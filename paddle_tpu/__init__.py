@@ -1,0 +1,50 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new framework with the capability surface of the PaddlePaddle
+reference (see SURVEY.md): eager autograd + jit compilation, full nn/optim/io
+stacks, and hybrid-parallel training (DP/TP/PP/SP/EP/ZeRO) — built
+TPU-first on JAX/XLA/Pallas: ops are pure-jax functions XLA fuses onto the
+MXU, autograd is jax.vjp over those functions, distribution is GSPMD over a
+jax.sharding.Mesh, and the hot kernels (flash attention, MoE dispatch) are
+Pallas.
+"""
+
+from __future__ import annotations
+
+from . import flags  # noqa: F401  (registers core flags first)
+from .flags import set_flags, get_flags  # noqa: F401
+
+from .core.dtype import (  # noqa: F401
+    dtype, float16, bfloat16, float32, float64, int8, int16, int32, int64,
+    uint8, bool_, complex64, complex128, get_default_dtype, set_default_dtype,
+)
+from .core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .core.autograd import no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+
+from .ops import *  # noqa: F401,F403
+from .ops import __all__ as _ops_all
+from .ops.random import seed, get_rng_state, set_rng_state  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import metric  # noqa: F401
+from . import device  # noqa: F401
+from . import jit  # noqa: F401
+from . import framework  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .framework.param_attr import ParamAttr  # noqa: F401
+from .device import set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu  # noqa: F401
+from .metric import accuracy  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = (
+    ["Tensor", "Parameter", "to_tensor", "no_grad", "enable_grad", "grad",
+     "seed", "save", "load", "set_default_dtype", "get_default_dtype",
+     "set_flags", "get_flags", "set_device", "get_device", "ParamAttr",
+     "accuracy"]
+    + list(_ops_all)
+)

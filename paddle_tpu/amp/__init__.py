@@ -1,0 +1,15 @@
+"""AMP: auto_cast + GradScaler (reference: python/paddle/amp/auto_cast.py:698,
+grad_scaler.py:578; O1/O2 op lists in amp/amp_lists.py).
+
+TPU-native: the native mixed-precision dtype is bfloat16 (no loss scaling
+required — GradScaler degrades to a no-op scale of 1.0 for bf16, kept for
+API parity and fp16 semantics)."""
+
+from .auto_cast import (  # noqa: F401
+    auto_cast, amp_guard, amp_state, decorate, white_list, black_list,
+    is_auto_cast_enabled, get_amp_dtype,
+)
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler",
+           "is_auto_cast_enabled", "get_amp_dtype"]

@@ -1,0 +1,130 @@
+"""Autocast context (reference: python/paddle/amp/auto_cast.py).
+
+Dispatch integration: core/dispatch.apply_op consults this module's state
+and casts floating inputs of white-list ops to the amp dtype (the reference
+bakes the same logic into every generated forward via AMP_LOGIC_TEMPLATE,
+eager_gen.py:502)."""
+
+from __future__ import annotations
+
+import threading
+
+from ..core.dtype import convert_dtype
+
+# O1 white list: ops that run in low precision (matmul-class, conv-class) —
+# reference python/paddle/amp/amp_lists.py WHITE_LIST
+white_list = {
+    "matmul", "mm", "bmm", "linear", "conv", "conv_transpose", "einsum",
+    "scaled_dot_product_attention", "flash_attention", "lstm_layer",
+    "gru_layer", "simple_rnn_layer", "embedding_lookup", "tensordot",
+}
+
+# black list: numerically-sensitive ops stay fp32 —
+# reference amp_lists.py BLACK_LIST
+black_list = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax",
+    "log_softmax", "cross_entropy", "bce_loss", "bce_with_logits",
+    "mse_loss", "l1_loss", "nll_loss", "kl_div", "sum", "mean", "p_norm",
+    "frobenius_norm", "layer_norm", "batch_norm_train", "batch_norm_infer",
+    "rms_norm", "group_norm", "instance_norm", "softmax_with_cross_entropy",
+    "cumsum", "cumprod", "pow", "square", "reciprocal", "rsqrt",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = None
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_STATE = _AmpState()
+
+
+def is_auto_cast_enabled() -> bool:
+    return _STATE.enabled
+
+
+def get_amp_dtype():
+    return _STATE.dtype
+
+
+def amp_state():
+    return _STATE
+
+
+def _cast_for_op(op_name: str, arrays):
+    """Called from dispatch: cast float arrays per amp policy."""
+    import jax.numpy as jnp
+    if not _STATE.enabled:
+        return arrays
+    wl = (white_list | _STATE.custom_white) - _STATE.custom_black
+    bl = (black_list | _STATE.custom_black) - _STATE.custom_white
+    if _STATE.level == "O2":
+        in_low = op_name not in bl
+    else:
+        in_low = op_name in wl
+    target = _STATE.dtype if in_low else jnp.float32
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and hasattr(a, "astype") \
+                and jnp.issubdtype(a.dtype, jnp.floating) \
+                and a.dtype != target:
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+class auto_cast:
+    """paddle.amp.auto_cast parity (context manager / decorator)."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        self.enable = enable
+        self.level = level
+        self.dtype = convert_dtype(dtype)
+        self.custom_white = set(custom_white_list or ())
+        self.custom_black = set(custom_black_list or ())
+
+    def __enter__(self):
+        self._prev = (_STATE.enabled, _STATE.dtype, _STATE.level,
+                      _STATE.custom_white, _STATE.custom_black)
+        _STATE.enabled = self.enable
+        _STATE.dtype = self.dtype
+        _STATE.level = self.level
+        _STATE.custom_white = self.custom_white
+        _STATE.custom_black = self.custom_black
+        return self
+
+    def __exit__(self, *exc):
+        (_STATE.enabled, _STATE.dtype, _STATE.level,
+         _STATE.custom_white, _STATE.custom_black) = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with self:
+                return fn(*a, **k)
+        return wrapper
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """paddle.amp.decorate parity: O2 casts model params to the amp dtype
+    (reference amp/auto_cast.py:782)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers

@@ -1,0 +1,21 @@
+"""paddle_tpu.autograd — user-facing autograd API
+(reference: python/paddle/autograd/__init__.py)."""
+
+from ..core.autograd import grad, no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    from ..core.autograd import run_backward
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+__all__ = ["grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "backward", "PyLayer", "PyLayerContext",
+           "saved_tensors_hooks"]

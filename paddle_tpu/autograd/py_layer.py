@@ -1,0 +1,104 @@
+"""PyLayer: user-defined autograd ops
+(reference: python/paddle/autograd/py_layer.py + C++
+paddle/fluid/pybind/eager_py_layer.cc).
+
+The user's ``backward`` runs inside our engine as the node's vjp — it
+receives/returns Tensors (with grad disabled), exactly the reference
+contract."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ..core import autograd
+from ..core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = [t.detach() if isinstance(t, Tensor) else t
+                       for t in tensors]
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff = [id(a) for a in args]
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = value
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with autograd.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+
+        requires = autograd.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if not requires:
+            return outputs
+
+        out_avals = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
+                     for o in outs if isinstance(o, Tensor)]
+        non_diff = getattr(ctx, "_non_diff", [])
+
+        def vjp_fn(cotangents):
+            with autograd.no_grad():
+                cots = [Tensor(c) for c in cotangents]
+                grads = cls.backward(ctx, *cots)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            out = []
+            for g in grads:
+                out.append(g._value if isinstance(g, Tensor) else g)
+            # pad to match input count
+            while len(out) < len(tensor_inputs):
+                out.append(None)
+            import jax.numpy as jnp
+            return tuple(
+                jnp.zeros(t._value.shape, t._value.dtype) if o is None else o
+                for o, t in zip(out, tensor_inputs))
+
+        node = autograd.GradNode(cls.__name__, vjp_fn, tensor_inputs, out_avals)
+        idx = 0
+        for o in outs:
+            if isinstance(o, Tensor) and id(o) not in non_diff:
+                o.stop_gradient = False
+                o._grad_node = node
+                o._out_index = idx
+            if isinstance(o, Tensor):
+                idx += 1
+        return outputs if multi else outs[0]
+
+
+# legacy alias used by some reference code paths
+LegacyPyLayer = PyLayer

@@ -1,0 +1,33 @@
+"""saved_tensors_hooks (reference: python/paddle/autograd/saved_tensors_hooks.py).
+
+Note: our GradNodes keep residuals inside jax.vjp closures, so pack/unpack
+hooks apply only to PyLayer.save_for_backward tensors. Activation
+recomputation (the main use) is provided natively by
+paddle_tpu.distributed.fleet.recompute (jax.checkpoint/remat)."""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["saved_tensors_hooks"]
+
+_state = threading.local()
+
+
+def current_hooks():
+    return getattr(_state, "hooks", None)
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        self._prev = getattr(_state, "hooks", None)
+        _state.hooks = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        _state.hooks = self._prev
+        return False

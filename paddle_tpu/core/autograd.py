@@ -1,0 +1,257 @@
+"""Eager autograd engine — tape-free graph of grad nodes, BFS executor.
+
+TPU-native analogue of the reference eager autograd
+(reference: paddle/fluid/eager/backward.cc:105 ``RunBackward``,
+paddle/fluid/eager/grad_node_info.h:183 ``GradNodeBase``).
+
+Design difference vs the reference: the reference generates one C++ GradNode
+class per op from YAML; here every op's VJP is obtained from ``jax.vjp`` over
+the op's (pure, JAX-traceable) forward function at call time, so there is ONE
+source of truth per op and the backward rule is always consistent with the
+forward — and the same tape works under ``jax.jit`` tracing, which is what
+makes whole train steps compilable.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Sequence
+
+import jax
+
+__all__ = ["GradNode", "run_backward", "grad", "no_grad", "enable_grad",
+           "is_grad_enabled", "set_grad_enabled"]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_STATE = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _STATE.enabled
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _STATE.enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager / decorator disabling grad recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _STATE.enabled
+        _STATE.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _STATE.enabled
+        _STATE.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.enabled = self._prev
+        return False
+
+
+class GradNode:
+    """One recorded op on the autograd graph.
+
+    ``vjp_fn`` maps a tuple of output cotangents to a tuple of input
+    cotangents (one per differentiable tensor input, aligned with ``inputs``).
+    ``out_avals`` carries shape/dtype of each forward output so missing
+    cotangents can be materialized as zeros.
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "_buffer", "_hooks")
+
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence,
+                 out_avals: Sequence[jax.ShapeDtypeStruct]):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)   # Tensor objects (strong refs, like the reference)
+        self.out_avals = list(out_avals)
+        self._buffer = None          # per-output accumulated cotangents
+        self._hooks = []
+
+    def accumulate(self, index: int, cotangent) -> None:
+        if self._buffer is None:
+            self._buffer = [None] * len(self.out_avals)
+        cur = self._buffer[index]
+        self._buffer[index] = cotangent if cur is None else cur + cotangent
+
+    def take_cotangents(self):
+        import jax.numpy as jnp
+        buf = self._buffer or [None] * len(self.out_avals)
+        outs = []
+        for aval, c in zip(self.out_avals, buf):
+            if c is None:
+                c = jnp.zeros(aval.shape, aval.dtype)
+            elif c.dtype != aval.dtype:
+                # AMP boundary: consumer ran in a different precision than
+                # this node's output (reference casts grads the same way)
+                c = c.astype(aval.dtype)
+            outs.append(c)
+        self._buffer = None
+        return tuple(outs)
+
+    def register_hook(self, hook: Callable) -> None:
+        self._hooks.append(hook)
+
+    def release(self) -> None:
+        self.vjp_fn = None
+        self.inputs = []
+        self._buffer = None
+
+
+def _toposort_count(roots: list[GradNode]) -> dict[GradNode, int]:
+    """Count, for every reachable node, how many consumer edges point at it
+    (reference backward.cc in-degree counting)."""
+    indeg: dict[GradNode, int] = {}
+    seen = set()
+    stack = list(roots)
+    for r in roots:
+        indeg.setdefault(r, 0)
+        seen.add(id(r))
+    while stack:
+        node = stack.pop()
+        for t in node.inputs:
+            p = t._grad_node
+            if p is not None:
+                indeg[p] = indeg.get(p, 0) + 1
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    stack.append(p)
+    return indeg
+
+
+def run_backward(tensors: Sequence, grad_tensors: Sequence | None = None,
+                 retain_graph: bool = False,
+                 accumulate_fn: Callable | None = None) -> None:
+    """BFS backward over the grad-node graph.
+
+    ``accumulate_fn(leaf_tensor, cotangent)`` lets :func:`grad` capture
+    gradients without touching ``.grad`` (reference GeneralGrad analogue);
+    default behavior accumulates into ``tensor.grad``.
+    """
+    import jax.numpy as jnp
+
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    roots: list[GradNode] = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    f"grad can be implicitly created only for scalar outputs, "
+                    f"got shape {t.shape}")
+            g = jnp.ones(t._value.shape, t._value.dtype)
+        elif hasattr(g, "_value"):
+            g = g._value
+        node = t._grad_node
+        if node is None:
+            if accumulate_fn is not None:
+                accumulate_fn(t, g)
+            else:
+                t._accumulate_grad(g)
+            continue
+        node.accumulate(t._out_index, g)
+        roots.append(node)
+
+    indeg = _toposort_count(roots)
+    # roots seeded directly are ready once their (possibly zero) consumer
+    # edges inside the subgraph are drained; seed-only roots start at 0.
+    queue = deque(n for n, d in indeg.items() if d == 0)
+    processed = set()
+
+    while queue:
+        node = queue.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        cots = node.take_cotangents()
+        for hook in node._hooks:
+            cots = tuple(hook(c) for c in cots)
+        in_cots = node.vjp_fn(cots)
+        for t, c in zip(node.inputs, in_cots):
+            if t.stop_gradient:
+                continue
+            for h in t._grad_hooks:
+                r = h(c)
+                if r is not None:
+                    c = r
+            p = t._grad_node
+            if p is None:
+                if accumulate_fn is not None:
+                    accumulate_fn(t, c)
+                else:
+                    t._accumulate_grad(c)
+            else:
+                p.accumulate(t._out_index, c)
+                indeg[p] -= 1
+                if indeg[p] == 0:
+                    queue.append(p)
+        if not retain_graph:
+            node.release()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad parity (reference python/paddle/autograd + GeneralGrad).
+
+    Returns gradients of ``outputs`` w.r.t. ``inputs`` without writing
+    ``.grad``. ``create_graph`` (higher-order) is not yet supported.
+    """
+    import jax.numpy as jnp
+
+    if create_graph:
+        raise NotImplementedError("create_graph=True (higher-order grad) "
+                                  "is not supported yet; use paddle_tpu.incubate.autograd")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    captured: dict[int, Any] = {}
+    wanted = {id(t): t for t in inputs}
+
+    def capture(leaf, cot):
+        if id(leaf) in wanted:
+            cur = captured.get(id(leaf))
+            captured[id(leaf)] = cot if cur is None else cur + cot
+
+    retain = bool(retain_graph) if retain_graph is not None else create_graph
+    run_backward(outputs, grad_outputs, retain_graph=retain,
+                 accumulate_fn=capture)
+
+    from .tensor import Tensor
+    results = []
+    for t in inputs:
+        c = captured.get(id(t))
+        if c is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient; "
+                    "pass allow_unused=True to return None for it")
+            results.append(None)
+        else:
+            results.append(Tensor(c, stop_gradient=True))
+    return results

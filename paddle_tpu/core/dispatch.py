@@ -1,0 +1,121 @@
+"""Op dispatch: the single funnel every public op goes through.
+
+TPU-native analogue of the reference's generated dygraph forward functions
+(reference: paddle/fluid/eager/auto_code_generator/generator/eager_gen.py
+FORWARD_FUNCTION_TEMPLATE — profiler span → AMP cast → AutogradMeta collect →
+GradNode creation → API call → output meta stamping).
+
+Here the per-op "kernel" is a pure JAX function; under eager execution JAX
+dispatches it op-by-op (optionally through a cached ``jax.jit`` wrapper), and
+under tracing the same code inlines into the surrounding jit program. The
+GradNode's vjp comes from ``jax.vjp`` over the same function — no separate
+backward codegen.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .. import flags
+from . import autograd
+from .tensor import Tensor
+
+__all__ = ["apply_op", "defop", "OP_REGISTRY", "register_op"]
+
+# Global op registry: name -> pure jax function. The analogue of the
+# reference KernelFactory (paddle/phi/core/kernel_factory.h:314): one entry
+# per op, keyed by name; "backend" selection is jax's own (TPU vs CPU).
+OP_REGISTRY: dict[str, Callable] = {}
+
+
+def register_op(name: str, fn: Callable) -> None:
+    OP_REGISTRY[name] = fn
+
+
+def _check_nan_inf(name: str, arrays) -> None:
+    for a in arrays:
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            bad = ~jnp.isfinite(a)
+            if bool(bad.any()):
+                raise FloatingPointError(f"op {name!r} produced NaN/Inf")
+
+
+def apply_op(name: str, fn: Callable, args: tuple, kwargs: dict,
+             differentiable: bool = True):
+    """Run op ``fn`` on mixed Tensor/raw args, recording autograd if needed.
+
+    Non-Tensor args (ints, shapes, axes, python floats) are closed over;
+    Tensor args become vjp primals. Outputs are Tensors. ``fn`` must be pure
+    and jax-traceable.
+    """
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    arrays = [a._value if isinstance(a, Tensor) else a for a in args]
+
+    # AMP autocast (reference eager_gen.py AMP_LOGIC_TEMPLATE): cast float
+    # inputs per the active amp policy before tracing/recording.
+    from ..amp.auto_cast import _STATE as _amp_state, _cast_for_op
+    if _amp_state.enabled:
+        arrays = _cast_for_op(name, arrays)
+
+    requires_grad = (
+        differentiable
+        and autograd.is_grad_enabled()
+        and any(not args[i].stop_gradient for i in tensor_idx)
+    )
+
+    if not requires_grad:
+        out = fn(*arrays, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        if flags.flag("check_nan_inf"):
+            _check_nan_inf(name, outs)
+        wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
+        return tuple(wrapped) if multi else wrapped[0]
+
+    def f(*tensor_arrays):
+        full = list(arrays)
+        for i, ta in zip(tensor_idx, tensor_arrays):
+            full[i] = ta
+        out = fn(*full, **kwargs)
+        return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+    primals = [arrays[i] for i in tensor_idx]
+    outs, vjp_fn = jax.vjp(f, *primals)
+    if flags.flag("check_nan_inf"):
+        _check_nan_inf(name, outs)
+
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+    node = autograd.GradNode(name, vjp_fn,
+                             [args[i] for i in tensor_idx], out_avals)
+    wrapped = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=False)
+        t._grad_node = node
+        t._out_index = i
+        wrapped.append(t)
+    # Re-detect multi-output from the raw fn contract: f always tuples.
+    return tuple(wrapped) if len(wrapped) > 1 else wrapped[0]
+
+
+def defop(name: str, differentiable: bool = True):
+    """Decorator turning a pure jax-array function into a public Tensor op.
+
+    The wrapped function accepts Tensors (or array-likes) in tensor
+    positions; scalars/shapes/axes pass through. The raw jax function stays
+    reachable as ``op.raw`` for use inside other kernels and jit tracing.
+    """
+    def deco(fn: Callable):
+        register_op(name, fn)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return apply_op(name, fn, args, kwargs, differentiable)
+
+        wrapper.raw = fn
+        wrapper.op_name = name
+        return wrapper
+    return deco

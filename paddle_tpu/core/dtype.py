@@ -1,0 +1,78 @@
+"""Dtype system: paddle-style dtype names over jnp dtypes.
+
+Reference analogue: paddle/phi/common/data_type.h (DataType enum) and the
+python `paddle.float32` etc. aliases. On TPU the native matmul dtype is
+bfloat16; float32 remains the default parameter dtype (as in the reference)
+and AMP switches compute to bf16.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "dtype", "float16", "bfloat16", "float32", "float64", "int8", "int16",
+    "int32", "int64", "uint8", "bool_", "complex64", "complex128",
+    "convert_dtype", "is_floating_point_dtype", "is_integer_dtype",
+    "get_default_dtype", "set_default_dtype",
+]
+
+# Canonical dtypes are numpy dtype objects (jnp uses them natively).
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+dtype = np.dtype  # the type of a dtype object
+
+_NAME_TO_DTYPE = {
+    "float16": float16, "fp16": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int": int32,
+    "int64": int64, "long": int64, "uint8": uint8,
+    "bool": bool_, "complex64": complex64, "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [np.dtype("float32")]
+
+
+def convert_dtype(d) -> np.dtype:
+    """Normalize str/np/jnp dtype to a numpy dtype object."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        if d not in _NAME_TO_DTYPE:
+            raise ValueError(f"unknown dtype name {d!r}")
+        return np.dtype(_NAME_TO_DTYPE[d])
+    return np.dtype(d)
+
+
+def is_floating_point_dtype(d) -> bool:
+    d = convert_dtype(d)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer_dtype(d) -> bool:
+    d = convert_dtype(d)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def get_default_dtype() -> np.dtype:
+    """paddle.get_default_dtype parity."""
+    return _DEFAULT_DTYPE[0]
+
+
+def set_default_dtype(d) -> None:
+    """paddle.set_default_dtype parity."""
+    _DEFAULT_DTYPE[0] = convert_dtype(d)

@@ -1,0 +1,222 @@
+"""Eager Tensor: a jax.Array plus autograd metadata.
+
+TPU-native analogue of the reference eager tensor
+(reference: paddle/phi/api/include/tensor.h:82 ``paddle::Tensor`` +
+paddle/fluid/eager/autograd_meta.h:61 ``AutogradMeta``). The device buffer is
+a ``jax.Array`` (PJRT-managed, async); autograd metadata is
+``stop_gradient`` / ``grad`` / the producing :class:`GradNode` edge.
+
+Most numeric methods are installed by ``paddle_tpu.ops`` at import time so
+the op surface has a single definition site (the YAML-registry analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dtype import convert_dtype, get_default_dtype
+
+__all__ = ["Tensor", "Parameter", "to_tensor"]
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_out_index",
+                 "_grad_hooks", "name", "persistable", "__weakref__")
+
+    def __init__(self, value, stop_gradient: bool = True, name: str | None = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self._grad_hooks = []
+        self.name = name
+        self.persistable = False
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self) -> list[int]:
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def size(self) -> int:
+        return int(self._value.size)
+
+    @property
+    def place(self):
+        devs = getattr(self._value, "devices", None)
+        if devs is None:
+            return None
+        ds = self._value.devices()
+        return next(iter(ds)) if ds else None
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    def numel(self) -> int:
+        return int(self._value.size)
+
+    # -- conversion --------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return self._value.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor: "Tensor | None" = None,
+                 retain_graph: bool = False) -> None:
+        """Run backward from this tensor (reference eager_method.cc backward
+        → backward.cc:105 RunBackward)."""
+        from . import autograd
+        grads = None if grad_tensor is None else [grad_tensor]
+        autograd.run_backward([self], grads, retain_graph=retain_graph)
+
+    def _accumulate_grad(self, cotangent) -> None:
+        if self.grad is None:
+            self.grad = Tensor(cotangent, stop_gradient=True)
+        else:
+            self.grad = Tensor(self.grad._value + cotangent, stop_gradient=True)
+
+    def clear_grad(self) -> None:
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False) -> None:
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._value), stop_gradient=True)
+        else:
+            self.grad = None
+
+    def register_hook(self, hook) -> None:
+        """Hook on this tensor's gradient during backward."""
+        self._grad_hooks.append(hook)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self) -> "Tensor":
+        from .dispatch import apply_op
+        return apply_op("clone", lambda x: x + 0, (self,), {})
+
+    # -- mutation (eager only; jax arrays are immutable, rebind) ----------
+    def set_value(self, value) -> None:
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._value.shape}")
+        self._value = value.astype(self._value.dtype)
+
+    def copy_(self, other, blocking: bool = True) -> "Tensor":
+        self.set_value(other)
+        return self
+
+    def _in_place_update(self, new_value) -> None:
+        """Optimizer-style in-place update: rebinds the buffer, keeps identity."""
+        self._value = new_value
+
+    # -- misc --------------------------------------------------------------
+    def block_until_ready(self) -> "Tensor":
+        jax.block_until_ready(self._value)
+        return self
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_info},\n       {np.asarray(self._value)!r})")
+
+    # numeric methods (add, matmul, reshape, ...) are installed by
+    # paddle_tpu.ops._install_tensor_methods()
+
+
+class Parameter(Tensor):
+    """Trainable parameter (reference python/paddle/base/framework.py Parameter
+    semantics: persistable, trainable=not stop_gradient)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, value, trainable: bool = True, name: str | None = None):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor parity."""
+    if isinstance(data, Tensor):
+        v = data._value
+    else:
+        if isinstance(data, (list, tuple)) or np.isscalar(data) or isinstance(data, np.ndarray):
+            arr = np.asarray(data)
+            if dtype is None and arr.dtype == np.float64:
+                arr = arr.astype(get_default_dtype())
+            v = jnp.asarray(arr)
+        else:
+            v = jnp.asarray(data)
+    if dtype is not None:
+        v = v.astype(convert_dtype(dtype))
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+# -- pytree registration: lets jax.jit / tree utils consume Tensors --------
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient,)
+
+
+def _tensor_unflatten(aux, children):
+    t = Tensor(children[0], stop_gradient=aux[0])
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda p: ((p._value,), (not p.stop_gradient,)),
+    lambda aux, ch: Parameter(ch[0], trainable=aux[0]),
+)

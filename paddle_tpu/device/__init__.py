@@ -1,0 +1,151 @@
+"""Device API (reference: python/paddle/device/__init__.py:250 set_device,
+:419 Event, :569 Stream, :900 synchronize).
+
+On TPU the PJRT runtime owns streams/allocation; Event/Stream are provided
+as API-parity objects mapping to jax's async dispatch (block_until_ready)."""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["set_device", "get_device", "get_all_devices", "device_count",
+           "synchronize", "is_compiled_with_cuda", "is_compiled_with_tpu",
+           "is_compiled_with_rocm", "is_compiled_with_xpu",
+           "is_compiled_with_custom_device", "Stream", "Event",
+           "get_available_device", "get_available_custom_device", "cuda"]
+
+_current_device = [None]
+
+
+def set_device(device: str):
+    """paddle.set_device parity. Accepts 'tpu', 'tpu:0', 'cpu', 'gpu:0'
+    (gpu maps to whatever accelerator jax exposes)."""
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    if name in ("tpu", "gpu", "xpu", "npu", "custom", "axon"):
+        devs = [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+    else:
+        devs = jax.devices("cpu")
+    _current_device[0] = devs[min(idx, len(devs) - 1)]
+    return _current_device[0]
+
+
+def get_device() -> str:
+    d = _current_device[0]
+    if d is None:
+        d = jax.devices()[0]
+    plat = "tpu" if d.platform in ("tpu", "axon") else d.platform
+    return f"{plat}:{d.id}"
+
+
+def get_current_device():
+    d = _current_device[0]
+    return d if d is not None else jax.devices()[0]
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_device():
+    return get_all_devices()
+
+
+def get_available_custom_device():
+    return []
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (reference
+    device/__init__.py:900; PJRT equivalent of stream sync)."""
+    (jax.effects_barrier if hasattr(jax, "effects_barrier") else lambda: None)()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def is_compiled_with_custom_device(device_name: str = "") -> bool:
+    return device_name in ("tpu", "axon")
+
+
+class Stream:
+    """API-parity stream. XLA/PJRT serializes per-device execution; multiple
+    streams map onto jax's async dispatch, so this is ordering metadata."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self.device = device
+
+    def record(self, stream=None):
+        pass
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+class _CudaNamespace:
+    """paddle.device.cuda shim so CUDA-written scripts run (reference
+    python/paddle/device/cuda)."""
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return 0
+
+    Stream = Stream
+    Event = Event
+
+
+cuda = _CudaNamespace()

@@ -1,0 +1,108 @@
+"""Runtime flag registry.
+
+TPU-native analogue of the reference's exported-flag system
+(reference: paddle/phi/core/flags.h:40-105, flags.cc — 105 exported
+``FLAGS_*`` gflags settable from env and ``paddle.set_flags``).
+
+Flags are declared once with a default + help string, can be overridden by
+``FLAGS_<name>`` environment variables at import time, and changed at runtime
+via :func:`set_flags` / read via :func:`get_flags`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+__all__ = ["define_flag", "set_flags", "get_flags", "flag"]
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    value: Any
+    help: str
+    type: type
+    on_change: Callable[[Any], None] | None = None
+
+
+_REGISTRY: dict[str, _Flag] = {}
+_LOCK = threading.Lock()
+
+
+def _parse(raw: str, ty: type) -> Any:
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return ty(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                on_change: Callable[[Any], None] | None = None) -> None:
+    """Register a runtime flag. Env var ``FLAGS_<name>`` overrides default."""
+    ty = type(default)
+    value = default
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        value = _parse(env, ty)
+    with _LOCK:
+        _REGISTRY[name] = _Flag(name, default, value, help, ty, on_change)
+
+
+def set_flags(flags: dict[str, Any]) -> None:
+    """Set one or more registered flags (paddle.set_flags parity)."""
+    with _LOCK:
+        for k, v in flags.items():
+            if k.startswith("FLAGS_"):
+                k = k[len("FLAGS_"):]
+            if k not in _REGISTRY:
+                raise KeyError(f"unknown flag {k!r}; known: {sorted(_REGISTRY)}")
+            f = _REGISTRY[k]
+            f.value = _parse(v, f.type) if isinstance(v, str) and f.type is not str else f.type(v)
+            if f.on_change is not None:
+                f.on_change(f.value)
+
+
+def get_flags(flags: list[str] | str | None = None) -> dict[str, Any]:
+    if flags is None:
+        names = list(_REGISTRY)
+    elif isinstance(flags, str):
+        names = [flags]
+    else:
+        names = list(flags)
+    out = {}
+    for k in names:
+        key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        out[k] = _REGISTRY[key].value
+    return out
+
+
+def flag(name: str) -> Any:
+    """Fast accessor for internal use."""
+    return _REGISTRY[name].value
+
+
+# ---------------------------------------------------------------------------
+# Core flags (subset of reference paddle/phi/core/flags.cc relevant on TPU).
+# ---------------------------------------------------------------------------
+def _set_matmul_precision(value: str) -> None:
+    import jax
+    jax.config.update("jax_default_matmul_precision", value)
+
+
+define_flag("check_nan_inf", False, "Check outputs of every op for NaN/Inf (reference FLAGS_check_nan_inf).")
+define_flag("benchmark", False, "Synchronize after every op for timing.")
+# fp32 matmuls must match the reference's fp32 numerics (cuBLAS default);
+# the bf16 fast path goes through AMP casting inputs, which the MXU consumes
+# natively regardless of this setting.
+define_flag("tpu_default_matmul_precision", "highest",
+            "jax matmul precision for f32 inputs: default|high|highest.",
+            on_change=_set_matmul_precision)
+_set_matmul_precision(flag("tpu_default_matmul_precision"))
+define_flag("eager_op_cache", True, "Cache per-op jitted executables for eager dispatch.")
+define_flag("use_pallas_kernels", True, "Use Pallas kernels (flash attention etc.) when on TPU.")
+define_flag("log_level", 0, "Verbose log level (reference GLOG_v analogue).")
+define_flag("allocator_strategy", "auto_growth", "Kept for API parity; PJRT owns device memory on TPU.")
+define_flag("comm_timeout_seconds", 1800, "Collective watchdog timeout (reference NCCLCommTask 30min default).")

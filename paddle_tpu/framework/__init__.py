@@ -1,0 +1,7 @@
+"""paddle_tpu.framework (reference: python/paddle/framework/__init__.py)."""
+
+from .param_attr import ParamAttr  # noqa: F401
+from .io import save, load  # noqa: F401
+from . import random  # noqa: F401
+
+__all__ = ["ParamAttr", "save", "load", "random"]

@@ -1,0 +1,71 @@
+"""Checkpoint save/load (reference: python/paddle/framework/io.py:646 save,
+:885 load — pickled nested state_dicts with tensor payloads).
+
+Format: pickle of nested containers where tensors are stored as
+``{"__tensor__": ndarray, "stop_gradient": bool}`` — cross-loadable without
+jax present. Distributed sharded checkpointing lives in
+paddle_tpu.distributed.checkpoint (async + reshard-on-load)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+def _pack(obj):
+    if isinstance(obj, Parameter):
+        return {"__param__": np.asarray(obj._value),
+                "trainable": obj.trainable, "name": obj.name}
+    if isinstance(obj, Tensor):
+        return {"__tensor__": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    import jax.numpy as jnp
+    if isinstance(obj, dict):
+        if "__tensor__" in obj:
+            if return_numpy:
+                return obj["__tensor__"]
+            return Tensor(jnp.asarray(obj["__tensor__"]),
+                          stop_gradient=obj.get("stop_gradient", True))
+        if "__param__" in obj:
+            if return_numpy:
+                return obj["__param__"]
+            return Parameter(jnp.asarray(obj["__param__"]),
+                             trainable=obj.get("trainable", True),
+                             name=obj.get("name"))
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTOCOL, **configs):
+    """paddle.save parity: nested state dict / tensor / layer state."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """paddle.load parity."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,7 @@
+"""Framework RNG helpers (reference: python/paddle/framework/random.py)."""
+
+from ..ops.random import (  # noqa: F401
+    seed, get_rng_state, set_rng_state, default_generator,
+)
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "default_generator"]

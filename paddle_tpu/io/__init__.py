@@ -1,0 +1,26 @@
+"""paddle_tpu.io — Dataset / DataLoader
+(reference: python/paddle/io/reader.py:216 DataLoader,
+io/dataloader/dataloader_iter.py multiprocess workers).
+
+TPU-native notes: host-side input pipeline feeding device via async
+dispatch; multiprocessing workers use the same worker/collate design as the
+reference. Batches are collated to numpy (host) and converted to device
+arrays lazily on first op."""
+
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    Subset, random_split, ConcatDataset,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, BatchSampler,
+    DistributedBatchSampler, WeightedRandomSampler, SubsetRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "Subset", "random_split", "ConcatDataset", "Sampler",
+    "SequenceSampler", "RandomSampler", "BatchSampler",
+    "DistributedBatchSampler", "WeightedRandomSampler", "SubsetRandomSampler",
+    "DataLoader", "default_collate_fn", "get_worker_info",
+]

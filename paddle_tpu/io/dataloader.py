@@ -1,0 +1,180 @@
+"""DataLoader (reference: python/paddle/io/reader.py:216 DataLoader;
+multiprocess iter in io/dataloader/dataloader_iter.py:358).
+
+Single-process path collates in the calling thread; multiprocess path uses
+a worker pool feeding an index queue / result dict with prefetching
+(same worker protocol shape as the reference, built on python
+multiprocessing instead of paddle's shared-memory tensors — device upload
+happens in the consumer, so workers only move numpy arrays)."""
+
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+@dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    dataset: object
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (reference
+    io/dataloader/collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch, axis=0)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if hasattr(sample, "_value"):  # Tensor
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        return Tensor(jnp.stack([s._value for s in batch], axis=0))
+    if isinstance(sample, (list, tuple)):
+        transposed = zip(*batch)
+        return type(sample)(default_collate_fn(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return batch
+    return np.asarray(batch)
+
+
+def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id,
+                 num_workers, worker_init_fn):
+    _worker_info.info = WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_id, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            data = collate_fn(samples)
+            result_queue.put((batch_id, data, None))
+        except Exception as e:  # noqa: BLE001
+            result_queue.put((batch_id, None, e))
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.collate_fn = collate_fn or default_collate_fn
+        self.worker_init_fn = worker_init_fn
+        self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        elif batch_size is None:
+            self.batch_sampler = None
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_multiprocess()
+
+    def _to_output(self, data):
+        return data
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            samples = [self.dataset[i] for i in indices]
+            yield self._to_output(self.collate_fn(samples))
+
+    def _iter_iterable(self):
+        it = iter(self.dataset)
+        while True:
+            batch = list(itertools.islice(it, self.batch_size))
+            if not batch:
+                return
+            if len(batch) < self.batch_size and self.drop_last:
+                return
+            yield self._to_output(self.collate_fn(batch))
+
+    def _iter_multiprocess(self):
+        ctx = mp.get_context("fork")
+        index_queues = [ctx.Queue() for _ in range(self.num_workers)]
+        result_queue = ctx.Queue()
+        workers = []
+        for wid in range(self.num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, index_queues[wid], result_queue,
+                      self.collate_fn, wid, self.num_workers,
+                      self.worker_init_fn),
+                daemon=True)
+            w.start()
+            workers.append(w)
+        try:
+            batches = list(self.batch_sampler)
+            n = len(batches)
+            next_to_send = 0
+            # prime: prefetch_factor batches per worker
+            for _ in range(min(n, self.prefetch_factor * self.num_workers)):
+                index_queues[next_to_send % self.num_workers].put(
+                    (next_to_send, batches[next_to_send]))
+                next_to_send += 1
+            reorder: dict[int, object] = {}
+            next_to_yield = 0
+            while next_to_yield < n:
+                while next_to_yield not in reorder:
+                    bid, data, err = result_queue.get(
+                        timeout=self.timeout if self.timeout else None)
+                    if err is not None:
+                        raise err
+                    reorder[bid] = data
+                    if next_to_send < n:
+                        index_queues[next_to_send % self.num_workers].put(
+                            (next_to_send, batches[next_to_send]))
+                        next_to_send += 1
+                yield self._to_output(reorder.pop(next_to_yield))
+                next_to_yield += 1
+        finally:
+            for q in index_queues:
+                q.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()

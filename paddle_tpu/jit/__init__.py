@@ -1,0 +1,10 @@
+"""paddle_tpu.jit (reference: python/paddle/jit/__init__.py)."""
+
+from .api import (  # noqa: F401
+    to_static, not_to_static, ignore_module, enable_to_static, TrainStep,
+    InputSpec, StaticFunction,
+)
+from .save_load import save, load, TranslatedLayer  # noqa: F401
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "enable_to_static",
+           "TrainStep", "InputSpec", "StaticFunction", "save", "load"]

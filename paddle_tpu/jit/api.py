@@ -1,0 +1,270 @@
+"""paddle_tpu.jit — dynamic-to-static compilation
+(reference: python/paddle/jit/api.py:242 to_static; SOT bytecode tracer in
+jit/sot/; AST path in jit/dy2static/).
+
+TPU-native design: the reference needs a bytecode/AST tracer because its ops
+execute eagerly in C++; here every op is a jax-traceable function, so
+"to_static" is direct jax tracing of the SAME eager code — the Tensor tape
+runs at trace time and whole programs (including backward + optimizer
+update, see TrainStep) lower to one XLA executable. Guards/graph-breaks
+(SOT's job) reduce to jax.jit's shape/dtype-keyed compile cache.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from ..ops import random as R
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "enable_to_static",
+           "TrainStep", "InputSpec", "StaticFunction"]
+
+_to_static_enabled = [True]
+
+
+def enable_to_static(flag: bool):
+    _to_static_enabled[0] = bool(flag)
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _collect_state(fn) -> list[tuple[str, Tensor]]:
+    """Find the Layer state captured by fn (Layer itself, bound method, or
+    attribute `self` on a callable)."""
+    from ..nn.layer.layers import Layer
+    owner = None
+    if isinstance(fn, Layer):
+        owner = fn
+    elif hasattr(fn, "__self__") and isinstance(fn.__self__, Layer):
+        owner = fn.__self__
+    if owner is None:
+        return []
+    return list(owner.state_dict().items())
+
+
+class StaticFunction:
+    """Callable wrapping jax.jit over the eager code
+    (reference program_translator.py:316 StaticFunction)."""
+
+    def __init__(self, function: Callable, input_spec=None, full_graph=True,
+                 **kwargs):
+        self._raw_fn = function
+        from ..nn.layer.layers import Layer
+        self._layer = function if isinstance(function, Layer) else None
+        self._input_spec = input_spec
+        self._jitted = None
+        self._state_items: list[tuple[str, Tensor]] = []
+        functools.update_wrapper(
+            self, function.forward if self._layer is not None else function)
+
+    @property
+    def _callable(self):
+        return self._layer.forward if self._layer is not None else self._raw_fn
+
+    def _build(self):
+        self._state_items = _collect_state(
+            self._layer if self._layer is not None else self._raw_fn)
+        state_objs = [t for _, t in self._state_items]
+
+        def pure(state_vals, rng_key, args, kwargs):
+            originals = [t._value for t in state_objs]
+            orig_nodes = [(t._grad_node, t._out_index) for t in state_objs]
+            old_key = R.default_generator._key
+            try:
+                for t, v in zip(state_objs, state_vals):
+                    t._value = v
+                    t._grad_node = None
+                R.default_generator._key = rng_key
+                out = self._callable(*args, **kwargs)
+                out_vals = jax.tree_util.tree_map(
+                    lambda x: x._value if isinstance(x, Tensor) else x, out,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+                new_state = [t._value for t in state_objs]
+                return out_vals, new_state
+            finally:
+                for t, v, (n, i) in zip(state_objs, originals, orig_nodes):
+                    t._value = v
+                    t._grad_node = n
+                    t._out_index = i
+                R.default_generator._key = old_key
+
+        self._jitted = jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled[0]:
+            return self._callable(*args, **kwargs)
+        if self._jitted is None:
+            self._build()
+        state_objs = [t for _, t in self._state_items]
+        state_vals = [t._value for t in state_objs]
+        args_vals = jax.tree_util.tree_map(
+            lambda x: x._value if isinstance(x, Tensor) else x, args,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        kwargs_vals = jax.tree_util.tree_map(
+            lambda x: x._value if isinstance(x, Tensor) else x, kwargs,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        out_vals, new_state = self._jitted(state_vals, R.next_key(),
+                                           args_vals, kwargs_vals)
+        # buffer updates (e.g. BN running stats) land back in the objects
+        for t, v in zip(state_objs, new_state):
+            t._value = v
+        return jax.tree_util.tree_map(
+            lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out_vals)
+
+    # paddle API surface
+    def concrete_program(self):
+        return None
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(
+            self._callable.__func__ if hasattr(self._callable, "__func__")
+            else self._callable)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """reference jit/api.py:242. Decorator or call-style."""
+
+    def decorate(fn):
+        from ..nn.layer.layers import Layer
+        if isinstance(fn, Layer):
+            static = StaticFunction(fn, input_spec, **kwargs)
+            fn.forward_static = static
+            orig_forward = fn.forward
+            fn.__call__  # noqa: B018
+            # wrap the layer: calling it goes through the compiled path
+            def compiled_call(*a, **k):
+                return static(*a, **k)
+            fn.forward = compiled_call
+            fn._static_function = static
+            return fn
+        return StaticFunction(fn, input_spec, **kwargs)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules: Sequence[Any]):
+    pass
+
+
+class TrainStep:
+    """Whole-train-step compilation: forward + backward + optimizer update in
+    ONE XLA executable with donated buffers.
+
+    This is the TPU answer to the reference's Program+Executor hot path
+    (SURVEY §3.3): zero per-op Python overhead in steady state.
+
+        step = TrainStep(model, opt, loss_fn)
+        loss = step(x, y)          # compiled after first call
+    """
+
+    def __init__(self, model, optimizer, loss_fn: Callable):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self._jitted = None
+        self._params: list[Parameter] = []
+        self._buffers: list[Tensor] = []
+
+    def _build(self):
+        self.optimizer._ensure_state()
+        self._params = [p for p in self.optimizer._parameter_list]
+        state = dict(self.model.state_dict())
+        param_ids = {id(p) for p in self._params}
+        self._buffers = [t for t in state.values() if id(t) not in param_ids]
+        opt = self.optimizer
+
+        def pure(param_vals, buffer_vals, opt_state, rng_key, step_count,
+                 lr, args):
+            originals = [(t, t._value, t._grad_node, t._out_index, t.grad)
+                         for t in self._params + self._buffers]
+            old_key = R.default_generator._key
+            old_acc = {k: list(v) for k, v in opt._accumulators.items()}
+            old_step = opt._global_step
+            old_fn = opt._update_fn
+            opt.get_lr = lambda: lr  # traced lr (scheduler-safe)
+            try:
+                for t, v in zip(self._params, param_vals):
+                    t._value = v
+                    t._grad_node = None
+                    t.grad = None
+                for t, v in zip(self._buffers, buffer_vals):
+                    t._value = v
+                    t._grad_node = None
+                R.default_generator._key = rng_key
+                for slot in opt._accumulators:
+                    opt._accumulators[slot] = list(opt_state[slot])
+                opt._global_step = step_count
+                loss = self.loss_fn(self.model, *args)
+                loss.backward()
+                opt.step()
+                new_params = [t._value for t in self._params]
+                new_buffers = [t._value for t in self._buffers]
+                new_opt = {k: list(v) for k, v in opt._accumulators.items()}
+                return loss._value, new_params, new_buffers, new_opt
+            finally:
+                for t, v, n, i, g in originals:
+                    t._value = v
+                    t._grad_node = n
+                    t._out_index = i
+                    t.grad = g
+                opt._accumulators = old_acc
+                opt._global_step = old_step
+                opt._update_fn = old_fn
+                del opt.get_lr  # restore class method
+                R.default_generator._key = old_key
+
+        self._jitted = jax.jit(pure, donate_argnums=(0, 2))
+
+    def __call__(self, *args):
+        if self._jitted is None:
+            self._build()
+        opt = self.optimizer
+        param_vals = [p._value for p in self._params]
+        buffer_vals = [b._value for b in self._buffers]
+        opt_state = {k: list(v) for k, v in opt._accumulators.items()}
+        args_vals = jax.tree_util.tree_map(
+            lambda x: x._value if isinstance(x, Tensor) else
+            (jnp.asarray(x) if isinstance(x, np.ndarray) else x), args,
+            is_leaf=lambda x: isinstance(x, (Tensor, np.ndarray)))
+        loss_val, new_params, new_buffers, new_opt = self._jitted(
+            param_vals, buffer_vals, opt_state, R.next_key(),
+            jnp.asarray(opt._global_step, jnp.int32),
+            jnp.asarray(opt.get_lr(), jnp.float32), args_vals)
+        for p, v in zip(self._params, new_params):
+            p._value = v
+        for b, v in zip(self._buffers, new_buffers):
+            b._value = v
+        for k in opt._accumulators:
+            opt._accumulators[k] = list(new_opt[k])
+        opt._global_step += 1
+        if opt._lr_scheduler is not None:
+            pass  # user steps the scheduler explicitly, as in the reference
+        return Tensor(loss_val)

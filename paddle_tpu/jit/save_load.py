@@ -1,0 +1,96 @@
+"""jit.save / jit.load (reference: python/paddle/jit/api.py save/load →
+serialized inference program + params; C++ runtime paddle/fluid/jit/).
+
+TPU-native: the deployable artifact is params + a jax.export StableHLO
+module when exportable; fallback stores params + the layer's pickled config
+for python-side reload (serving path in paddle_tpu.inference uses the
+compiled executable cache directly)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Saves state_dict + (if possible) a StableHLO export of forward."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {k: np.asarray(v._value) for k, v in layer.state_dict().items()}
+    meta = {"class": type(layer).__name__}
+    payload = {"state": state, "meta": meta}
+    stablehlo = None
+    if input_spec:
+        try:
+            import jax
+            from .api import StaticFunction
+            sf = layer._static_function if hasattr(layer, "_static_function") \
+                else StaticFunction(layer)
+            import jax.numpy as jnp
+            from ..core.dtype import convert_dtype
+            examples = [Tensor(jnp.zeros([d if d is not None and d > 0 else 1
+                                          for d in spec.shape],
+                                         convert_dtype(spec.dtype)))
+                        for spec in input_spec]
+            sf._build()
+            state_objs = [t for _, t in sf._state_items]
+            state_vals = [t._value for t in state_objs]
+            import jax.export
+            def fwd(state_vals, xs):
+                out, _ = sf._jitted.__wrapped__(
+                    state_vals, jax.random.PRNGKey(0), tuple(xs), {})
+                return out
+            exported = jax.export.export(jax.jit(fwd))(
+                state_vals, [e._value for e in examples])
+            stablehlo = exported.serialize()
+        except Exception:  # noqa: BLE001 - export best-effort
+            stablehlo = None
+    payload["stablehlo"] = stablehlo
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+
+
+class TranslatedLayer:
+    """Loaded inference artifact (reference jit TranslatedLayer)."""
+
+    def __init__(self, payload):
+        self._state = payload["state"]
+        self._stablehlo = payload.get("stablehlo")
+        self._rebuilt = None
+        if self._stablehlo is not None:
+            import jax.export
+            self._rebuilt = jax.export.deserialize(self._stablehlo)
+
+    def state_dict(self):
+        import jax.numpy as jnp
+        return {k: Tensor(jnp.asarray(v)) for k, v in self._state.items()}
+
+    def __call__(self, *args):
+        if self._rebuilt is None:
+            raise RuntimeError(
+                "this artifact has no compiled program; load its state_dict "
+                "into the model class instead")
+        import jax.numpy as jnp
+        vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        state_vals = [jnp.asarray(v) for v in self._state.values()]
+        out = self._rebuilt.call(state_vals, vals)
+        import jax
+        return jax.tree_util.tree_map(Tensor, out)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        return self
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    return TranslatedLayer(payload)

@@ -1,0 +1,71 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py
+ClipGradByGlobalNorm etc.)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params):
+        for p in params:
+            if p.grad is not None:
+                p.grad._in_place_update(
+                    jnp.clip(p.grad._value, self.min, self.max))
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params):
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad._value
+            norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+            scale = jnp.where(norm > self.clip_norm,
+                              self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            p.grad._in_place_update((g.astype(jnp.float32) * scale).astype(g.dtype))
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip across the full parameter list (reference
+    nn/clip.py ClipGradByGlobalNorm; hybrid-parallel variant lives in
+    distributed.fleet HybridParallelClipGrad which allreduces the norm
+    across parallel axes first)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _global_norm(self, params):
+        sq = [jnp.sum(p.grad._value.astype(jnp.float32) ** 2)
+              for p in params if p.grad is not None and getattr(p, "need_clip", True)]
+        if not sq:
+            return None
+        return jnp.sqrt(jnp.sum(jnp.stack(sq)))
+
+    def __call__(self, params):
+        norm = self._global_norm(params)
+        if norm is None:
+            return
+        scale = self.clip_norm / jnp.maximum(norm, self.clip_norm)
+        for p in params:
+            if p.grad is not None and getattr(p, "need_clip", True):
+                g = p.grad._value
+                p.grad._in_place_update(
+                    (g.astype(jnp.float32) * scale).astype(g.dtype))

@@ -1,0 +1,251 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py
+→ phi activation kernels; on TPU XLA fuses these into neighbors)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import defop
+
+__all__ = [
+    "relu", "relu6", "relu_", "gelu", "sigmoid", "silu", "swish", "softmax",
+    "log_softmax", "leaky_relu", "elu", "selu", "celu", "hardtanh",
+    "hardsigmoid", "hardswish", "hardshrink", "softshrink", "tanhshrink",
+    "softplus", "softsign", "mish", "prelu", "glu", "log_sigmoid",
+    "gumbel_softmax", "maxout", "tanh", "thresholded_relu",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _simple(name, fn):
+    op = defop(name)(fn)
+
+    def wrapper(x, name=None):
+        return op(_t(x))
+    wrapper.__name__ = name
+    return wrapper
+
+
+relu = _simple("relu", jax.nn.relu)
+relu6 = _simple("relu6", jax.nn.relu6)
+sigmoid = _simple("sigmoid_fn", jax.nn.sigmoid)
+silu = _simple("silu", jax.nn.silu)
+softsign = _simple("softsign", jax.nn.soft_sign)
+tanhshrink = _simple("tanhshrink", lambda x: x - jnp.tanh(x))
+log_sigmoid = _simple("log_sigmoid", jax.nn.log_sigmoid)
+tanh = _simple("tanh_fn", jnp.tanh)
+mish = _simple("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+@defop("gelu")
+def _gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return _gelu(_t(x), approximate=approximate)
+
+
+@defop("swish")
+def _swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def swish(x, name=None):
+    return _swish(_t(x))
+
+
+@defop("softmax")
+def _softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        from ...ops.manipulation import cast
+        x = cast(x, dtype)
+    return _softmax(x, axis=axis)
+
+
+@defop("log_softmax")
+def _log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        from ...ops.manipulation import cast
+        x = cast(x, dtype)
+    return _log_softmax(x, axis=axis)
+
+
+@defop("leaky_relu")
+def _leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _leaky_relu(_t(x), negative_slope=negative_slope)
+
+
+@defop("elu")
+def _elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _elu(_t(x), alpha=alpha)
+
+
+@defop("selu")
+def _selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _selu(_t(x), scale=scale, alpha=alpha)
+
+
+@defop("celu")
+def _celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return _celu(_t(x), alpha=alpha)
+
+
+@defop("hardtanh")
+def _hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _hardtanh(_t(x), min=min, max=max)
+
+
+@defop("hardsigmoid")
+def _hardsigmoid(x, slope=1.0 / 6, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return _hardsigmoid(_t(x), slope=slope, offset=offset)
+
+
+@defop("hardswish")
+def _hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def hardswish(x, name=None):
+    return _hardswish(_t(x))
+
+
+@defop("hardshrink")
+def _hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _hardshrink(_t(x), threshold=threshold)
+
+
+@defop("softshrink")
+def _softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _softshrink(_t(x), threshold=threshold)
+
+
+@defop("softplus")
+def _softplus(x, beta=1.0, threshold=20.0):
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jax.nn.softplus(bx) / beta)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _softplus(_t(x), beta=beta, threshold=threshold)
+
+
+@defop("thresholded_relu")
+def _thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return _thresholded_relu(_t(x), threshold=threshold, value=value)
+
+
+@defop("prelu")
+def _prelu(x, weight, data_format="NCHW"):
+    if weight.size == 1:
+        w = weight.reshape(())
+    else:
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+        shape[ch_axis] = weight.size
+        w = weight.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return _prelu(_t(x), _t(weight), data_format=data_format)
+
+
+@defop("glu")
+def _glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def glu(x, axis=-1, name=None):
+    return _glu(_t(x), axis=axis)
+
+
+@defop("maxout")
+def _maxout(x, groups, axis=1):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _maxout(_t(x), groups=groups, axis=axis)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...ops.random import next_key
+    x = _t(x)
+    g = jax.random.gumbel(next_key(), tuple(x.shape), x._value.dtype)
+
+    @defop("gumbel_softmax")
+    def _gs(x, g, temperature, hard, axis):
+        y = jax.nn.softmax((x + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return _gs(x, Tensor(g), temperature=temperature, hard=hard, axis=axis)

@@ -1,0 +1,107 @@
+"""Attention functionals (reference: python/paddle/nn/functional/
+flash_attention.py:142 flash_attention, :440 scaled_dot_product_attention —
+wrapping the flashattn CUDA lib).
+
+TPU-native: the hot path is a Pallas flash-attention kernel
+(paddle_tpu/kernels/flash_attention.py) with online softmax tiling sized to
+VMEM; this module provides the public API and a pure-XLA fallback that
+XLA still fuses well at moderate sequence lengths."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import flags
+from ...core.tensor import Tensor
+from ...core.dispatch import defop
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _use_pallas() -> bool:
+    return (flags.flag("use_pallas_kernels")
+            and jax.default_backend() == "tpu")
+
+
+def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
+    """Reference attention in pure XLA ops. Layout: [B, S, H, D] (paddle
+    flash_attention layout)."""
+    qh = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * s
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(cmask, scores, jnp.asarray(-jnp.inf, scores.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, jnp.asarray(-jnp.inf, scores.dtype))
+        else:
+            scores = scores + mask
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)  # [B, S, H, D]
+
+
+@defop("scaled_dot_product_attention")
+def _sdpa(q, k, v, mask=None, dropout_p=0.0, causal=False):
+    if _use_pallas() and mask is None:
+        from ...kernels.flash_attention import flash_attention_fwd
+        return flash_attention_fwd(q, k, v, causal=causal)
+    return _sdpa_ref(q, k, v, mask=mask, causal=causal)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Layout [batch, seq, num_heads, head_dim] (reference :440)."""
+    if attn_mask is not None:
+        return _sdpa(_t(query), _t(key), _t(value), _t(attn_mask),
+                     dropout_p=dropout_p, causal=is_causal)
+    return _sdpa(_t(query), _t(key), _t(value), dropout_p=dropout_p,
+                 causal=is_causal)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """reference nn/functional/flash_attention.py:142 — returns (out, softmax)."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    raise NotImplementedError(
+        "varlen flash attention: use dense flash_attention with padding mask")
+
+
+class sdp_kernel:
+    """Context selecting attention backends (torch-compat shim the reference
+    also exposes)."""
+
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True):
+        self.enable_flash = enable_flash
+
+    def __enter__(self):
+        self._prev = flags.flag("use_pallas_kernels")
+        flags.set_flags({"use_pallas_kernels": self.enable_flash})
+        return self
+
+    def __exit__(self, *exc):
+        flags.set_flags({"use_pallas_kernels": self._prev})
+        return False

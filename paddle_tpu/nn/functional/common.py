@@ -1,0 +1,368 @@
+"""Common functionals: linear/embedding/dropout/pad/interpolate/one_hot...
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import defop
+from ...ops.random import next_key
+
+__all__ = ["linear", "embedding", "one_hot", "dropout", "dropout2d",
+           "dropout3d", "alpha_dropout", "pad", "interpolate", "upsample",
+           "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
+           "label_smooth", "bilinear", "unfold", "fold", "affine_grid",
+           "grid_sample", "npair_loss", "zeropad2d"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+@defop("linear")
+def _linear(x, weight, bias=None):
+    # paddle Linear weight layout: [in_features, out_features]
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def linear(x, weight, bias=None, name=None):
+    if bias is not None:
+        return _linear(_t(x), _t(weight), _t(bias))
+    return _linear(_t(x), _t(weight))
+
+
+@defop("embedding_lookup")
+def _embedding(ids, weight, padding_idx=None):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    ids = _t(x)
+    # ids are data (non-diff): pass raw so vjp only tracks weight
+    return _embedding(ids._value.astype(jnp.int32), _t(weight),
+                      padding_idx=padding_idx)
+
+
+def one_hot(x, num_classes, name=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.nn.one_hot(v.astype(jnp.int32), num_classes))
+
+
+@defop("dropout_apply")
+def _dropout_apply(x, mask, scale):
+    return x * mask * scale
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """reference: nn/functional/common.py dropout; RNG = JAX counter-based
+    key split per call (reference curand per-op seeds)."""
+    x = _t(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return _dropout_apply(x, jnp.ones((), x._value.dtype), 1.0 - p)
+        return x
+    if p == 1.0:
+        from ...ops.creation import zeros_like
+        return zeros_like(x) * x  # keep graph connectivity
+    shape = list(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(shape))
+    mask = keep.astype(x._value.dtype)
+    scale = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
+    return _dropout_apply(x, mask, scale)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(x.shape))
+    a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+
+    @defop("alpha_dropout")
+    def _ad(x, keep, a, b, alpha_p):
+        return a * jnp.where(keep, x, alpha_p) + b
+    return _ad(x, Tensor(keep), a=a, b=b, alpha_p=alpha_p)
+
+
+@defop("pad_op")
+def _pad(x, pad_cfg, mode="constant", value=0.0):
+    if mode == "constant":
+        return jnp.pad(x, pad_cfg, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, pad_cfg, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    x = _t(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = list(int(p) for p in pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle layout: pad covers trailing spatial dims, reversed pairs
+        # e.g. NCHW with pad=[l,r,t,b] -> W:(l,r), H:(t,b)
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial_dims = list(range(2, 2 + n_spatial))
+        else:
+            spatial_dims = list(range(1, 1 + n_spatial))
+        for i, d in enumerate(reversed(spatial_dims)):
+            cfg[d] = (pad[2 * i], pad[2 * i + 1])
+    return _pad(x, pad_cfg=tuple(cfg), mode=mode, value=value)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+@defop("interpolate_op")
+def _interpolate(x, size, mode, align_corners, n):
+    # channel-first: resize spatial dims
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    out_shape = x.shape[:2] + tuple(size)
+    if not align_corners or method == "nearest":
+        return jax.image.resize(x, out_shape, method=method)
+    # align_corners: build index grid explicitly
+    slices = []
+    src_spatial = x.shape[2:]
+    out = x
+    for i, (s_in, s_out) in enumerate(zip(src_spatial, size)):
+        if s_out == 1:
+            idx = jnp.zeros((1,), jnp.float32)
+        else:
+            idx = jnp.linspace(0.0, s_in - 1, s_out)
+        i0 = jnp.floor(idx).astype(jnp.int32)
+        i1 = jnp.minimum(i0 + 1, s_in - 1)
+        w = (idx - i0).astype(x.dtype)
+        axis = 2 + i
+        g0 = jnp.take(out, i0, axis=axis)
+        g1 = jnp.take(out, i1, axis=axis)
+        bshape = [1] * g0.ndim
+        bshape[axis] = s_out
+        w = w.reshape(bshape)
+        out = g0 * (1 - w) + g1 * w
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = _t(x)
+    n = x.ndim - 2
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * n
+        size = [int(s * f) for s, f in zip(x.shape[2:], scale_factor)]
+    if isinstance(size, Tensor):
+        size = size.tolist()
+    size = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in size]
+    return _interpolate(x, size=tuple(size), mode=mode,
+                        align_corners=align_corners, n=n)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+@defop("cosine_similarity")
+def _cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return _cosine_similarity(_t(x1), _t(x2), axis=axis, eps=eps)
+
+
+@defop("pixel_shuffle")
+def _pixel_shuffle(x, upscale_factor):
+    N, C, H, W = x.shape
+    r = upscale_factor
+    x = x.reshape(N, C // (r * r), r, r, H, W)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(N, C // (r * r), H * r, W * r)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _pixel_shuffle(_t(x), upscale_factor=upscale_factor)
+
+
+@defop("pixel_unshuffle")
+def _pixel_unshuffle(x, downscale_factor):
+    N, C, H, W = x.shape
+    r = downscale_factor
+    x = x.reshape(N, C, H // r, r, W // r, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return x.reshape(N, C * r * r, H // r, W // r)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _pixel_unshuffle(_t(x), downscale_factor=downscale_factor)
+
+
+@defop("label_smooth")
+def _label_smooth(label, epsilon, prior=None):
+    k = label.shape[-1]
+    if prior is None:
+        return (1 - epsilon) * label + epsilon / k
+    return (1 - epsilon) * label + epsilon * prior
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        return _label_smooth(_t(label), epsilon=epsilon,
+                             prior=prior_dist._value if isinstance(prior_dist, Tensor) else prior_dist)
+    return _label_smooth(_t(label), epsilon=epsilon)
+
+
+@defop("bilinear")
+def _bilinear(x1, x2, weight, bias=None):
+    # weight: [out, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    if bias is not None:
+        return _bilinear(_t(x1), _t(x2), _t(weight), _t(bias))
+    return _bilinear(_t(x1), _t(x2), _t(weight))
+
+
+@defop("unfold")
+def _unfold(x, kernel_sizes, strides, paddings, dilations):
+    N, C, H, W = x.shape
+    kh, kw = kernel_sizes
+    x = jnp.pad(x, [(0, 0), (0, 0), (paddings[0], paddings[1]),
+                    (paddings[2], paddings[3])])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=tuple(strides),
+        padding="VALID", rhs_dilation=tuple(dilations),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ckk, oh, ow = patches.shape
+    return patches.reshape(n, ckk, oh * ow)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _norm(v, n=2):
+        return [v] * n if isinstance(v, int) else list(v)
+    ks = _norm(kernel_sizes)
+    st = _norm(strides)
+    dl = _norm(dilations)
+    pd = _norm(paddings, 4) if not isinstance(paddings, int) else [paddings] * 4
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+    return _unfold(_t(x), kernel_sizes=tuple(ks), strides=tuple(st),
+                   paddings=tuple(pd), dilations=tuple(dl))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    raise NotImplementedError("fold: planned (inverse of unfold)")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    theta = _t(theta)
+    N, C, H, W = [int(s) for s in (out_shape.tolist() if isinstance(out_shape, Tensor) else out_shape)]
+
+    @defop("affine_grid")
+    def _ag(theta, H, W, align_corners):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) + 0.5) / H * 2 - 1
+            xs = (jnp.arange(W) + 0.5) / W * 2 - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+        return jnp.einsum("hwk,njk->nhwj", base, theta)
+    return _ag(theta, H=H, W=W, align_corners=align_corners)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    x, grid = _t(x), _t(grid)
+
+    @defop("grid_sample")
+    def _gs(x, grid, align_corners):
+        N, C, H, W = x.shape
+        gx = (grid[..., 0] + 1) * (W - 1) / 2 if align_corners else \
+            ((grid[..., 0] + 1) * W - 1) / 2
+        gy = (grid[..., 1] + 1) * (H - 1) / 2 if align_corners else \
+            ((grid[..., 1] + 1) * H - 1) / 2
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = gx - x0
+        wy = gy - y0
+
+        def gather(yy, xx):
+            yy = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xx = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            flat = x.reshape(N, C, H * W)
+            idx = (yy * W + xx).reshape(N, 1, -1)
+            out = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (N, C, idx.shape[-1])), axis=2)
+            return out.reshape(N, C, *gx.shape[1:])
+        v00 = gather(y0, x0)
+        v01 = gather(y0, x0 + 1)
+        v10 = gather(y0 + 1, x0)
+        v11 = gather(y0 + 1, x0 + 1)
+        wx = wx[:, None]
+        wy = wy[:, None]
+        return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+                + v10 * (1 - wx) * wy + v11 * wx * wy)
+    return _gs(x, grid, align_corners=align_corners)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    from . import loss as L
+    anchor, positive = _t(anchor), _t(positive)
+
+    @defop("npair_loss")
+    def _np(anchor, positive, labels, l2_reg):
+        reg = l2_reg * (jnp.sum(anchor * anchor) + jnp.sum(positive * positive)) \
+            / anchor.shape[0] * 0.25
+        sim = anchor @ positive.T
+        lab = labels.reshape(-1, 1) == labels.reshape(1, -1)
+        lab = lab.astype(sim.dtype)
+        lab = lab / jnp.sum(lab, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(lab * logp, axis=1))
+        return ce + reg
+    return _np(anchor, positive, _t(labels), l2_reg=l2_reg)

@@ -1,0 +1,181 @@
+"""Convolution functionals (reference: python/paddle/nn/functional/conv.py →
+phi conv kernels/cuDNN).
+
+TPU-native: a single lowering to lax.conv_general_dilated — XLA tiles convs
+onto the MXU directly (no im2col, no algo autotuning like cuDNN)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import defop
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(e) for e in v)
+
+
+def _norm_padding(padding, n):
+    """paddle padding: int | list[int] | list[pair] | 'SAME' | 'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+@defop("conv")
+def _conv(x, weight, bias=None, stride=(1, 1), padding="VALID",
+          dilation=(1, 1), groups=1, n=2, channel_last=False):
+    lhs_spec, rhs_spec, out_spec = _dim_numbers(n, channel_last)
+    # paddle weight layout is always OIHW-style [out_c, in_c/groups, *k]
+    if channel_last:
+        # transpose weight to spec
+        perm = tuple(range(2, 2 + n)) + (1, 0)
+        w = jnp.transpose(weight, perm)
+    else:
+        w = weight
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=(lhs_spec, rhs_spec if channel_last else rhs_spec, out_spec))
+    if bias is not None:
+        if channel_last:
+            out = out + bias.reshape((1,) * (n + 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    args = dict(stride=_norm_tuple(stride, n),
+                padding=_norm_padding(padding, n),
+                dilation=_norm_tuple(dilation, n), groups=groups, n=n,
+                channel_last=channel_last)
+    if bias is not None:
+        return _conv(_t(x), _t(weight), _t(bias), **args)
+    return _conv(_t(x), _t(weight), **args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format)
+
+
+def _conv_transpose_impl(x, weight, stride, padding, output_padding,
+                         dilation, groups, n):
+    """Fractionally-strided conv in channel-first layout. paddle
+    transpose-conv weight layout is [in_c, out_c/groups, *k] (IOHW)."""
+    if groups != 1:
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(weight, groups, axis=0)
+        outs = [_conv_transpose_impl(xi, wi, stride, padding, output_padding,
+                                     dilation, 1, n)
+                for xi, wi in zip(xs, ws)]
+        return jnp.concatenate(outs, axis=1)
+    k_spatial = weight.shape[2:]
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    pad_cfg = []
+    for (lo, hi), k, d, op_ in zip(padding, k_spatial, dilation, output_padding):
+        eff_k = (k - 1) * d + 1
+        pad_cfg.append((eff_k - 1 - lo, eff_k - 1 - hi + op_))
+    w_flip = jnp.flip(weight, axis=tuple(range(2, 2 + n)))  # [I, O, *k]
+    w_oihw = jnp.swapaxes(w_flip, 0, 1)                     # [O, I, *k]
+    return jax.lax.conv_general_dilated(
+        x, w_oihw, window_strides=(1,) * n, padding=pad_cfg,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=_dim_numbers(n, False))
+
+
+@defop("conv_transpose")
+def _conv_transpose(x, weight, bias=None, stride=(1, 1), padding="VALID",
+                    output_padding=(0, 0), dilation=(1, 1), groups=1, n=2,
+                    channel_last=False):
+    out = _conv_transpose_impl(x, weight, stride, padding, output_padding,
+                               dilation, groups, n)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCHW", output_size=None, name=None):
+    n = 2
+    channel_last = data_format == "NHWC"
+    if channel_last:
+        from ...ops.manipulation import transpose as _tr
+        x = _tr(_t(x), [0, 3, 1, 2])
+    out = _conv_transpose(
+        _t(x), _t(weight), _t(bias) if bias is not None else None,
+        stride=_norm_tuple(stride, n), padding=_norm_padding(padding, n),
+        output_padding=_norm_tuple(output_padding, n),
+        dilation=_norm_tuple(dilation, n), groups=groups, n=n,
+        channel_last=False)
+    if channel_last:
+        from ...ops.manipulation import transpose as _tr
+        out = _tr(out, [0, 2, 3, 1])
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", name=None):
+    n = 1
+    return _conv_transpose(
+        _t(x), _t(weight), _t(bias) if bias is not None else None,
+        stride=_norm_tuple(stride, n), padding=_norm_padding(padding, n),
+        output_padding=_norm_tuple(output_padding, n),
+        dilation=_norm_tuple(dilation, n), groups=groups, n=n,
+        channel_last=False)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", name=None):
+    n = 3
+    return _conv_transpose(
+        _t(x), _t(weight), _t(bias) if bias is not None else None,
+        stride=_norm_tuple(stride, n), padding=_norm_padding(padding, n),
+        output_padding=_norm_tuple(output_padding, n),
+        dilation=_norm_tuple(dilation, n), groups=groups, n=n,
+        channel_last=False)

@@ -1,0 +1,425 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py →
+phi cross_entropy/softmax_with_cross_entropy kernels)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import defop
+
+__all__ = ["cross_entropy", "softmax_with_cross_entropy", "mse_loss",
+           "l1_loss", "nll_loss", "binary_cross_entropy",
+           "binary_cross_entropy_with_logits", "smooth_l1_loss", "kl_div",
+           "margin_ranking_loss", "hinge_embedding_loss", "cosine_embedding_loss",
+           "triplet_margin_loss", "huber_loss", "log_loss", "square_error_cost",
+           "sigmoid_focal_loss", "dice_loss", "ctc_loss", "poisson_nll_loss",
+           "multi_label_soft_margin_loss", "soft_margin_loss",
+           "gaussian_nll_loss"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+@defop("cross_entropy")
+def _cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                   reduction="mean", axis=-1, use_softmax=True,
+                   label_smoothing=0.0, weight=None):
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(logits, 1e-30))
+    if soft_label:
+        target = label
+        if label_smoothing > 0:
+            k = logits.shape[axis]
+            target = (1 - label_smoothing) * target + label_smoothing / k
+        out = -jnp.sum(target * logp, axis=axis)
+        if weight is not None:
+            # class weights don't apply cleanly to soft labels; skip
+            pass
+        return _reduce(out, reduction)
+    ids = label.astype(jnp.int32)
+    if ids.ndim == logits.ndim:
+        ids = jnp.squeeze(ids, axis)
+    valid = (ids != ignore_index)
+    safe_ids = jnp.where(valid, ids, 0)
+    picked = jnp.take_along_axis(
+        jnp.moveaxis(logp, axis, -1), safe_ids[..., None], axis=-1)[..., 0]
+    if label_smoothing > 0:
+        k = logits.shape[axis]
+        smooth = jnp.mean(logp, axis=axis)
+        picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+    out = -picked
+    if weight is not None:
+        w = jnp.take(weight, safe_ids, axis=0)
+        out = out * w
+        out = jnp.where(valid, out, 0.0)
+        if reduction == "mean":
+            return jnp.sum(out) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    out = jnp.where(valid, out, 0.0)
+    if reduction == "mean":
+        return jnp.sum(out) / jnp.maximum(jnp.sum(valid.astype(out.dtype)), 1.0)
+    return _reduce(out, reduction)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    logits = _t(input)
+    if soft_label:
+        return _cross_entropy(logits, _t(label), soft_label=True,
+                              ignore_index=ignore_index, reduction=reduction,
+                              axis=axis, use_softmax=use_softmax,
+                              label_smoothing=label_smoothing,
+                              weight=_t(weight) if weight is not None else None)
+    lbl = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+    return _cross_entropy(logits, lbl, soft_label=False,
+                          ignore_index=ignore_index, reduction=reduction,
+                          axis=axis, use_softmax=use_softmax,
+                          label_smoothing=label_smoothing,
+                          weight=_t(weight) if weight is not None else None)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, [axis])
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+@defop("mse_loss")
+def _mse(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _mse(_t(input), _t(label), reduction=reduction)
+
+
+def square_error_cost(input, label):
+    return _mse(_t(input), _t(label), reduction="none")
+
+
+@defop("l1_loss")
+def _l1(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _l1(_t(input), _t(label), reduction=reduction)
+
+
+@defop("nll_loss")
+def _nll(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    ids = label.astype(jnp.int32)
+    valid = ids != ignore_index
+    safe = jnp.where(valid, ids, 0)
+    picked = jnp.take_along_axis(input, safe[..., None] if input.ndim == ids.ndim + 1
+                                 else safe, axis=1 if input.ndim > 1 else 0)
+    if picked.ndim > ids.ndim:
+        picked = picked[..., 0] if input.ndim == 2 else jnp.squeeze(picked, 1)
+    out = -picked
+    if weight is not None:
+        w = jnp.take(weight, safe, axis=0)
+        out = out * w
+        out = jnp.where(valid, out, 0.0)
+        if reduction == "mean":
+            return jnp.sum(out) / jnp.maximum(jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    out = jnp.where(valid, out, 0.0)
+    if reduction == "mean":
+        return jnp.sum(out) / jnp.maximum(jnp.sum(valid.astype(out.dtype)), 1.0)
+    return _reduce(out, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    lbl = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+    return _nll(_t(input), lbl,
+                weight=_t(weight) if weight is not None else None,
+                ignore_index=ignore_index, reduction=reduction)
+
+
+@defop("bce_loss")
+def _bce(input, label, weight=None, reduction="mean"):
+    x = jnp.clip(input, 1e-12, 1.0 - 1e-7)
+    out = -(label * jnp.log(x) + (1 - label) * jnp.log1p(-x))
+    if weight is not None:
+        out = out * weight
+    return _reduce(out, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    return _bce(_t(input), _t(label),
+                weight=_t(weight) if weight is not None else None,
+                reduction=reduction)
+
+
+@defop("bce_with_logits")
+def _bce_logits(logit, label, weight=None, pos_weight=None, reduction="mean"):
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        out = (1 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        out = (1 - label) * logit + max_val + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if weight is not None:
+        out = out * weight
+    return _reduce(out, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    return _bce_logits(_t(logit), _t(label),
+                       weight=_t(weight) if weight is not None else None,
+                       pos_weight=_t(pos_weight) if pos_weight is not None else None,
+                       reduction=reduction)
+
+
+@defop("smooth_l1_loss")
+def _smooth_l1(input, label, delta=1.0, reduction="mean"):
+    diff = jnp.abs(input - label)
+    out = jnp.where(diff < delta, 0.5 * diff * diff / delta, diff - 0.5 * delta)
+    return _reduce(out, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _smooth_l1(_t(input), _t(label), delta=delta, reduction=reduction)
+
+
+@defop("huber_loss")
+def _huber(input, label, delta=1.0, reduction="mean"):
+    diff = jnp.abs(input - label)
+    out = jnp.where(diff <= delta, 0.5 * diff * diff,
+                    delta * (diff - 0.5 * delta))
+    return _reduce(out, reduction)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    return _huber(_t(input), _t(label), delta=delta, reduction=reduction)
+
+
+@defop("kl_div")
+def _kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        out = jnp.exp(label) * (label - input)
+    else:
+        out = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(out) / input.shape[0]
+    return _reduce(out, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    return _kl_div(_t(input), _t(label), reduction=reduction,
+                   log_target=log_target)
+
+
+@defop("margin_ranking_loss")
+def _margin_ranking(input, other, label, margin=0.0, reduction="mean"):
+    out = jnp.maximum(-label * (input - other) + margin, 0.0)
+    return _reduce(out, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return _margin_ranking(_t(input), _t(other), _t(label), margin=margin,
+                           reduction=reduction)
+
+
+@defop("hinge_embedding_loss")
+def _hinge_embedding(input, label, margin=1.0, reduction="mean"):
+    out = jnp.where(label == 1.0, input, jnp.maximum(margin - input, 0.0))
+    return _reduce(out, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return _hinge_embedding(_t(input), _t(label), margin=margin,
+                            reduction=reduction)
+
+
+@defop("cosine_embedding_loss")
+def _cosine_embedding(input1, input2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1), 1e-12)
+    out = jnp.where(label == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+    return _reduce(out, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    return _cosine_embedding(_t(input1), _t(input2), _t(label), margin=margin,
+                             reduction=reduction)
+
+
+@defop("triplet_margin_loss")
+def _triplet(anchor, positive, negative, margin=1.0, p=2.0, eps=1e-6,
+             swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + eps, p), axis=-1),
+                         1.0 / p)
+    d_pos = dist(anchor, positive)
+    d_neg = dist(anchor, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    out = jnp.maximum(d_pos - d_neg + margin, 0.0)
+    return _reduce(out, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    return _triplet(_t(input), _t(positive), _t(negative), margin=margin,
+                    p=p, eps=epsilon, swap=swap, reduction=reduction)
+
+
+@defop("log_loss")
+def _log_loss(input, label, epsilon=1e-4):
+    return -label * jnp.log(input + epsilon) \
+        - (1 - label) * jnp.log(1 - input + epsilon)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _log_loss(_t(input), _t(label), epsilon=epsilon)
+
+
+@defop("sigmoid_focal_loss")
+def _focal(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+           reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) \
+        + jnp.clip(-logit, 0, None)
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    out = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        out = out / normalizer
+    return _reduce(out, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    return _focal(_t(logit), _t(label),
+                  normalizer=_t(normalizer) if normalizer is not None else None,
+                  alpha=alpha, gamma=gamma, reduction=reduction)
+
+
+@defop("dice_loss")
+def _dice(input, label, epsilon=1e-5):
+    label_oh = jax.nn.one_hot(label[..., 0].astype(jnp.int32), input.shape[-1],
+                              dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inse = jnp.sum(input * label_oh, axis=reduce_dims)
+    dice_denom = jnp.sum(input, axis=reduce_dims) + jnp.sum(label_oh, axis=reduce_dims)
+    return jnp.mean(1 - 2 * inse / (dice_denom + epsilon))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    lbl = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+    return _dice(_t(input), lbl, epsilon=epsilon)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax's implementation pattern (forward algorithm in log space)."""
+    import optax
+    lp = _t(log_probs)  # [T, B, C] paddle layout
+
+    @defop("ctc_loss")
+    def _ctc(logits, labels, input_lengths, label_lengths, blank, reduction):
+        # optax expects [B, T, C] logits and [B, N] labels with 0 = pad
+        logits_btc = jnp.swapaxes(logits, 0, 1)
+        B, T, C = logits_btc.shape
+        labels = labels.astype(jnp.int32)
+        N = labels.shape[1]
+        logit_pad = (jnp.arange(T)[None, :] >= input_lengths[:, None]).astype(jnp.float32)
+        label_pad = (jnp.arange(N)[None, :] >= label_lengths[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits_btc, logit_pad, labels, label_pad,
+                                 blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(per_seq / jnp.maximum(label_lengths, 1))
+        if reduction == "sum":
+            return jnp.sum(per_seq)
+        return per_seq
+    lab = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+    il = input_lengths._value if isinstance(input_lengths, Tensor) else jnp.asarray(input_lengths)
+    ll = label_lengths._value if isinstance(label_lengths, Tensor) else jnp.asarray(label_lengths)
+    return _ctc(lp, lab, il, ll, blank=blank, reduction=reduction)
+
+
+@defop("poisson_nll_loss")
+def _poisson_nll(input, label, log_input=True, full=False, eps=1e-8,
+                 reduction="mean"):
+    if log_input:
+        out = jnp.exp(input) - label * input
+    else:
+        out = input - label * jnp.log(input + eps)
+    if full:
+        stirling = label * jnp.log(label + eps) - label \
+            + 0.5 * jnp.log(2 * jnp.pi * (label + eps))
+        out = out + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(out, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    return _poisson_nll(_t(input), _t(label), log_input=log_input, full=full,
+                        eps=epsilon, reduction=reduction)
+
+
+@defop("soft_margin_loss")
+def _soft_margin(input, label, reduction="mean"):
+    return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return _soft_margin(_t(input), _t(label), reduction=reduction)
+
+
+@defop("multi_label_soft_margin_loss")
+def _ml_soft_margin(input, label, weight=None, reduction="mean"):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    return _ml_soft_margin(_t(input), _t(label),
+                           weight=_t(weight) if weight is not None else None,
+                           reduction=reduction)
+
+
+@defop("gaussian_nll_loss")
+def _gaussian_nll(input, label, variance, full=False, epsilon=1e-6,
+                  reduction="mean"):
+    var = jnp.maximum(variance, epsilon)
+    out = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        out = out + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, input.dtype))
+    return _reduce(out, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    return _gaussian_nll(_t(input), _t(label), _t(variance), full=full,
+                         epsilon=epsilon, reduction=reduction)

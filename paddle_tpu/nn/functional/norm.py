@@ -1,0 +1,185 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py
+→ phi batch_norm/layer_norm kernels; fused on TPU by XLA)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import defop
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "normalize", "rms_norm"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+@defop("batch_norm_infer")
+def _bn_infer(x, mean, var, weight, bias, epsilon, axis):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    out = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@defop("batch_norm_train")
+def _bn_train(x, weight, bias, epsilon, axis):
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    mean = jnp.mean(x, axis=reduce_axes)
+    var = jnp.var(x, axis=reduce_axes)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    out = (x - mean.reshape(shape)) * inv
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    x = _t(x)
+    axis = 1 if data_format.startswith("NC") or x.ndim <= 2 else x.ndim - 1
+    if use_global_stats is None:
+        use_global_stats = not training
+    if use_global_stats:
+        return _bn_infer(x, _t(running_mean), _t(running_var),
+                         _t(weight) if weight is not None else None,
+                         _t(bias) if bias is not None else None,
+                         epsilon=epsilon, axis=axis)
+    out, mean, var = _bn_train(x, _t(weight) if weight is not None else None,
+                               _t(bias) if bias is not None else None,
+                               epsilon=epsilon, axis=axis)
+    # update running stats in place (eager side effect, like the reference
+    # kernel writing mean_out/variance_out)
+    if running_mean is not None:
+        n = x.size // x.shape[axis]
+        unbiased = var._value * (n / max(n - 1, 1))
+        running_mean._in_place_update(
+            momentum * running_mean._value + (1 - momentum) * mean._value)
+        running_var._in_place_update(
+            momentum * running_var._value + (1 - momentum) * unbiased)
+    return out
+
+
+@defop("layer_norm")
+def _layer_norm(x, weight, bias, epsilon, begin_norm_axis):
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    x = _t(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin = x.ndim - len(list(normalized_shape))
+    return _layer_norm(x, _t(weight) if weight is not None else None,
+                       _t(bias) if bias is not None else None,
+                       epsilon=epsilon, begin_norm_axis=begin)
+
+
+@defop("rms_norm")
+def _rms_norm(x, weight, epsilon):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    out = (x.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-06, name=None):
+    """RMSNorm (reference fused_rms_norm in incubate/nn/functional). Stats in
+    fp32 even under bf16 — matches the reference fused kernel."""
+    return _rms_norm(_t(x), _t(weight) if weight is not None else None,
+                     epsilon=epsilon)
+
+
+@defop("instance_norm")
+def _instance_norm(x, weight, bias, epsilon):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-05, data_format="NCHW", name=None):
+    return _instance_norm(_t(x), _t(weight) if weight is not None else None,
+                          _t(bias) if bias is not None else None, epsilon=eps)
+
+
+@defop("group_norm")
+def _group_norm(x, weight, bias, num_groups, epsilon):
+    N, C = x.shape[0], x.shape[1]
+    xg = x.reshape((N, num_groups, C // num_groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    return _group_norm(_t(x), _t(weight) if weight is not None else None,
+                       _t(bias) if bias is not None else None,
+                       num_groups=num_groups, epsilon=epsilon)
+
+
+@defop("local_response_norm")
+def _lrn(x, size, alpha, beta, k):
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[1]
+    pad_cfg = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    sq = jnp.pad(sq, pad_cfg)
+    window = (1, size) + (1,) * (x.ndim - 2)
+    s = jax.lax.reduce_window(sq, 0.0, jax.lax.add, window, (1,) * x.ndim, "VALID")
+    return x / jnp.power(k + alpha * s, beta)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return _lrn(_t(x), size=size, alpha=alpha, beta=beta, k=k)
+
+
+@defop("normalize")
+def _normalize(x, p, axis, epsilon):
+    norm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _normalize(_t(x), p=float(p), axis=axis, epsilon=epsilon)

@@ -1,0 +1,204 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py →
+phi pool kernels). TPU-native: lax.reduce_window."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import defop
+
+__all__ = ["max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d",
+           "avg_pool2d", "avg_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _norm(v, n):
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(e) for e in v)
+
+
+def _norm_pad(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, ceil_mode):
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        pad = [(0, 0), (0, 0)] + list(padding)
+        if ceil_mode:
+            # extend right pad so the last partial window is included
+            pad = [(0, 0), (0, 0)]
+            for i in range(n):
+                size = x.shape[2 + i]
+                lo, hi = padding[i]
+                out = (size + lo + hi - kernel[i] + stride[i] - 1) // stride[i] + 1
+                needed = (out - 1) * stride[i] + kernel[i] - size - lo
+                pad.append((lo, max(hi, needed)))
+    return jax.lax.reduce_window(x, init, reducer, window, strides, pad)
+
+
+@defop("max_pool")
+def _max_pool(x, kernel, stride, padding, n, ceil_mode=False):
+    if not isinstance(padding, str):
+        # pad with -inf so padded cells never win
+        cfg = [(0, 0), (0, 0)] + list(padding)
+        x = jax.lax.pad(x, jnp.asarray(-jnp.inf, x.dtype),
+                        [(lo, hi, 0) for lo, hi in cfg])
+        padding = [(0, 0)] * n
+    return _pool(x, kernel, stride, padding, n, jax.lax.max,
+                 -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                 else jnp.iinfo(x.dtype).min, ceil_mode)
+
+
+@defop("avg_pool")
+def _avg_pool(x, kernel, stride, padding, n, ceil_mode=False, exclusive=True):
+    if isinstance(padding, str):
+        summed = _pool(x, kernel, stride, padding, n, jax.lax.add, 0.0, False)
+        denom = 1
+        for k in kernel:
+            denom *= k
+        return summed / denom
+    summed = _pool(x, kernel, stride, padding, n, jax.lax.add, 0.0, ceil_mode)
+    if exclusive:
+        ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+        denom = _pool(ones, kernel, stride, padding, n, jax.lax.add, 0.0, ceil_mode)
+        return summed / denom
+    denom = 1
+    for k in kernel:
+        denom *= k
+    return summed / denom
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    k = _norm(kernel_size, 2)
+    s = _norm(stride, 2) or k
+    return _max_pool(_t(x), kernel=k, stride=s, padding=_norm_pad(padding, 2),
+                     n=2, ceil_mode=ceil_mode)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    k = _norm(kernel_size, 1)
+    s = _norm(stride, 1) or k
+    return _max_pool(_t(x), kernel=k, stride=s, padding=_norm_pad(padding, 1),
+                     n=1, ceil_mode=ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    k = _norm(kernel_size, 3)
+    s = _norm(stride, 3) or k
+    return _max_pool(_t(x), kernel=k, stride=s, padding=_norm_pad(padding, 3),
+                     n=3, ceil_mode=ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    k = _norm(kernel_size, 2)
+    s = _norm(stride, 2) or k
+    return _avg_pool(_t(x), kernel=k, stride=s, padding=_norm_pad(padding, 2),
+                     n=2, ceil_mode=ceil_mode, exclusive=exclusive)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    k = _norm(kernel_size, 1)
+    s = _norm(stride, 1) or k
+    return _avg_pool(_t(x), kernel=k, stride=s, padding=_norm_pad(padding, 1),
+                     n=1, ceil_mode=ceil_mode, exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    k = _norm(kernel_size, 3)
+    s = _norm(stride, 3) or k
+    return _avg_pool(_t(x), kernel=k, stride=s, padding=_norm_pad(padding, 3),
+                     n=3, ceil_mode=ceil_mode, exclusive=exclusive)
+
+
+@defop("adaptive_avg_pool")
+def _adaptive_avg_pool(x, output_size, n):
+    # output bins: mean over computed ranges; use reshape trick when divisible
+    spatial = x.shape[2:]
+    if all(s % o == 0 for s, o in zip(spatial, output_size)):
+        shape = list(x.shape[:2])
+        for s, o in zip(spatial, output_size):
+            shape += [o, s // o]
+        xr = x.reshape(shape)
+        axes = tuple(3 + 2 * i for i in range(n))
+        return xr.mean(axis=axes)
+    # general: per output cell slice mean (unrolled; output sizes are small)
+    out = jnp.zeros(x.shape[:2] + tuple(output_size), x.dtype)
+    from itertools import product
+    for idx in product(*[range(o) for o in output_size]):
+        sl = [slice(None), slice(None)]
+        for i, o in zip(idx, output_size):
+            s = spatial[len(sl) - 2]
+            start = (i * s) // o
+            end = -(-((i + 1) * s) // o)
+            sl.append(slice(start, end))
+        cell = x[tuple(sl)].mean(axis=tuple(range(2, 2 + n)))
+        out = out.at[(slice(None), slice(None)) + idx].set(cell)
+    return out
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_avg_pool(_t(x), output_size=_norm(output_size, 2), n=2)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_avg_pool(_t(x), output_size=_norm(output_size, 1), n=1)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_avg_pool(_t(x), output_size=_norm(output_size, 3), n=3)
+
+
+@defop("adaptive_max_pool")
+def _adaptive_max_pool(x, output_size, n):
+    spatial = x.shape[2:]
+    if all(s % o == 0 for s, o in zip(spatial, output_size)):
+        shape = list(x.shape[:2])
+        for s, o in zip(spatial, output_size):
+            shape += [o, s // o]
+        xr = x.reshape(shape)
+        axes = tuple(3 + 2 * i for i in range(n))
+        return xr.max(axis=axes)
+    raise NotImplementedError("adaptive_max_pool with non-divisible sizes")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool(_t(x), output_size=_norm(output_size, 2), n=2)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool(_t(x), output_size=_norm(output_size, 1), n=1)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool(_t(x), output_size=_norm(output_size, 3), n=3)

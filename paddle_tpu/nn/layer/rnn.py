@@ -1,0 +1,392 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the time loop is ONE ``lax.scan`` per layer/direction (compiles
+to a single fused XLA while-loop; the reference used cuDNN RNN descriptors).
+Gate matmuls are batched so the MXU sees [batch, 4*hidden] GEMMs."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import defop
+from ...core.tensor import Tensor
+from .layers import Layer
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell", "GRUCell",
+           "RNN", "BiRNN"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+# -- single-layer scans (pure jax) -----------------------------------------
+def _lstm_scan(x, h0, c0, wi, wh, bi, bh):
+    """x: [T, B, I]; returns (out [T, B, H], hT, cT). Gate order i,f,g,o
+    (reference lstm kernel gate order)."""
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt @ wi.T + h @ wh.T + bi + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    (hT, cT), out = jax.lax.scan(step, (h0, c0), x)
+    return out, hT, cT
+
+
+def _gru_scan(x, h0, wi, wh, bi, bh):
+    def step(h, xt):
+        gi = xt @ wi.T + bi
+        gh = h @ wh.T + bh
+        ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(in_ + r * hn)
+        h2 = (1 - z) * n + z * h
+        return h2, h2
+
+    hT, out = jax.lax.scan(step, h0, x)
+    return out, hT
+
+
+def _rnn_scan(x, h0, wi, wh, bi, bh, activation):
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def step(h, xt):
+        h2 = act(xt @ wi.T + h @ wh.T + bi + bh)
+        return h2, h2
+
+    hT, out = jax.lax.scan(step, h0, x)
+    return out, hT
+
+
+# -- cells -----------------------------------------------------------------
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        return Tensor(jnp.full((b, self.hidden_size), init_value,
+                               jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / hidden_size ** 0.5
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        @defop("rnn_cell")
+        def _cell(x, h, wi, wh, bi, bh, activation):
+            out, hT = _rnn_scan(x[None], h, wi, wh, bi, bh, activation)
+            return out[0]
+        h = _cell(_t(inputs), _t(states), self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, activation=self.activation)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / hidden_size ** 0.5
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        @defop("lstm_cell")
+        def _cell(x, h, c, wi, wh, bi, bh):
+            out, hT, cT = _lstm_scan(x[None], h, c, wi, wh, bi, bh)
+            return out[0], cT
+        h2, c2 = _cell(_t(inputs), _t(h), _t(c), self.weight_ih,
+                       self.weight_hh, self.bias_ih, self.bias_hh)
+        return h2, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / hidden_size ** 0.5
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        @defop("gru_cell")
+        def _cell(x, h, wi, wh, bi, bh):
+            out, hT = _gru_scan(x[None], h, wi, wh, bi, bh)
+            return out[0]
+        h = _cell(_t(inputs), _t(states), self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh)
+        return h, h
+
+
+# -- multi-layer stacked RNNs ---------------------------------------------
+class _RNNBase(Layer):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"LSTM": 4, "GRU": 3}.get(self.MODE[:4].rstrip("_"), 1)
+        if self.MODE.startswith("LSTM"):
+            gate_mult = 4
+        elif self.MODE.startswith("GRU"):
+            gate_mult = 3
+        else:
+            gate_mult = 1
+        std = 1.0 / hidden_size ** 0.5
+        init = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for direction_i in range(self.bidirect):
+                in_size = input_size if layer == 0 else hidden_size * self.bidirect
+                suffix = "_reverse" if direction_i else ""
+                wi = self.create_parameter([gate_mult * hidden_size, in_size],
+                                           weight_ih_attr,
+                                           default_initializer=init)
+                wh = self.create_parameter([gate_mult * hidden_size, hidden_size],
+                                           weight_hh_attr,
+                                           default_initializer=init)
+                bi = self.create_parameter([gate_mult * hidden_size],
+                                           bias_ih_attr, is_bias=True,
+                                           default_initializer=init)
+                bh = self.create_parameter([gate_mult * hidden_size],
+                                           bias_hh_attr, is_bias=True,
+                                           default_initializer=init)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", wi)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", wh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", bi)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", bh)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def _run_layer(self, x, weights, h0, c0, reverse):
+        """x, outputs: raw [T, B, ...] jax arrays within the defop."""
+        raise NotImplementedError
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = _t(inputs)
+        if not self.time_major:
+            from ...ops.manipulation import transpose
+            x = transpose(x, [1, 0, 2])
+        T, B = x.shape[0], x.shape[1]
+        n_states = self.num_layers * self.bidirect
+        is_lstm = self.MODE.startswith("LSTM")
+        if initial_states is None:
+            z = Tensor(jnp.zeros((n_states, B, self.hidden_size), x._value.dtype))
+            initial_states = (z, z) if is_lstm else z
+        outputs = x
+        final_h, final_c = [], []
+        for layer in range(self.num_layers):
+            layer_outs = []
+            for d in range(self.bidirect):
+                idx = layer * self.bidirect + d
+                wi, wh, bi, bh = self._all_weights[idx]
+                if is_lstm:
+                    h0 = initial_states[0][idx]
+                    c0 = initial_states[1][idx]
+                else:
+                    h0 = initial_states[idx]
+                    c0 = None
+                out, hT, cT = self._apply_direction(outputs, wi, wh, bi, bh,
+                                                    h0, c0, reverse=bool(d))
+                layer_outs.append(out)
+                final_h.append(hT)
+                if is_lstm:
+                    final_c.append(cT)
+            if self.bidirect == 2:
+                from ...ops.manipulation import concat
+                outputs = concat(layer_outs, axis=-1)
+            else:
+                outputs = layer_outs[0]
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                from ..functional import dropout as F_dropout
+                outputs = F_dropout(outputs, self.dropout,
+                                    training=self.training)
+        from ...ops.manipulation import stack, transpose
+        h_stack = stack(final_h, axis=0)
+        if not self.time_major:
+            outputs = transpose(outputs, [1, 0, 2])
+        if is_lstm:
+            c_stack = stack(final_c, axis=0)
+            return outputs, (h_stack, c_stack)
+        return outputs, h_stack
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def _apply_direction(self, x, wi, wh, bi, bh, h0, c0, reverse):
+        @defop("simple_rnn_layer")
+        def _run(x, wi, wh, bi, bh, h0, reverse, activation):
+            xs = jnp.flip(x, 0) if reverse else x
+            out, hT = _rnn_scan(xs, h0, wi, wh, bi, bh, activation)
+            if reverse:
+                out = jnp.flip(out, 0)
+            return out, hT
+        out, hT = _run(x, wi, wh, bi, bh, h0, reverse=reverse,
+                       activation=self.activation)
+        return out, hT, None
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+    def _apply_direction(self, x, wi, wh, bi, bh, h0, c0, reverse):
+        @defop("lstm_layer")
+        def _run(x, wi, wh, bi, bh, h0, c0, reverse):
+            xs = jnp.flip(x, 0) if reverse else x
+            out, hT, cT = _lstm_scan(xs, h0, c0, wi, wh, bi, bh)
+            if reverse:
+                out = jnp.flip(out, 0)
+            return out, hT, cT
+        return _run(x, wi, wh, bi, bh, h0, c0, reverse=reverse)
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+    def _apply_direction(self, x, wi, wh, bi, bh, h0, c0, reverse):
+        @defop("gru_layer")
+        def _run(x, wi, wh, bi, bh, h0, reverse):
+            xs = jnp.flip(x, 0) if reverse else x
+            out, hT = _gru_scan(xs, h0, wi, wh, bi, bh)
+            if reverse:
+                out = jnp.flip(out, 0)
+            return out, hT
+        out, hT = _run(x, wi, wh, bi, bh, h0, reverse=reverse)
+        return out, hT, None
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (reference nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        x = _t(inputs)
+        axis = 0 if self.time_major else 1
+        T = x.shape[axis]
+        states = initial_states
+        outs = []
+        idxs = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        from ...ops.manipulation import stack
+        for t in idxs:
+            xt = x[t] if self.time_major else x[:, t]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        sf = sb = None
+        if initial_states is not None:
+            sf, sb = initial_states
+        out_f, st_f = self.rnn_fw(inputs, sf)
+        out_b, st_b = self.rnn_bw(inputs, sb)
+        from ...ops.manipulation import concat
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)

@@ -1,0 +1,51 @@
+"""nn.utils (reference: python/paddle/nn/utils/clip_grad_norm_.py etc.)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad._value for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) ** (1.0 / norm_type)
+    clip_coef = jnp.clip(max_norm / (total + 1e-6), None, 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._in_place_update(p.grad._value * clip_coef)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._in_place_update(
+                jnp.clip(p.grad._value, -clip_value, clip_value))
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate([p._value.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = p.size
+        p._in_place_update(v[off:off + n].reshape(p._value.shape).astype(p._value.dtype))
+        off += n

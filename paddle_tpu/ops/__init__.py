@@ -1,0 +1,178 @@
+"""The op surface: single definition site for every public tensor op.
+
+This package is the analogue of the reference's YAML op registry +
+generated API (paddle/phi/api/yaml/ops.yaml → api_gen.py → paddle::
+experimental::* → tensor methods): every op is defined once over jax arrays,
+registered in OP_REGISTRY, exported as a module function, and installed as a
+Tensor method here (the reference monkey-patches tensor methods the same way
+— python/paddle/tensor/__init__.py tensor_method_func list)."""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from ..core.dispatch import OP_REGISTRY  # noqa: F401
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, reduction, linalg, logic, search, random  # noqa: F401
+
+_MODULES = [creation, math, manipulation, reduction, linalg, logic, search, random]
+
+
+def _collect():
+    ns = {}
+    for m in _MODULES:
+        for name in getattr(m, "__all__", []):
+            ns[name] = getattr(m, name)
+    return ns
+
+
+_NS = _collect()
+
+# ---------------------------------------------------------------------------
+# Tensor method installation
+# ---------------------------------------------------------------------------
+_METHOD_NAMES = [
+    # math
+    "exp", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square", "abs",
+    "sign", "neg", "reciprocal", "sin", "cos", "tan", "asin", "acos", "atan",
+    "sinh", "cosh", "tanh", "erf", "floor", "ceil", "round", "clip", "scale",
+    "add", "subtract", "multiply", "divide", "pow", "maximum", "minimum",
+    "mod", "remainder", "floor_divide", "logit", "lerp", "trunc", "frac",
+    "cumsum", "cumprod", "isnan", "isinf", "isfinite", "sigmoid", "expm1",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "nan_to_num",
+    # reduction
+    "sum", "mean", "max", "min", "prod", "amax", "amin", "all", "any",
+    "logsumexp", "std", "var", "median", "argmax", "argmin", "count_nonzero",
+    "nanmean", "nansum", "quantile", "kthvalue", "mode",
+    # manipulation
+    "reshape", "transpose", "flatten", "squeeze", "unsqueeze", "concat",
+    "split", "chunk", "cast", "gather", "gather_nd", "scatter",
+    "index_select", "index_sample", "tile", "expand", "expand_as",
+    "broadcast_to", "flip", "roll", "unbind", "take_along_axis",
+    "put_along_axis", "masked_select", "masked_fill", "repeat_interleave",
+    "moveaxis", "swapaxes", "t", "view", "view_as", "strided_slice",
+    "tolist", "rot90", "index_put", "where", "tensordot", "unstack",
+    # linalg
+    "matmul", "mm", "bmm", "dot", "inner", "outer", "mv", "cross", "norm",
+    "dist", "cholesky", "qr", "svd", "inv", "pinv", "solve", "matrix_power",
+    "det", "slogdet", "lu", "kron", "histogram", "bincount", "inverse",
+    "eigvals", "lstsq", "trace_mat",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "is_empty",
+    # search
+    "sort", "argsort", "topk", "unique", "unique_consecutive", "index_add",
+    "index_fill", "searchsorted", "bucketize", "nonzero",
+    # random inplace
+    "uniform_", "normal_", "exponential_",
+]
+
+
+def _install_tensor_methods():
+    for name in _METHOD_NAMES:
+        fn = _NS.get(name)
+        if fn is None:
+            continue
+        if hasattr(Tensor, name):
+            continue
+        setattr(Tensor, name, fn)
+
+    from .manipulation import _t as _as_tensor  # noqa
+
+    # astype: paddle method name for cast
+    Tensor.astype = lambda self, dtype: _NS["cast"](self, dtype)
+    Tensor.numel_t = _NS["numel"] if "numel" in _NS else None
+
+    # ---- arithmetic dunders ----
+    add, sub, mul, div = _NS["add"], _NS["subtract"], _NS["multiply"], _NS["divide"]
+    Tensor.__add__ = lambda self, o: add(self, o)
+    Tensor.__radd__ = lambda self, o: add(o, self)
+    Tensor.__sub__ = lambda self, o: sub(self, o)
+    Tensor.__rsub__ = lambda self, o: sub(o, self)
+    Tensor.__mul__ = lambda self, o: mul(self, o)
+    Tensor.__rmul__ = lambda self, o: mul(o, self)
+    Tensor.__truediv__ = lambda self, o: div(self, o)
+    Tensor.__rtruediv__ = lambda self, o: div(o, self)
+    Tensor.__floordiv__ = lambda self, o: _NS["floor_divide"](self, o)
+    Tensor.__rfloordiv__ = lambda self, o: _NS["floor_divide"](o, self)
+    Tensor.__mod__ = lambda self, o: _NS["mod"](self, o)
+    Tensor.__rmod__ = lambda self, o: _NS["mod"](o, self)
+    Tensor.__pow__ = lambda self, o: _NS["pow"](self, o)
+    Tensor.__rpow__ = lambda self, o: _NS["pow"](o, self)
+    Tensor.__matmul__ = lambda self, o: _NS["matmul"](self, o)
+    Tensor.__rmatmul__ = lambda self, o: _NS["matmul"](o, self)
+    Tensor.__neg__ = lambda self: _NS["neg"](self)
+    Tensor.__abs__ = lambda self: _NS["abs"](self)
+    Tensor.__invert__ = lambda self: _NS["logical_not"](self)
+
+    # ---- comparison dunders ----
+    Tensor.__eq__ = lambda self, o: _NS["equal"](self, o)
+    Tensor.__ne__ = lambda self, o: _NS["not_equal"](self, o)
+    Tensor.__lt__ = lambda self, o: _NS["less_than"](self, o)
+    Tensor.__le__ = lambda self, o: _NS["less_equal"](self, o)
+    Tensor.__gt__ = lambda self, o: _NS["greater_than"](self, o)
+    Tensor.__ge__ = lambda self, o: _NS["greater_equal"](self, o)
+
+    # ---- indexing ----
+    from ..core.dispatch import apply_op
+
+    def _getitem(self, idx):
+        def unwrap(i):
+            if isinstance(i, Tensor):
+                return i._value
+            if isinstance(i, tuple):
+                return tuple(unwrap(e) for e in i)
+            return i
+        idx = unwrap(idx)
+        return apply_op("getitem", lambda x: x[idx], (self,), {})
+
+    def _setitem(self, idx, value):
+        if not self.stop_gradient and self._grad_node is None:
+            raise RuntimeError(
+                "in-place __setitem__ on a leaf tensor that requires grad is "
+                "not allowed (reference inplace-on-leaf rule)")
+
+        def unwrap(i):
+            if isinstance(i, Tensor):
+                return i._value
+            if isinstance(i, tuple):
+                return tuple(unwrap(e) for e in i)
+            return i
+        jidx = unwrap(idx)
+        varg = value if isinstance(value, Tensor) else None
+        if varg is not None:
+            out = apply_op("setitem",
+                           lambda x, v: x.at[jidx].set(v.astype(x.dtype)),
+                           (self, varg), {})
+        else:
+            out = apply_op("setitem",
+                           lambda x: x.at[jidx].set(value),
+                           (self,), {})
+        # in-place semantics: adopt the new value and graph position
+        self._value = out._value
+        self._grad_node = out._grad_node
+        self._out_index = out._out_index
+        self.stop_gradient = out.stop_gradient
+
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+
+    # iteration over first axis
+    def _iter(self):
+        for i in range(self.shape[0] if self.ndim else 0):
+            yield _getitem(self, i)
+    Tensor.__iter__ = _iter
+
+
+_install_tensor_methods()
+
+__all__ = sorted(_NS)

@@ -1,0 +1,184 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py surface,
+kernels in paddle/phi/kernels/*full*, *arange* etc.)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, to_tensor
+from ..core.dispatch import defop
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "diag", "diagflat", "tril", "triu", "meshgrid", "assign",
+    "clone", "tril_indices", "triu_indices", "complex", "polar",
+]
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else get_default_dtype()
+    return convert_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(tuple(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(tuple(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._value
+    if dtype is None:
+        arr = jnp.full(tuple(shape), fill_value)
+        if arr.dtype == jnp.float64:
+            arr = arr.astype(get_default_dtype())
+        return Tensor(arr)
+    return Tensor(jnp.full(tuple(shape), fill_value, _dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros_like(x._value if isinstance(x, Tensor) else x,
+                                 dtype=_dt(dtype, (x.dtype if isinstance(x, Tensor) else None))))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones_like(x._value if isinstance(x, Tensor) else x,
+                                dtype=_dt(dtype, (x.dtype if isinstance(x, Tensor) else None))))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.full_like(v, fill_value, dtype=_dt(dtype, v.dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or get_default_dtype()
+    a = jnp.arange(start, end, step, dtype=convert_dtype(dtype) if dtype else None)
+    if a.dtype == jnp.float64:
+        a = a.astype(get_default_dtype())
+    return Tensor(a)
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+@defop("diag")
+def _diag(x, offset=0, padding_value=0):
+    d = jnp.diag(x, k=offset)
+    if padding_value != 0 and x.ndim == 1:
+        n = x.shape[0] + abs(offset)
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        d = jnp.where(mask, d, padding_value)
+    return d
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return _diag(x, offset=offset, padding_value=padding_value)
+
+
+@defop("diagflat")
+def _diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def diagflat(x, offset=0, name=None):
+    return _diagflat(x, offset=offset)
+
+
+@defop("tril")
+def _tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return _tril(x, diagonal=diagonal)
+
+
+@defop("triu")
+def _triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return _triu(x, diagonal=diagonal)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    r = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack(r).astype(convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r = jnp.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack(r).astype(convert_dtype(dtype)))
+
+
+def meshgrid(*args, name=None):
+    arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(g) for g in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output.set_value(v)
+        return output
+    return Tensor(v)
+
+
+@defop("clone")
+def _clone(x):
+    return x + 0
+
+
+def clone(x, name=None):
+    return _clone(x)
+
+
+@defop("complex")
+def _complex(real, imag):
+    return jax_complex(real, imag)
+
+
+def jax_complex(real, imag):
+    return real + 1j * imag
+
+
+def complex(real, imag, name=None):  # noqa: A001 - paddle API name
+    return _complex(real, imag)
+
+
+@defop("polar")
+def _polar(abs_, angle):
+    return abs_ * jnp.cos(angle) + 1j * abs_ * jnp.sin(angle)
+
+
+def polar(abs, angle, name=None):  # noqa: A002
+    return _polar(abs, angle)

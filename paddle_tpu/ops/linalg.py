@@ -1,0 +1,379 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py:238 matmul →
+phi/kernels/gpu/matmul_kernel.cu → cuBLAS).
+
+On TPU every matmul maps to the MXU via XLA dot_general; precision is
+controlled by FLAGS_tpu_default_matmul_precision (bf16 inputs hit the MXU
+natively)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import defop
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "inner", "outer", "mv", "cross", "norm",
+    "dist", "cholesky", "qr", "svd", "inv", "pinv", "solve",
+    "triangular_solve", "cholesky_solve", "lu", "matrix_power", "matrix_rank",
+    "det", "slogdet", "eig", "eigh", "eigvals", "eigvalsh", "lstsq",
+    "multi_dot", "kron", "corrcoef", "cov", "histogram", "bincount",
+    "einsum", "matrix_transpose",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+@defop("matmul")
+def _matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        if x.ndim == 1:
+            pass
+        else:
+            x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        if y.ndim == 1:
+            pass
+        else:
+            y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _matmul(_t(x), _t(y), transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+@defop("dot")
+def _dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def dot(x, y, name=None):
+    return _dot(_t(x), _t(y))
+
+
+@defop("inner")
+def _inner(x, y):
+    return jnp.inner(x, y)
+
+
+def inner(x, y, name=None):
+    return _inner(_t(x), _t(y))
+
+
+@defop("outer")
+def _outer(x, y):
+    return jnp.outer(x, y)
+
+
+def outer(x, y, name=None):
+    return _outer(_t(x), _t(y))
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+@defop("cross")
+def _cross(x, y, axis):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cross(x, y, axis=9, name=None):
+    x = _t(x)
+    if axis == 9:  # paddle default: first axis of size 3
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return _cross(x, _t(y), axis=axis)
+
+
+@defop("p_norm")
+def _p_norm(x, p=2.0, axis=None, keepdim=False):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+@defop("frobenius_norm")
+def _fro_norm(x, axis=None, keepdim=False):
+    return jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdim))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = _t(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+        if p in (None, "fro", 2) and len(axis) == 2:
+            return _fro_norm(x, axis=axis, keepdim=keepdim)
+        if isinstance(p, (int, float)):
+            return _p_norm(x, p=float(p), axis=axis, keepdim=keepdim)
+        raise ValueError(f"norm p={p} over two axes unsupported")
+    if p is None or p == "fro":
+        return _fro_norm(x, axis=axis, keepdim=keepdim)
+    if p == "nuc":
+        @defop("nuclear_norm")
+        def _nuc(a):
+            return jnp.sum(jnp.linalg.svd(a, compute_uv=False))
+        return _nuc(x)
+    return _p_norm(x, p=float(p), axis=axis, keepdim=keepdim)
+
+
+@defop("dist")
+def _dist(x, y, p=2.0):
+    d = x - y
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+def dist(x, y, p=2, name=None):
+    return _dist(_t(x), _t(y), p=float(p))
+
+
+# ---- decompositions (jnp.linalg; CPU fallback for ones XLA:TPU lacks) ----
+@defop("cholesky")
+def _cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def cholesky(x, upper=False, name=None):
+    return _cholesky(_t(x), upper=upper)
+
+
+def qr(x, mode="reduced", name=None):
+    @defop("qr")
+    def _qr(a, mode):
+        return tuple(jnp.linalg.qr(a, mode=mode))
+    if mode == "r":
+        r = jnp.linalg.qr(_t(x)._value, mode="r")
+        return Tensor(r)
+    q, r = _qr(_t(x), mode=mode)
+    return q, r
+
+
+def svd(x, full_matrices=False, name=None):
+    @defop("svd")
+    def _svd(a, full_matrices):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2)
+    return _svd(_t(x), full_matrices=full_matrices)
+
+
+@defop("inverse")
+def _inv(x):
+    return jnp.linalg.inv(x)
+
+
+def inv(x, name=None):
+    return _inv(_t(x))
+
+
+inverse = inv
+
+
+@defop("pinv")
+def _pinv(x, rcond):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _pinv(_t(x), rcond=float(rcond))
+
+
+@defop("solve")
+def _solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def solve(x, y, name=None):
+    return _solve(_t(x), _t(y))
+
+
+@defop("triangular_solve")
+def _triangular_solve(x, y, upper, transpose, unitriangular):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return _triangular_solve(_t(x), _t(y), upper=upper, transpose=transpose,
+                             unitriangular=unitriangular)
+
+
+@defop("cholesky_solve")
+def _cholesky_solve(x, y, upper):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return _cholesky_solve(_t(x), _t(y), upper=upper)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    import jax.scipy.linalg as jsl
+    xv = _t(x)._value
+    lu_, piv = jsl.lu_factor(xv)
+    out = (Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1))
+    if get_infos:
+        return out + (Tensor(jnp.zeros((), jnp.int32)),)
+    return out
+
+
+@defop("matrix_power")
+def _matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_power(x, n, name=None):
+    return _matrix_power(_t(x), n=int(n))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(_t(x)._value, rtol=tol).astype(jnp.int64))
+
+
+@defop("det")
+def _det(x):
+    return jnp.linalg.det(x)
+
+
+def det(x, name=None):
+    return _det(_t(x))
+
+
+def slogdet(x, name=None):
+    @defop("slogdet")
+    def _slogdet(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return sign, logdet
+    sign, logdet = _slogdet(_t(x))
+    from .manipulation import stack
+    return stack([sign, logdet], axis=0)
+
+
+def eig(x, name=None):
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(_t(x)._value))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    w = np.linalg.eigvals(np.asarray(_t(x)._value))
+    return Tensor(jnp.asarray(w))
+
+
+def eigh(x, UPLO="L", name=None):
+    @defop("eigh")
+    def _eigh(a, UPLO):
+        w, v = jnp.linalg.eigh(a, UPLO=UPLO)
+        return w, v
+    return _eigh(_t(x), UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    @defop("eigvalsh")
+    def _eigvalsh(a, UPLO):
+        return jnp.linalg.eigvalsh(a, UPLO=UPLO)
+    return _eigvalsh(_t(x), UPLO=UPLO)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(_t(x)._value, _t(y)._value, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(rank.astype(jnp.int64)), Tensor(sv))
+
+
+def multi_dot(x, name=None):
+    @defop("multi_dot")
+    def _md(*arrs):
+        return jnp.linalg.multi_dot(arrs)
+    return _md(*[_t(a) for a in x])
+
+
+@defop("kron")
+def _kron(x, y):
+    return jnp.kron(x, y)
+
+
+def kron(x, y, name=None):
+    return _kron(_t(x), _t(y))
+
+
+@defop("cov")
+def _cov(x, rowvar, ddof, fweights, aweights):
+    return jnp.cov(x, rowvar=rowvar, ddof=ddof, fweights=fweights,
+                   aweights=aweights)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights._value if isinstance(fweights, Tensor) else fweights
+    aw = aweights._value if isinstance(aweights, Tensor) else aweights
+    return _cov(_t(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                fweights=fw, aweights=aw)
+
+
+@defop("corrcoef")
+def _corrcoef(x, rowvar):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _corrcoef(_t(x), rowvar=rowvar)
+
+
+@defop("histogram", differentiable=False)
+def _histogram(x, bins, min, max):
+    h, _ = jnp.histogram(x, bins=bins, range=(min, max) if (min or max) else None)
+    return h.astype(jnp.int64)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    return _histogram(_t(input), bins=bins, min=min, max=max)
+
+
+@defop("bincount", differentiable=False)
+def _bincount(x, weights, minlength):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    import numpy as np
+    xv = np.asarray(_t(x)._value)
+    w = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
+    return Tensor(jnp.asarray(np.bincount(xv, weights=w, minlength=minlength)))
+
+
+@defop("einsum")
+def _einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    return _einsum(equation, *[_t(o) for o in operands])
+
+
+def matrix_transpose(x, name=None):
+    from .manipulation import swapaxes
+    return swapaxes(_t(x), -1, -2)

@@ -1,0 +1,73 @@
+"""Comparison / logic ops (reference: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import defop
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "is_empty", "is_tensor",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+_CMP = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+    "less_than": jnp.less, "less_equal": jnp.less_equal,
+}
+
+for _name, _fn in _CMP.items():
+    _op = defop(_name, differentiable=False)(_fn)
+
+    def _make(op):
+        def wrapper(x, y, name=None):
+            return op(_t(x), _t(y))
+        return wrapper
+
+    globals()[_name] = _make(_op)
+
+
+@defop("equal_all", differentiable=False)
+def _equal_all(x, y):
+    if x.shape != y.shape:
+        return jnp.asarray(False)
+    return jnp.all(x == y)
+
+
+def equal_all(x, y, name=None):
+    return _equal_all(_t(x), _t(y))
+
+
+@defop("isclose", differentiable=False)
+def _isclose(x, y, rtol, atol, equal_nan):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _isclose(_t(x), _t(y), rtol=float(rtol), atol=float(atol),
+                    equal_nan=equal_nan)
+
+
+@defop("allclose", differentiable=False)
+def _allclose(x, y, rtol, atol, equal_nan):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _allclose(_t(x), _t(y), rtol=float(rtol), atol=float(atol),
+                     equal_nan=equal_nan)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,568 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py
+→ phi reshape/transpose/concat/... kernels).
+
+On TPU these are mostly free: XLA folds reshapes/transposes into surrounding
+fusions; only materializing ops (concat/gather/pad) cost HBM bandwidth."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ..core.dispatch import defop
+
+__all__ = [
+    "reshape", "transpose", "flatten", "squeeze", "unsqueeze", "concat",
+    "stack", "split", "chunk", "cast", "gather", "gather_nd", "scatter",
+    "scatter_nd_add", "index_select", "index_sample", "tile", "expand",
+    "expand_as", "broadcast_to", "flip", "roll", "unbind", "unstack",
+    "take_along_axis", "put_along_axis", "where", "masked_select",
+    "masked_fill", "repeat_interleave", "moveaxis", "swapaxes", "t",
+    "as_complex", "as_real", "view", "view_as", "crop", "strided_slice",
+    "slice", "rot90", "tensordot", "broadcast_tensors", "atleast_1d",
+    "atleast_2d", "atleast_3d", "index_put", "tolist", "numel", "shard_index",
+]
+
+
+_builtin_slice = slice  # the public paddle op below shadows the builtin
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+@defop("reshape")
+def _reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    return _reshape(_t(x), shape=tuple(shape))
+
+
+@defop("transpose")
+def _transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return _transpose(_t(x), perm=tuple(int(p) for p in perm))
+
+
+def t(x, name=None):
+    x = _t(x)
+    if x.ndim < 2:
+        return x
+    if x.ndim != 2:
+        raise ValueError("paddle.t expects ndim<=2; use transpose")
+    return transpose(x, [1, 0])
+
+
+@defop("flatten")
+def _flatten(x, start_axis, stop_axis):
+    shape = x.shape
+    nd = len(shape)
+    start = start_axis % nd if nd else 0
+    stop = stop_axis % nd if nd else 0
+    new_shape = shape[:start] + (-1,) + shape[stop + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _flatten(_t(x), start_axis=start_axis, stop_axis=stop_axis)
+
+
+@defop("squeeze")
+def _squeeze(x, axis):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = tuple(a % x.ndim for a in axis)
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def squeeze(x, axis=None, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return _squeeze(_t(x), axis=tuple(axis) if axis is not None else None)
+
+
+@defop("unsqueeze")
+def _unsqueeze(x, axis):
+    for a in sorted(a % (x.ndim + 1) for a in axis):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = [int(a.item()) if isinstance(a, Tensor) else int(a) for a in axis]
+    return _unsqueeze(_t(x), axis=tuple(axis))
+
+
+@defop("concat")
+def _concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _concat(*[_t(e) for e in x], axis=axis)
+
+
+@defop("stack")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack(*[_t(e) for e in x], axis=axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = axis % x.ndim
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_unknown = sum(1 for s in sizes if s < 0)
+        if n_unknown:
+            known = sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    outs = []
+    off = 0
+    for s in sizes:
+        outs.append(_slice_op(x, axes=(axis,), starts=(off,), ends=(off + s,)))
+        off += s
+    return outs
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+@defop("slice")
+def _slice_op(x, axes, starts, ends):
+    idx = [_builtin_slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a % x.ndim] = _builtin_slice(s, e)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+    return _slice_op(_t(x), axes=tuple(axes), starts=tuple(starts), ends=tuple(ends))
+
+
+@defop("strided_slice")
+def _strided_slice(x, axes, starts, ends, strides):
+    idx = [_builtin_slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a % x.ndim] = _builtin_slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return _strided_slice(_t(x), axes=tuple(axes), starts=tuple(starts),
+                          ends=tuple(ends), strides=tuple(strides))
+
+
+@defop("cast")
+def _cast(x, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype, name=None):
+    return _cast(_t(x), dtype=convert_dtype(dtype))
+
+
+@defop("gather")
+def _gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    idx = _v(index)
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = idx[:, 0]
+    return apply_index_op(_gather, _t(x), idx, axis=axis)
+
+
+def apply_index_op(op, x, idx, **kw):
+    # index is data (non-differentiable); pass as raw array so jax.vjp only
+    # differentiates the tensor operand.
+    return op(x, idx, **kw)
+
+
+@defop("gather_nd")
+def _gather_nd(x, index):
+    index = index.astype(jnp.int32)
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def gather_nd(x, index, name=None):
+    return _gather_nd(_t(x), _v(index))
+
+
+@defop("scatter")
+def _scatter(x, index, updates, overwrite=True):
+    index = index.astype(jnp.int32)
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _scatter(_t(x), _v(index), _t(updates), overwrite=overwrite)
+
+
+@defop("scatter_nd_add")
+def _scatter_nd_add(x, index, updates):
+    index = index.astype(jnp.int32)
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _scatter_nd_add(_t(x), _v(index), _t(updates))
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+@defop("index_sample")
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=1)
+
+
+def index_sample(x, index, name=None):
+    return _index_sample(_t(x), _v(index))
+
+
+@defop("index_put")
+def _index_put(x, indices, value, accumulate=False):
+    idx = tuple(i.astype(jnp.int32) for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    return _index_put(_t(x), tuple(_v(i) for i in indices), _t(value),
+                      accumulate=accumulate)
+
+
+@defop("tile")
+def _tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    repeat_times = [int(r.item()) if isinstance(r, Tensor) else int(r)
+                    for r in repeat_times]
+    return _tile(_t(x), repeat_times=tuple(repeat_times))
+
+
+@defop("expand")
+def _expand(x, shape):
+    shape = list(shape)
+    nd = len(shape)
+    xshape = (1,) * (nd - x.ndim) + x.shape
+    x = jnp.reshape(x, xshape)
+    out_shape = tuple(xs if s in (-1,) else s for s, xs in zip(shape, xshape))
+    return jnp.broadcast_to(x, out_shape)
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    return _expand(_t(x), shape=tuple(shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = jnp.broadcast_arrays(*[_v(i) for i in inputs])
+    shape = arrs[0].shape
+    return [expand(_t(i), shape) for i in inputs]
+
+
+@defop("flip")
+def _flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return _flip(_t(x), axis=tuple(axis))
+
+
+@defop("rot90")
+def _rot90(x, k, axes):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _rot90(_t(x), k=k, axes=tuple(axes))
+
+
+@defop("roll")
+def _roll(x, shifts, axis):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, Tensor):
+        shifts = shifts.tolist()
+    return _roll(_t(x), shifts=shifts, axis=axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = _t(x)
+    n = x.shape[axis % x.ndim]
+    outs = split(x, n, axis)
+    return [squeeze(o, [axis]) for o in outs]
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+@defop("take_along_axis")
+def _take_along_axis(x, index, axis):
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=axis)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return _take_along_axis(_t(arr), _v(indices), axis=axis)
+
+
+@defop("put_along_axis")
+def _put_along_axis(x, index, value, axis, reduce="assign"):
+    index = index.astype(jnp.int32)
+    value = jnp.broadcast_to(jnp.asarray(value, x.dtype), index.shape)
+    if reduce in ("assign", None):
+        return jnp.put_along_axis(x, index, value, axis=axis, inplace=False)
+    # scatter-add/mul via full advanced-index grids along every dim
+    axis = axis % x.ndim
+    grids = []
+    for d in range(x.ndim):
+        if d == axis:
+            grids.append(index)
+        else:
+            shape = tuple(index.shape[i] if i != d else x.shape[d]
+                          for i in range(x.ndim))
+            g = jnp.arange(index.shape[d]).reshape(
+                tuple(index.shape[d] if i == d else 1 for i in range(x.ndim)))
+            grids.append(jnp.broadcast_to(g, index.shape))
+    idx = tuple(grids)
+    if reduce == "add":
+        return x.at[idx].add(value)
+    if reduce in ("mul", "multiply"):
+        return x.at[idx].multiply(value)
+    raise NotImplementedError(f"put_along_axis reduce={reduce}")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    return _put_along_axis(_t(arr), _v(indices), _t(values), axis=axis,
+                           reduce=reduce)
+
+
+@defop("where")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return _where(_v(condition), _t(x), _t(y))
+
+
+def nonzero(x, as_tuple=False):
+    """Dynamic-shape op: eager only (not jit-traceable), like reference
+    kernels that allocate by count."""
+    import numpy as np
+    arr = np.asarray(_v(x))
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n)) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def masked_select(x, mask, name=None):
+    import numpy as np
+    xv, mv = np.asarray(_v(x)), np.asarray(_v(mask))
+    return Tensor(jnp.asarray(xv[mv.astype(bool)]))
+
+
+@defop("masked_fill")
+def _masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        value = value._value
+    return _masked_fill(_t(x), _v(mask).astype(bool), value)
+
+
+@defop("repeat_interleave")
+def _repeat_interleave(x, repeats, axis):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = repeats._value
+    return _repeat_interleave(_t(x), repeats=repeats, axis=axis)
+
+
+def moveaxis(x, source, destination, name=None):
+    x = _t(x)
+    perm = list(range(x.ndim))
+    src = [source] if isinstance(source, int) else list(source)
+    dst = [destination] if isinstance(destination, int) else list(destination)
+    src = [s % x.ndim for s in src]
+    dst = [d % x.ndim for d in dst]
+    rest = [i for i in range(x.ndim) if i not in src]
+    perm = [None] * x.ndim
+    for s, d in zip(src, dst):
+        perm[d] = s
+    it = iter(rest)
+    for i in range(x.ndim):
+        if perm[i] is None:
+            perm[i] = next(it)
+    return transpose(x, perm)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    x = _t(x)
+    perm = list(range(x.ndim))
+    perm[axis1], perm[axis2] = perm[axis2], perm[axis1]
+    return transpose(x, perm)
+
+
+@defop("as_complex")
+def _as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_complex(x, name=None):
+    return _as_complex(_t(x))
+
+
+@defop("as_real")
+def _as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_real(x, name=None):
+    return _as_real(_t(x))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+@defop("crop")
+def _crop(x, offsets, shape):
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _t(x)
+    shape = list(shape) if shape is not None else x.shape
+    shape = [x.shape[i] if s == -1 else int(s) for i, s in enumerate(shape)]
+    offsets = [int(o) for o in (offsets or [0] * x.ndim)]
+    return _crop(x, offsets=tuple(offsets), shape=tuple(shape))
+
+
+@defop("tensordot")
+def _tensordot(a, b, axes):
+    return jnp.tensordot(a, b, axes=axes)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return _tensordot(_t(x), _t(y), axes=axes)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(_t(i), [-1]) if _t(i).ndim == 0 else _t(i) for i in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for i in inputs:
+        ti = _t(i)
+        while ti.ndim < 2:
+            ti = unsqueeze(ti, [0])
+        outs.append(ti)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for i in inputs:
+        ti = _t(i)
+        while ti.ndim < 3:
+            ti = unsqueeze(ti, [-1] if ti.ndim >= 2 else [0])
+        outs.append(ti)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def tolist(x):
+    return _t(x).tolist()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size, dtype=jnp.int64))
+
+
+@defop("shard_index", differentiable=False)
+def _shard_index(x, index_num, nshards, shard_id, ignore_value):
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return _shard_index(_t(input), index_num=index_num, nshards=nshards,
+                        shard_id=shard_id, ignore_value=ignore_value)

@@ -1,0 +1,269 @@
+"""Elementwise & scalar math ops (reference: python/paddle/tensor/math.py →
+generated _C_ops → phi/kernels elementwise/activation kernels).
+
+Every op is one pure-jnp function; XLA fuses chains of these into single
+TPU kernels, which replaces the reference's hand-fused CUDA elementwise
+kernels (phi/kernels/funcs/elementwise_base.h machinery)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import defop
+
+__all__ = []  # populated below
+
+
+def _export(name):
+    __all__.append(name)
+
+
+def _coerce(x):
+    """Allow python scalars / numpy in tensor slots of binary ops."""
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+# ---- simple unary --------------------------------------------------------
+_UNARY = {
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log2": jnp.log2,
+    "log10": jnp.log10, "log1p": jnp.log1p, "sqrt": jnp.sqrt,
+    "square": jnp.square, "abs": jnp.abs, "sign": jnp.sign,
+    "neg": jnp.negative, "reciprocal": lambda x: 1.0 / x,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "rsqrt": jax.lax.rsqrt, "digamma": jax.scipy.special.digamma,
+    "lgamma": jax.scipy.special.gammaln, "trunc": jnp.trunc,
+    "frac": lambda x: x - jnp.trunc(x), "rad2deg": jnp.rad2deg,
+    "deg2rad": jnp.deg2rad, "angle": jnp.angle, "conj": jnp.conj,
+    "real": jnp.real, "imag": jnp.imag, "sigmoid": jax.nn.sigmoid,
+    "i0": lambda x: jax.scipy.special.i0(x), "i0e": lambda x: jax.scipy.special.i0e(x),
+    "i1": lambda x: jax.scipy.special.i1(x), "i1e": lambda x: jax.scipy.special.i1e(x),
+}
+
+for _name, _fn in _UNARY.items():
+    _op = defop(_name)(_fn)
+
+    def _make(op):
+        def wrapper(x, name=None):
+            return op(_coerce(x))
+        return wrapper
+
+    globals()[_name] = _make(_op)
+    _export(_name)
+
+# Non-differentiable unary (integer/bool results).
+_UNARY_NONDIFF = {
+    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+    "logical_not": jnp.logical_not, "bitwise_not": jnp.bitwise_not,
+}
+for _name, _fn in _UNARY_NONDIFF.items():
+    _op = defop(_name, differentiable=False)(_fn)
+
+    def _make_nd(op):
+        def wrapper(x, name=None):
+            return op(_coerce(x))
+        return wrapper
+
+    globals()[_name] = _make_nd(_op)
+    _export(_name)
+
+
+# ---- binary --------------------------------------------------------------
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "pow": jnp.power, "maximum": jnp.maximum,
+    "minimum": jnp.minimum, "fmax": jnp.fmax, "fmin": jnp.fmin,
+    "atan2": jnp.arctan2, "hypot": jnp.hypot, "copysign": jnp.copysign,
+    "nextafter": jnp.nextafter, "ldexp": jnp.ldexp,
+    "heaviside": jnp.heaviside, "gammaln": None,
+}
+_BINARY.pop("gammaln")
+for _name, _fn in _BINARY.items():
+    _op = defop(_name)(_fn)
+
+    def _make2(op):
+        def wrapper(x, y, name=None):
+            return op(_coerce(x), _coerce(y))
+        return wrapper
+
+    globals()[_name] = _make2(_op)
+    _export(_name)
+
+_BINARY_NONDIFF = {
+    "floor_divide": jnp.floor_divide, "mod": jnp.mod, "remainder": jnp.mod,
+    "floor_mod": jnp.mod,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor, "bitwise_and": jnp.bitwise_and,
+    "bitwise_or": jnp.bitwise_or, "bitwise_xor": jnp.bitwise_xor,
+    "gcd": jnp.gcd, "lcm": jnp.lcm,
+}
+for _name, _fn in _BINARY_NONDIFF.items():
+    _op = defop(_name, differentiable=False)(_fn)
+    globals()[_name] = _make2(_op)
+    _export(_name)
+
+
+# ---- parameterized -------------------------------------------------------
+@defop("scale")
+def _scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = _scale(_coerce(x), scale=float(scale), bias=float(bias),
+                 bias_after_scale=bias_after_scale)
+    return out
+_export("scale")
+
+
+@defop("clip")
+def _clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def clip(x, min=None, max=None, name=None):
+    if isinstance(min, Tensor):
+        min = min.item()
+    if isinstance(max, Tensor):
+        max = max.item()
+    return _clip(_coerce(x), min=min, max=max)
+_export("clip")
+
+
+@defop("lerp")
+def _lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def lerp(x, y, weight, name=None):
+    return _lerp(_coerce(x), _coerce(y), _coerce(weight))
+_export("lerp")
+
+
+@defop("add_n")
+def _add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (grad-accumulation op in the reference,
+    phi/kernels/add_n_kernel.h)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    return _add_n(*inputs)
+_export("add_n")
+
+
+@defop("stanh")
+def _stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _stanh(_coerce(x), scale_a=scale_a, scale_b=scale_b)
+_export("stanh")
+
+
+@defop("logit")
+def _logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def logit(x, eps=None, name=None):
+    return _logit(_coerce(x), eps=eps)
+_export("logit")
+
+
+@defop("cumsum")
+def _cumsum(x, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    out = _cumsum(_coerce(x), axis=axis)
+    if dtype is not None:
+        from .manipulation import cast
+        out = cast(out, dtype)
+    return out
+_export("cumsum")
+
+
+@defop("cumprod")
+def _cumprod(x, dim=None):
+    return jnp.cumprod(x, axis=dim)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = _cumprod(_coerce(x), dim=dim)
+    if dtype is not None:
+        from .manipulation import cast
+        out = cast(out, dtype)
+    return out
+_export("cumprod")
+
+
+@defop("cummax", differentiable=False)
+def _cummax(x, axis):
+    return jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    xx = _coerce(x)
+    if axis is None:
+        from .manipulation import reshape
+        xx, axis = reshape(xx, [-1]), 0
+    values = _cummax(xx, axis=axis)
+    return values
+_export("cummax")
+
+
+@defop("logaddexp")
+def _logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def logaddexp(x, y, name=None):
+    return _logaddexp(_coerce(x), _coerce(y))
+_export("logaddexp")
+
+
+@defop("multiplex")
+def _multiplex(index, *inputs):
+    stacked = jnp.stack(inputs, axis=0)  # [n, batch, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    sel = idx.reshape((1, -1) + (1,) * (stacked.ndim - 2))
+    return jnp.take_along_axis(stacked, sel, axis=0)[0]
+
+
+def multiplex(inputs, index, name=None):
+    return _multiplex(_coerce(index), *[_coerce(i) for i in inputs])
+_export("multiplex")
+
+
+@defop("nan_to_num")
+def _nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _nan_to_num(_coerce(x), nan=nan, posinf=posinf, neginf=neginf)
+_export("nan_to_num")
+
+
+def increment(x, value=1.0, name=None):
+    x._in_place_update(x._value + value)
+    return x
+_export("increment")

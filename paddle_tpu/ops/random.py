@@ -1,0 +1,168 @@
+"""Random ops + global generator (reference: python/paddle/tensor/random.py,
+seed plumbing in paddle/phi/core/generator.h).
+
+Design: JAX's counter-based PRNG (threefry) replaces the reference's
+per-device curand generators; a process-global Generator holds a key and
+splits per call. Parallel-RNG for TP dropout lives in
+paddle_tpu.distributed.fleet.rng (reference mpu/random.py RNGStatesTracker)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor
+from ..core.dispatch import defop
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "default_generator",
+           "rand", "randn", "randint", "randint_like", "uniform", "normal",
+           "standard_normal", "gaussian", "randperm", "bernoulli",
+           "multinomial", "poisson", "uniform_", "normal_", "exponential_",
+           "next_key"]
+
+
+class Generator:
+    """Process-global splittable PRNG state."""
+
+    def __init__(self, seed_: int = 0):
+        self._key = jax.random.PRNGKey(seed_)
+        self._seed = seed_
+
+    def manual_seed(self, s: int):
+        self._key = jax.random.PRNGKey(s)
+        self._seed = s
+        return self
+
+    def split(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return Tensor(self._key)
+
+    def set_state(self, state):
+        self._key = state._value if isinstance(state, Tensor) else jnp.asarray(state)
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int):
+    """paddle.seed parity."""
+    default_generator.manual_seed(int(s))
+    return default_generator
+
+
+def next_key() -> jax.Array:
+    return default_generator.split()
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(states):
+    default_generator.set_state(states[0] if isinstance(states, (list, tuple)) else states)
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else get_default_dtype()
+    return convert_dtype(dtype)
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, tuple(int(s) for s in shape),
+                                     _dt(dtype), minval=min, maxval=max))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jax.random.normal(next_key(), tuple(int(s) for s in shape),
+                                    _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None) -> Tensor:
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return Tensor(mean + std * jax.random.normal(
+        key, tuple(int(s) for s in shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            jnp.shape(m), jnp.shape(s)) if shape is None else tuple(shape)
+        return Tensor(m + s * jax.random.normal(next_key(), out_shape,
+                                                get_default_dtype()))
+    if shape is None:
+        shape = []
+    return gaussian(shape, mean=mean, std=std)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), tuple(int(s) for s in shape),
+                                     low, high, convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    return randint(low, high, shape=x.shape, dtype=dtype or str(x.dtype))
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(next_key(), p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    p = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + p.shape[:-1])
+        if p.ndim == 2:
+            out = jnp.moveaxis(out, 0, -1)
+        return Tensor(out.astype(jnp.int64))
+    # without replacement: gumbel top-k
+    g = jax.random.gumbel(next_key(), p.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def poisson(x, name=None) -> Tensor:
+    lam = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(next_key(), lam).astype(lam.dtype))
+
+
+# in-place variants (eager): rebind value
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._in_place_update(jax.random.uniform(next_key(), tuple(x.shape), x.dtype,
+                                          minval=min, maxval=max))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._in_place_update(mean + std * jax.random.normal(next_key(), tuple(x.shape),
+                                                      x.dtype))
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._in_place_update(jax.random.exponential(next_key(), tuple(x.shape),
+                                              x.dtype) / lam)
+    return x

@@ -1,0 +1,223 @@
+"""Reduction & statistic ops (reference: python/paddle/tensor/math.py +
+stat.py → phi reduce kernels; XLA lowers these to tiled tree reductions)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ..core.dispatch import defop
+
+__all__ = [
+    "sum", "mean", "max", "min", "prod", "amax", "amin", "all", "any",
+    "logsumexp", "std", "var", "median", "nanmedian", "nanmean", "nansum",
+    "count_nonzero", "argmax", "argmin", "cumulative_trapezoid", "trapezoid",
+    "kthvalue", "mode", "quantile", "nanquantile",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+        return tuple(axis) if isinstance(axis, list) else int(axis)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _make_reduce(name, fn, differentiable=True):
+    op = defop(name, differentiable=differentiable)(
+        lambda x, axis=None, keepdim=False: fn(x, axis=axis, keepdims=keepdim))
+
+    def wrapper(x, axis=None, keepdim=False, name=None, dtype=None):
+        out = op(_t(x), axis=_axis(axis), keepdim=keepdim)
+        if dtype is not None:
+            from .manipulation import cast
+            out = cast(out, dtype)
+        return out
+    wrapper.__name__ = name
+    return wrapper
+
+
+sum = _make_reduce("sum", jnp.sum)  # noqa: A001
+mean = _make_reduce("mean", jnp.mean)
+max = _make_reduce("max", jnp.max)  # noqa: A001
+min = _make_reduce("min", jnp.min)  # noqa: A001
+prod = _make_reduce("prod", jnp.prod)
+amax = _make_reduce("amax", jnp.max)
+amin = _make_reduce("amin", jnp.min)
+all = _make_reduce("all", jnp.all, differentiable=False)  # noqa: A001
+any = _make_reduce("any", jnp.any, differentiable=False)  # noqa: A001
+nanmean = _make_reduce("nanmean", jnp.nanmean)
+nansum = _make_reduce("nansum", jnp.nansum)
+
+
+@defop("logsumexp")
+def _logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _logsumexp(_t(x), axis=_axis(axis), keepdim=keepdim)
+
+
+@defop("std")
+def _std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _std(_t(x), axis=_axis(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@defop("var")
+def _var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _var(_t(x), axis=_axis(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@defop("median")
+def _median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return _median(_t(x), axis=_axis(axis), keepdim=keepdim)
+
+
+@defop("nanmedian")
+def _nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return _nanmedian(_t(x), axis=_axis(axis), keepdim=keepdim)
+
+
+@defop("count_nonzero", differentiable=False)
+def _count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim).astype(jnp.int64)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _count_nonzero(_t(x), axis=_axis(axis), keepdim=keepdim)
+
+
+@defop("argmax", differentiable=False)
+def _argmax(x, axis=None, keepdim=False, dtype=jnp.int64):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmax(_t(x), axis=_axis(axis), keepdim=keepdim,
+                   dtype=convert_dtype(dtype))
+
+
+@defop("argmin", differentiable=False)
+def _argmin(x, axis=None, keepdim=False, dtype=jnp.int64):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmin(_t(x), axis=_axis(axis), keepdim=keepdim,
+                   dtype=convert_dtype(dtype))
+
+
+@defop("kthvalue")
+def _kthvalue(x, k, axis, keepdim):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    sel = jnp.take(vals, k - 1, axis=axis)
+    isel = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        sel = jnp.expand_dims(sel, axis)
+        isel = jnp.expand_dims(isel, axis)
+    return sel, isel.astype(jnp.int64)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return _kthvalue(_t(x), k=int(k), axis=axis, keepdim=keepdim)
+
+
+@defop("mode")
+def _mode(x, axis, keepdim):
+    sorted_x = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+
+    def count_run(i):
+        v = jnp.take(sorted_x, i, axis=axis)
+        eq = (sorted_x == jnp.expand_dims(v, axis)).sum(axis=axis)
+        return eq
+    counts = jnp.stack([count_run(i) for i in range(n)], axis=-1)
+    best = jnp.argmax(counts, axis=-1)
+    vals = jnp.take_along_axis(
+        jnp.moveaxis(sorted_x, axis, -1), best[..., None], axis=-1)[..., 0]
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+    return vals
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = _t(x)
+    vals = _mode(x, axis=axis, keepdim=keepdim)
+    v = vals._value if keepdim else jnp.expand_dims(vals._value, axis)
+    idx = jnp.argmax(jnp.moveaxis(x._value == v, axis, -1), axis=-1)
+    if keepdim:
+        idx = jnp.expand_dims(idx, axis)
+    return vals, Tensor(idx.astype(jnp.int64))
+
+
+@defop("quantile")
+def _quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return _quantile(_t(x), q=q, axis=_axis(axis), keepdim=keepdim)
+
+
+@defop("nanquantile")
+def _nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return _nanquantile(_t(x), q=q, axis=_axis(axis), keepdim=keepdim)
+
+
+@defop("trapezoid")
+def _trapezoid(y, x=None, dx=1.0, axis=-1):
+    return jnp.trapezoid(y, x=x, dx=dx, axis=axis)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return _trapezoid(_t(y), _t(x), axis=axis)
+    return _trapezoid(_t(y), dx=1.0 if dx is None else float(dx), axis=axis)
+
+
+@defop("cumulative_trapezoid")
+def _cumulative_trapezoid(y, dx=1.0, axis=-1):
+    ya = jnp.moveaxis(y, axis, -1)
+    avg = (ya[..., 1:] + ya[..., :-1]) * 0.5 * dx
+    return jnp.moveaxis(jnp.cumsum(avg, axis=-1), -1, axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        raise NotImplementedError("cumulative_trapezoid with x tensor")
+    return _cumulative_trapezoid(_t(y), dx=1.0 if dx is None else float(dx),
+                                 axis=axis)

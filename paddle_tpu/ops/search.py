@@ -1,0 +1,161 @@
+"""Search & sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import defop
+
+__all__ = ["sort", "argsort", "topk", "searchsorted", "bucketize", "unique",
+           "unique_consecutive", "index_add", "index_fill"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+@defop("sort")
+def _sort(x, axis=-1, descending=False, stable=True):
+    out = jnp.sort(x, axis=axis, stable=stable)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    return _sort(_t(x), axis=axis, descending=descending, stable=stable)
+
+
+@defop("argsort", differentiable=False)
+def _argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.int64)
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    return _argsort(_t(x), axis=axis, descending=descending, stable=stable)
+
+
+@defop("topk")
+def _topk(x, k, axis, largest):
+    axis = axis % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idxs = jax.lax.top_k(xm, k)
+    else:
+        vals, idxs = jax.lax.top_k(-xm, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idxs, -1, axis).astype(jnp.int64)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    return _topk(_t(x), k=k, axis=axis, largest=largest)
+
+
+@defop("searchsorted", differentiable=False)
+def _searchsorted(sorted_sequence, values, right):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        return jnp.searchsorted(sorted_sequence, values, side=side).astype(jnp.int64)
+    # batched innermost dim
+    flat_seq = sorted_sequence.reshape(-1, sorted_sequence.shape[-1])
+    flat_val = values.reshape(-1, values.shape[-1])
+    out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(flat_seq, flat_val)
+    return out.reshape(values.shape).astype(jnp.int64)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    out = _searchsorted(_t(sorted_sequence), _t(values), right=right)
+    if out_int32:
+        from .manipulation import cast
+        out = cast(out, "int32")
+    return out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    """Dynamic-shape: eager only (reference unique kernel allocates by count)."""
+    import numpy as np
+    arr = np.asarray(_v(x))
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    import numpy as np
+    arr = np.asarray(_v(x))
+    if axis is None:
+        arr = arr.reshape(-1)
+        axis = 0
+    keep = np.ones(arr.shape[axis], dtype=bool)
+    sl = [slice(None)] * arr.ndim
+    prev = None
+    vals_idx = []
+    counts = []
+    inverse = np.zeros(arr.shape[axis], dtype=np.int64)
+    gi = -1
+    for i in range(arr.shape[axis]):
+        sl[axis] = i
+        cur = arr[tuple(sl)]
+        if prev is None or not np.array_equal(cur, prev):
+            gi += 1
+            vals_idx.append(i)
+            counts.append(1)
+        else:
+            counts[-1] += 1
+        inverse[i] = gi
+        prev = cur
+    out = np.take(arr, vals_idx, axis=axis)
+    rets = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        rets.append(Tensor(jnp.asarray(inverse)))
+    if return_counts:
+        rets.append(Tensor(jnp.asarray(np.asarray(counts))))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+@defop("index_add")
+def _index_add(x, index, value, axis):
+    index = index.astype(jnp.int32)
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_add(x, index, axis, value, name=None):
+    return _index_add(_t(x), _v(index), _t(value), axis=axis)
+
+
+@defop("index_fill")
+def _index_fill(x, index, value, axis):
+    index = index.astype(jnp.int32)
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index].set(jnp.asarray(value, x.dtype))
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_fill(x, index, axis, value, name=None):
+    if isinstance(value, Tensor):
+        value = value.item()
+    return _index_fill(_t(x), _v(index), axis=axis, value=value)

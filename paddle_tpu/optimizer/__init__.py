@@ -1,0 +1,12 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/__init__.py)."""
+
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Momentum, Adam, AdamW, Adagrad, Adadelta, Adamax, RMSProp, Lamb,
+    NAdam, RAdam, ASGD, Rprop,
+)
+
+__all__ = ["lr", "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "Adadelta", "Adamax", "RMSProp", "Lamb", "NAdam", "RAdam", "ASGD",
+           "Rprop"]

@@ -1,0 +1,145 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py).
+
+TPU-native design: the whole update (all params, all state) is ONE jitted
+jax function over pytrees with donated buffers — the analogue of the
+reference's fused multi-tensor optimizer kernels, but produced by XLA fusion
+instead of hand-written CUDA. Eager .step() gathers grads, runs the cached
+executable, and rebinds parameter values in place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters must be provided (dygraph-style optimizer)")
+        self._parameter_list = list(parameters)
+        self._lr = learning_rate
+        self._lr_scheduler = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        # per-parameter slot state, keyed by slot name then param index
+        self._accumulators: dict[str, list[jax.Array]] = {}
+        self._global_step = 0
+        self._update_fn = None  # cached jitted update
+
+    # -- API parity ---------------------------------------------------------
+    def get_lr(self) -> float:
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler())
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if self._lr_scheduler is not None:
+            raise RuntimeError("can't set_lr when using an LRScheduler")
+        self._lr = value
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- state --------------------------------------------------------------
+    def _ensure_state(self):
+        """Subclasses create slots here (lazily, once shapes are known)."""
+
+    def _init_slot(self, name: str, like_master: bool = False):
+        if name not in self._accumulators:
+            self._accumulators[name] = [
+                jnp.zeros(p._value.shape,
+                          jnp.float32 if like_master else p._value.dtype)
+                for p in self._parameter_list]
+
+    def state_dict(self) -> dict:
+        out: dict[str, Any] = {"global_step": self._global_step}
+        for slot, arrs in self._accumulators.items():
+            for i, a in enumerate(arrs):
+                out[f"{slot}_{i}"] = Tensor(a)
+        if self._lr_scheduler is not None:
+            out["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state: dict):
+        self._ensure_state()
+        self._global_step = int(state.get("global_step", 0))
+        for slot in self._accumulators:
+            for i in range(len(self._accumulators[slot])):
+                key = f"{slot}_{i}"
+                if key in state:
+                    v = state[key]
+                    self._accumulators[slot][i] = (
+                        v._value if isinstance(v, Tensor) else jnp.asarray(v))
+        if "LR_Scheduler" in state and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state["LR_Scheduler"])
+
+    # -- the update ---------------------------------------------------------
+    def _update(self, params: list[jax.Array], grads: list[jax.Array],
+                state: dict[str, list[jax.Array]], lr, step
+                ) -> tuple[list[jax.Array], dict[str, list[jax.Array]]]:
+        """Pure function: subclasses implement. Must not touch self state."""
+        raise NotImplementedError
+
+    def _apply_weight_decay(self, p, g):
+        """L2Decay-style decay applied to the gradient (reference
+        regularizer semantics); AdamW overrides step-coupled decay."""
+        wd = self._weight_decay
+        if wd is None:
+            return g
+        coeff = float(wd) if not callable(wd) else float(wd())
+        return g + coeff * p
+
+    @property
+    def _param_groups_key(self):
+        return tuple(id(p) for p in self._parameter_list)
+
+    def step(self):
+        self._ensure_state()
+        params_with_grad = [(i, p) for i, p in enumerate(self._parameter_list)
+                            if p.grad is not None and not p.stop_gradient]
+        if not params_with_grad:
+            self._global_step += 1
+            return
+        if self._grad_clip is not None:
+            self._grad_clip([p for _, p in params_with_grad])
+        idxs = [i for i, _ in params_with_grad]
+        params = [p._value for _, p in params_with_grad]
+        grads = [p.grad._value.astype(p._value.dtype) for _, p in params_with_grad]
+        state = {slot: [arrs[i] for i in idxs]
+                 for slot, arrs in self._accumulators.items()}
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step = jnp.asarray(self._global_step + 1, jnp.int32)
+
+        if self._update_fn is None:
+            self._update_fn = jax.jit(self._update, donate_argnums=(0, 2))
+        new_params, new_state = self._update_fn(params, grads, state, lr, step)
+        for (i, p), np_ in zip(params_with_grad, new_params):
+            p._in_place_update(np_)
+        for slot in new_state:
+            for j, i in enumerate(idxs):
+                self._accumulators[slot][i] = new_state[slot][j]
+        self._global_step += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # lr scheduler passthrough
+    def _learning_rate(self):
+        return self.get_lr()

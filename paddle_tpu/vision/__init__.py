@@ -1,0 +1,7 @@
+"""paddle_tpu.vision (reference: python/paddle/vision)."""
+
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+
+__all__ = ["models", "transforms", "datasets"]

