@@ -17,6 +17,8 @@ white_list = {
     "matmul", "mm", "bmm", "linear", "conv", "conv_transpose", "einsum",
     "scaled_dot_product_attention", "flash_attention", "lstm_layer",
     "gru_layer", "simple_rnn_layer", "embedding_lookup", "tensordot",
+    # whole-model fused forwards (stacked-scan models): matmul-dominated
+    "llama_forward", "gpt_forward",
 }
 
 # black list: numerically-sensitive ops stay fp32 —

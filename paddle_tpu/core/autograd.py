@@ -186,6 +186,12 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence | None = None,
         if id(node) in processed:
             continue
         processed.add(id(node))
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to run backward through the graph a second time "
+                "(the saved intermediates were already released); call "
+                ".backward(retain_graph=True) on the first backward if you "
+                "need to backward twice")
         cots = node.take_cotangents()
         for hook in node._hooks:
             cots = tuple(hook(c) for c in cots)

@@ -25,7 +25,8 @@ __all__ = ["Tensor", "Parameter", "to_tensor"]
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_out_index",
-                 "_grad_hooks", "name", "persistable", "__weakref__")
+                 "_grad_hooks", "name", "persistable", "dist_attr",
+                 "_dist_spec", "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True, name: str | None = None):
         if isinstance(value, Tensor):
@@ -40,6 +41,8 @@ class Tensor:
         self._grad_hooks = []
         self.name = name
         self.persistable = False
+        self.dist_attr = None
+        self._dist_spec = None  # PartitionSpec annotation for pjit paths
 
     # -- metadata ----------------------------------------------------------
     @property

@@ -1,0 +1,47 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/__init__.py).
+
+Layering (SURVEY §2.3 / §5):
+- mesh/placements + shard_tensor/reshard  — semi-auto parallel (DistTensor)
+- communication                            — eager collective API (control plane)
+- fcollectives                             — compiled collectives (hot path)
+- fleet                                    — hybrid parallel orchestration
+- parallelize/DistTrainStep                — one-program hybrid train step
+- launch                                   — multi-host process launcher
+- checkpoint                               — sharded save/load + reshard
+"""
+
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, is_initialized, ParallelEnv,
+    barrier,
+)
+from .communication import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, destroy_process_group, all_reduce,
+    all_gather, all_gather_object, broadcast, reduce, scatter, all_to_all,
+    reduce_scatter, send, recv, isend, irecv, batch_isend_irecv, P2POp, wait,
+    stream,
+)
+from .mesh import (  # noqa: F401
+    ProcessMesh, Placement, Replicate, Shard, Partial, shard_tensor, reshard,
+    dtensor_from_fn, get_mesh, set_mesh,
+)
+from .parallel import DataParallel  # noqa: F401
+from .parallelize import parallelize, DistTrainStep, shard_model_state  # noqa: F401
+from . import fcollectives  # noqa: F401
+from . import fleet  # noqa: F401
+from .fleet.sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .auto_parallel import shard_layer, shard_optimizer, to_static_dist  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+    "ParallelEnv", "barrier", "ReduceOp", "Group", "new_group", "get_group",
+    "destroy_process_group", "all_reduce", "all_gather", "all_gather_object",
+    "broadcast", "reduce", "scatter", "all_to_all", "reduce_scatter", "send",
+    "recv", "isend", "irecv", "batch_isend_irecv", "P2POp", "wait", "stream",
+    "ProcessMesh", "Placement", "Replicate", "Shard", "Partial",
+    "shard_tensor", "reshard", "dtensor_from_fn", "get_mesh", "set_mesh",
+    "DataParallel", "parallelize", "DistTrainStep", "fleet",
+    "group_sharded_parallel", "save_group_sharded_model", "shard_layer",
+    "shard_optimizer", "save_state_dict", "load_state_dict",
+]

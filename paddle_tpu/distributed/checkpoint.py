@@ -1,0 +1,86 @@
+"""Distributed sharded checkpointing (reference: auto_parallel/static/
+dist_saver.py DistributedSaver:57 per-rank save + re-merge; converter.py
+re-slices across topologies; incubate/distributed/utils/io/ dist_save).
+
+TPU-native: orbax-backed async sharded save/load — each host writes its
+shards; on load, arrays are resharded to the CURRENT topology (the
+converter.py capability) because restore takes target shardings."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict", "DistributedSaver"]
+
+
+def _to_arrays(state_dict):
+    return {k: (v._value if isinstance(v, Tensor) else v)
+            for k, v in state_dict.items()}
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    async_save=False):
+    """reference distributed/checkpoint/save_state_dict. Uses orbax when the
+    state is device-sharded; plain pickle otherwise."""
+    arrays = _to_arrays(state_dict)
+    try:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        path = os.path.abspath(path)
+        ckptr.save(path, arrays, force=True)
+        ckptr.wait_until_finished()
+        return
+    except Exception:  # noqa: BLE001 — fall back to host gather + pickle
+        from ..framework.io import save
+        host = {k: np.asarray(v) for k, v in arrays.items()}
+        save(host, os.path.join(path, "state.pdparams")
+             if not path.endswith(".pdparams") else path)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0):
+    """Restore INTO ``state_dict``'s tensors, resharding to each target
+    tensor's current sharding (cross-topology reshard-on-load)."""
+    import jax.numpy as jnp
+    targets = {k: v for k, v in state_dict.items() if isinstance(v, Tensor)}
+    try:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        abstract = {
+            k: jax.ShapeDtypeStruct(tuple(v.shape), v._value.dtype,
+                                    sharding=v._value.sharding)
+            for k, v in targets.items()}
+        restored = ckptr.restore(os.path.abspath(path), abstract)
+        for k, v in restored.items():
+            targets[k]._in_place_update(v)
+        return state_dict
+    except FileNotFoundError:
+        raise
+    except Exception:  # noqa: BLE001
+        from ..framework.io import load
+        p = os.path.join(path, "state.pdparams") \
+            if not path.endswith(".pdparams") else path
+        host = load(p, return_numpy=True)
+        for k, v in host.items():
+            if k in targets:
+                t = targets[k]
+                arr = jnp.asarray(v, dtype=t._value.dtype)
+                if hasattr(t._value, "sharding"):
+                    arr = jax.device_put(arr, t._value.sharding)
+                t._in_place_update(arr)
+        return state_dict
+
+
+class DistributedSaver:
+    """reference dist_saver.py:57."""
+
+    def save(self, path, state_dict, **kwargs):
+        save_state_dict(state_dict, path)
+
+    def load(self, path, state_dict, **kwargs):
+        return load_state_dict(state_dict, path)

@@ -1,0 +1,263 @@
+"""Communication API (reference: python/paddle/distributed/communication/ —
+all_reduce/all_gather/all_to_all/broadcast/... over ProcessGroup; C++
+paddle/fluid/distributed/collective/process_group.h:47).
+
+TPU-native split (SURVEY §5 "Distributed communication backend"):
+- HOT PATH: collectives are compiled into programs — use the functional
+  forms (`fcollectives`, lax.psum etc.) inside shard_map/pjit; GSPMD rides
+  ICI. The eager API below is the control-plane / parity surface.
+- EAGER over a device axis: each "rank" is a shard of a device-sharded
+  Tensor in this controller; collectives run as tiny shard_map programs.
+- Cross-host (DCN): jax.experimental.multihost_utils.
+
+ReduceOp / group semantics mirror the reference."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .env import get_rank, get_world_size
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
+           "all_reduce", "all_gather", "all_gather_object", "broadcast",
+           "reduce", "scatter", "all_to_all", "reduce_scatter", "send", "recv",
+           "isend", "irecv", "batch_isend_irecv", "P2POp", "wait", "stream"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_GROUP_COUNTER = [0]
+_GROUPS: dict[int, "Group"] = {}
+
+
+@dataclass
+class Group:
+    """reference: distributed/communication/group.py Group."""
+
+    ranks: list[int] = field(default_factory=list)
+    gid: int = 0
+    pg_timeout: int = 1800
+
+    @property
+    def nranks(self):
+        return len(self.ranks) if self.ranks else get_world_size()
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        return self.get_group_rank(get_rank())
+
+    def get_group_rank(self, global_rank):
+        if not self.ranks:
+            return global_rank
+        return self.ranks.index(global_rank) if global_rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(gid={self.gid}, ranks={self.ranks or 'all'})"
+
+
+_DEFAULT_GROUP = Group(ranks=[], gid=0)
+_GROUPS[0] = _DEFAULT_GROUP
+
+
+def new_group(ranks=None, backend=None, timeout=1800):
+    _GROUP_COUNTER[0] += 1
+    g = Group(ranks=list(ranks) if ranks else [], gid=_GROUP_COUNTER[0],
+              pg_timeout=timeout)
+    _GROUPS[g.gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _GROUPS.get(gid)
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _GROUPS.clear()
+        _GROUPS[0] = _DEFAULT_GROUP
+    else:
+        _GROUPS.pop(group.gid, None)
+
+
+class _Task:
+    """Async task handle (reference ProcessGroup::Task futures); jax dispatch
+    is already async, wait = block_until_ready."""
+
+    def __init__(self, value=None):
+        self._value = value
+
+    def wait(self):
+        if self._value is not None:
+            jax.block_until_ready(self._value)
+
+    def is_completed(self):
+        return True
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(tensor._value)
+
+
+def _single_process(group) -> bool:
+    return (group is None or not group.ranks or len(group.ranks) <= 1) \
+        and get_world_size() == 1
+
+
+def _mh():
+    from jax.experimental import multihost_utils
+    return multihost_utils
+
+
+# -- eager collectives ------------------------------------------------------
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce across processes (reference
+    communication/all_reduce.py)."""
+    if _single_process(group):
+        return _Task(tensor._value)
+    # cross-host: sum over all processes via global broadcast trick
+    mh = _mh()
+    gathered = mh.process_allgather(np.asarray(tensor._value))
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        out = gathered.sum(axis=0)
+        if op == ReduceOp.AVG:
+            out = out / get_world_size(group)
+    elif op == ReduceOp.MAX:
+        out = gathered.max(axis=0)
+    elif op == ReduceOp.MIN:
+        out = gathered.min(axis=0)
+    else:
+        out = gathered.prod(axis=0)
+    tensor._in_place_update(jnp.asarray(out))
+    return _Task(tensor._value)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    if _single_process(group):
+        tensor_list.append(Tensor(tensor._value))
+        return _Task(tensor._value)
+    mh = _mh()
+    gathered = mh.process_allgather(np.asarray(tensor._value))
+    for i in range(gathered.shape[0]):
+        tensor_list.append(Tensor(jnp.asarray(gathered[i])))
+    return _Task(tensor._value)
+
+
+def all_gather_object(object_list, obj, group=None):
+    if _single_process(group):
+        object_list.append(obj)
+        return
+    import pickle
+    mh = _mh()
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # pad to max length across hosts
+    n = np.asarray([payload.size])
+    sizes = mh.process_allgather(n).reshape(-1)
+    maxlen = int(sizes.max())
+    padded = np.zeros(maxlen, np.uint8)
+    padded[:payload.size] = payload
+    all_p = mh.process_allgather(padded)
+    for i in range(all_p.shape[0]):
+        object_list.append(pickle.loads(all_p[i][:int(sizes[i])].tobytes()))
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    if _single_process(group):
+        return _Task(tensor._value)
+    mh = _mh()
+    out = mh.broadcast_one_to_all(np.asarray(tensor._value),
+                                  is_source=get_rank() == src)
+    tensor._in_place_update(jnp.asarray(out))
+    return _Task(tensor._value)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)  # dst also gets the value
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if _single_process(group):
+        if tensor_list:
+            tensor._in_place_update(tensor_list[get_rank()]._value)
+        return _Task(tensor._value)
+    raise NotImplementedError("cross-host eager scatter: use sharded io")
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    if _single_process(group):
+        out_tensor_list.extend(Tensor(t._value) for t in in_tensor_list)
+        return _Task(None)
+    raise NotImplementedError(
+        "cross-host eager all_to_all: the compiled path (fleet MoE) uses "
+        "lax.all_to_all inside shard_map")
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    if _single_process(group):
+        acc = tensor_list[0]._value
+        for t in tensor_list[1:]:
+            acc = acc + t._value
+        tensor._in_place_update(acc)
+        return _Task(tensor._value)
+    raise NotImplementedError("cross-host eager reduce_scatter")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    if _single_process(group):
+        return _Task(None)
+    raise NotImplementedError("host-level p2p: planned over DCN store")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    if _single_process(group):
+        return _Task(None)
+    raise NotImplementedError("host-level p2p: planned over DCN store")
+
+
+isend = send
+irecv = recv
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    return [_Task(None) for _ in p2p_op_list]
+
+
+class stream:
+    """paddle.distributed.stream namespace parity."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    all_to_all = staticmethod(all_to_all)
+    reduce_scatter = staticmethod(reduce_scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

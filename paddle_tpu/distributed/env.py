@@ -1,0 +1,92 @@
+"""Distributed environment (reference: python/paddle/distributed/parallel.py
+init_parallel_env:940, TCPStore rendezvous :1096).
+
+TPU-native: JAX is single-controller per host; multi-host rendezvous is
+jax.distributed.initialize (coordinator = the reference's TCPStore). "rank"
+means host/process index; within a host all local chips belong to this
+process."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+           "ParallelEnv", "barrier"]
+
+_initialized = [False]
+
+
+def init_parallel_env():
+    """reference parallel.py:940. Multi-host: uses PADDLE_* or JAX coord env
+    vars; single-host: no-op (all chips already visible)."""
+    if _initialized[0]:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                os.environ.get("JAX_NUM_PROCESSES", "1")))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                              os.environ.get("JAX_PROCESS_ID", "0")))
+    if coord and nprocs > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nprocs, process_id=rank)
+    _initialized[0] = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def barrier(group=None):
+    """Host-level barrier over DCN (reference ProcessGroup::Barrier)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+class ParallelEnv:
+    """reference: python/paddle/distributed/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()

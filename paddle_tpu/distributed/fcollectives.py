@@ -1,0 +1,110 @@
+"""Functional collectives — the compiled hot path.
+
+These are the TPU-native replacements for the reference's c_* collective
+kernels (paddle/fluid/operators/collective/, 107 files): pure functions over
+named mesh axes, used inside shard_map/pjit programs where XLA schedules
+them onto ICI. Each also records on the autograd tape so eager-style code
+composed of shard_map regions differentiates correctly."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import defop
+from ..core.tensor import Tensor
+
+__all__ = ["psum", "pmean", "pmax", "pmin", "all_gather_axis",
+           "reduce_scatter_axis", "all_to_all_axis", "ppermute_axis",
+           "axis_index", "axis_size"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+@defop("c_allreduce_sum")
+def _psum(x, axis_name):
+    return lax.psum(x, axis_name)
+
+
+def psum(x, axis_name):
+    return _psum(_t(x), axis_name=axis_name)
+
+
+@defop("c_allreduce_mean")
+def _pmean(x, axis_name):
+    return lax.pmean(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return _pmean(_t(x), axis_name=axis_name)
+
+
+@defop("c_allreduce_max")
+def _pmax(x, axis_name):
+    return lax.pmax(x, axis_name)
+
+
+def pmax(x, axis_name):
+    return _pmax(_t(x), axis_name=axis_name)
+
+
+@defop("c_allreduce_min")
+def _pmin(x, axis_name):
+    return lax.pmin(x, axis_name)
+
+
+def pmin(x, axis_name):
+    return _pmin(_t(x), axis_name=axis_name)
+
+
+@defop("c_allgather")
+def _all_gather(x, axis_name, axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def all_gather_axis(x, axis_name, axis=0, tiled=True):
+    return _all_gather(_t(x), axis_name=axis_name, axis=axis, tiled=tiled)
+
+
+@defop("c_reducescatter")
+def _reduce_scatter(x, axis_name, axis=0, tiled=True):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=tiled)
+
+
+def reduce_scatter_axis(x, axis_name, axis=0, tiled=True):
+    return _reduce_scatter(_t(x), axis_name=axis_name, axis=axis, tiled=tiled)
+
+
+@defop("alltoall")
+def _all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def all_to_all_axis(x, axis_name, split_axis=0, concat_axis=0, tiled=True):
+    """MoE dispatch primitive (reference global_scatter/global_gather ops)."""
+    return _all_to_all(_t(x), axis_name=axis_name, split_axis=split_axis,
+                       concat_axis=concat_axis, tiled=tiled)
+
+
+@defop("ppermute")
+def _ppermute(x, axis_name, perm):
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def ppermute_axis(x, axis_name, perm):
+    """Neighbor shift over ICI — pipeline p2p and ring attention building
+    block (reference p2p_communication.py send/recv)."""
+    return _ppermute(_t(x), axis_name=axis_name, perm=tuple(map(tuple, perm)))
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.axis_size(axis_name) if hasattr(lax, "axis_size") \
+        else lax.psum(1, axis_name)

@@ -1,0 +1,39 @@
+"""paddle_tpu.distributed.fleet (reference: python/paddle/distributed/fleet)."""
+
+from .base import (  # noqa: F401
+    DistributedStrategy, CommunicateTopology, HybridCommunicateGroup,
+    ParallelMode,
+)
+from .fleet import (  # noqa: F401
+    fleet, init, Fleet, distributed_model, distributed_optimizer,
+    get_hybrid_communicate_group, worker_index, worker_num, is_first_worker,
+)
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy, get_rng_state_tracker, RNGStatesTracker,
+    model_parallel_random_seed, shard_hint,
+)
+from .hybrid_optimizer import HybridParallelOptimizer, HybridParallelClipGrad  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    DataParallelModel, TensorParallel, PipelineParallel,
+    PipelineParallelWithInterleave, ShardingParallel, SegmentParallel,
+)
+from .pipeline import LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer, spmd_pipeline  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .sharding import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model, DygraphShardingOptimizer,
+    GroupShardedStage2, GroupShardedStage3, apply_sharding_specs,
+)
+from .moe import MoELayer, NaiveGate, GShardGate, SwitchGate  # noqa: F401
+from . import sequence_parallel  # noqa: F401
+from . import utils  # noqa: F401
+
+__all__ = [
+    "DistributedStrategy", "CommunicateTopology", "HybridCommunicateGroup",
+    "ParallelMode", "fleet", "init", "Fleet", "distributed_model",
+    "distributed_optimizer", "get_hybrid_communicate_group", "worker_index",
+    "worker_num", "is_first_worker", "VocabParallelEmbedding",
+    "ColumnParallelLinear", "RowParallelLinear", "ParallelCrossEntropy",
+    "get_rng_state_tracker", "HybridParallelOptimizer", "LayerDesc",
+    "PipelineLayer", "recompute", "group_sharded_parallel", "MoELayer",
+]

@@ -1,0 +1,259 @@
+"""Fleet base: DistributedStrategy + hybrid topology.
+
+Reference: python/paddle/distributed/fleet/base/distributed_strategy.py (155
+proto-backed properties, framework/distributed_strategy.proto) and
+fleet/base/topology.py (CommunicateTopology:61, HybridCommunicateGroup:174,
+axes order ['dp','pp','sharding','sep','mp']).
+
+TPU-native: the cartesian process topology IS a device mesh; each hybrid
+axis becomes a named mesh axis and "comm groups" become named-axis handles
+(collectives compile onto ICI instead of building NCCL rings)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["DistributedStrategy", "CommunicateTopology",
+           "HybridCommunicateGroup", "ParallelMode"]
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class _Config(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError as e:
+            raise AttributeError(k) from e
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class DistributedStrategy:
+    """Config object (reference DistributedStrategy). Holds the same knobs;
+    unknown ones are accepted and kept for recipe compatibility."""
+
+    def __init__(self):
+        self.hybrid_configs = _Config(
+            dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
+            sep_degree=1, order=["dp", "pp", "sharding", "sep", "mp"])
+        self.amp = False
+        self.amp_configs = _Config(init_loss_scaling=32768.0, use_pure_fp16=False,
+                                   use_fp16_guard=True, custom_white_list=[],
+                                   custom_black_list=[])
+        self.recompute = False
+        self.recompute_configs = _Config(checkpoints=[])
+        self.sharding = False
+        self.sharding_configs = _Config(stage=1, degree=8,
+                                        segment_broadcast_MB=32.0)
+        self.pipeline = False
+        self.pipeline_configs = _Config(accumulate_steps=1,
+                                        micro_batch_size=1,
+                                        schedule_mode="1F1B")
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = _Config(tensor_parallel_degree=1)
+        self.gradient_merge = False
+        self.gradient_merge_configs = _Config(k_steps=1, avg=True)
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.gradient_scale_configs = _Config(scale_strategy="avg")
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.without_graph_optimization = False
+
+    def _set_hybrid(self, **kw):
+        self.hybrid_configs.update(kw)
+
+    def __setattr__(self, k, v):
+        if k == "hybrid_configs" and isinstance(v, dict) \
+                and not isinstance(v, _Config):
+            cfg = self.__dict__.get("hybrid_configs", _Config())
+            cfg.update(v)
+            object.__setattr__(self, k, cfg)
+        else:
+            object.__setattr__(self, k, v)
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={dict(self.hybrid_configs)})"
+
+
+class CommunicateTopology:
+    """reference topology.py:61 — cartesian coordinate system over hybrid
+    axes."""
+
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or
+                                    ["data", "pipe", "sharding", "sep", "model"])
+        self._dims = list(dims or [1, 1, 1, 1, 1])
+        self.coordinate = None
+        self._world = np.arange(int(np.prod(self._dims))).reshape(self._dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **args):
+        coord = tuple(args[name] for name in self._parallel_names)
+        return int(self._world[coord])
+
+    def get_coord(self, rank):
+        pos = np.argwhere(self._world == rank)[0]
+        return dict(zip(self._parallel_names, (int(p) for p in pos)))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[axis] = index
+        return sorted(int(r) for r in self._world[tuple(sl)].reshape(-1))
+
+    def get_comm_list(self, axis_name):
+        """All groups along axis_name: lists of ranks varying only in that
+        axis (reference get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._world, axis, -1)
+        return [list(map(int, row)) for row in moved.reshape(-1, self._dims[axis])]
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
+
+class HybridCommunicateGroup:
+    """reference topology.py:174. Built from a DistributedStrategy; exposes
+    per-axis ranks/degrees and the device mesh the axes live on."""
+
+    def __init__(self, topology: CommunicateTopology, rank: int | None = None):
+        from ..env import get_rank
+        self._topo = topology
+        self.global_rank = get_rank() if rank is None else rank
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep")
+        self._mp_degree = topology.get_dim("model")
+        coord = topology.get_coord(self.global_rank)
+        self._dp_rank = coord["data"]
+        self._pp_rank = coord["pipe"]
+        self._sharding_rank = coord["sharding"]
+        self._sep_rank = coord["sep"]
+        self._mp_rank = coord["model"]
+
+    # mesh view -----------------------------------------------------------
+    def get_mesh(self):
+        """The hybrid topology as a ProcessMesh with named axes (drop
+        degree-1 axes for a clean PartitionSpec namespace)."""
+        from ..mesh import ProcessMesh
+        name_map = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+                    "sep": "sep", "model": "mp"}
+        names, dims = [], []
+        for n in self._topo.get_hybrid_group_names():
+            d = self._topo.get_dim(n)
+            names.append(name_map.get(n, n))
+            dims.append(d)
+        return ProcessMesh(shape=dims, dim_names=names)
+
+    # parity accessors ------------------------------------------------------
+    def get_parallel_mode(self):
+        if self._mp_degree > 1 or self._pp_degree > 1 or self._sep_degree > 1:
+            if self._pp_degree > 1:
+                return ParallelMode.PIPELINE_PARALLEL
+            if self._mp_degree > 1:
+                return ParallelMode.TENSOR_PARALLEL
+            return ParallelMode.SEGMENT_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    def get_data_parallel_rank(self):
+        return self._dp_rank
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_rank(self):
+        return self._mp_rank
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_stage_id(self):
+        return self._pp_rank
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_rank(self):
+        return self._sharding_rank
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_rank(self):
+        return self._sep_rank
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def _axis_group(self, axis):
+        from ..communication import new_group
+        ranks = self._topo.get_axis_list(
+            axis, self._topo.get_coord(self.global_rank)[axis])
+        return new_group(ranks)
+
+    def get_data_parallel_group(self):
+        return self._axis_group("data")
+
+    def get_model_parallel_group(self):
+        return self._axis_group("model")
+
+    def get_pipe_parallel_group(self):
+        return self._axis_group("pipe")
+
+    def get_sharding_parallel_group(self):
+        return self._axis_group("sharding")
+
+    def get_sep_parallel_group(self):
+        return self._axis_group("sep")
+
+    def get_check_parallel_group(self, *a, **k):
+        from ..communication import new_group
+        return new_group([])
+
+    def get_data_parallel_group_src_rank(self):
+        return self._topo.get_axis_list("data", 0)[0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._topo.get_axis_list("model", 0)[0]
+
+    def topology(self):
+        return self._topo
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank,
+                                              pipe=stage_id, **kwargs)
+
+    # pipeline neighbors
+    def is_first_stage(self):
+        return self._pp_rank == 0
+
+    def is_last_stage(self):
+        return self._pp_rank == self._pp_degree - 1

@@ -1,0 +1,108 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py —
+init:170, distributed_model (model.py:31, dispatch :131-165),
+distributed_optimizer :1060)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ..env import get_rank, get_world_size, init_parallel_env
+from .base import (CommunicateTopology, DistributedStrategy,
+                   HybridCommunicateGroup, ParallelMode)
+
+__all__ = ["init", "fleet", "Fleet", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_index", "worker_num", "is_first_worker"]
+
+
+class Fleet:
+    def __init__(self):
+        self._is_initialized = False
+        self._hcg: HybridCommunicateGroup | None = None
+        self._strategy: DistributedStrategy | None = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        """reference fleet.py:170."""
+        init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        dims = [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+                hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+                hc.get("mp_degree", 1)]
+        topo = CommunicateTopology(
+            ["data", "pipe", "sharding", "sep", "model"], dims)
+        self._hcg = HybridCommunicateGroup(topo)
+        from ..mesh import set_mesh
+        set_mesh(self._hcg.get_mesh())
+        self._is_initialized = True
+        return self
+
+    @property
+    def worker_index(self):
+        return get_rank()
+
+    @property
+    def worker_num(self):
+        return get_world_size()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def distributed_model(self, model):
+        """reference fleet/model.py:31. Wraps the model per the active
+        parallel mode (on TPU: annotates specs + shards state)."""
+        from ..parallelize import shard_model_state
+        if self._hcg is None:
+            self.init()
+        mesh = self._hcg.get_mesh()
+        mode = self._hcg.get_parallel_mode()
+        from .meta_parallel import (DataParallelModel, PipelineParallel,
+                                    SegmentParallel, ShardingParallel,
+                                    TensorParallel)
+        wrapper = {
+            ParallelMode.DATA_PARALLEL: DataParallelModel,
+            ParallelMode.TENSOR_PARALLEL: TensorParallel,
+            ParallelMode.PIPELINE_PARALLEL: PipelineParallel,
+            ParallelMode.SHARDING_PARALLEL: ShardingParallel,
+            ParallelMode.SEGMENT_PARALLEL: SegmentParallel,
+        }[mode]
+        wrapped = wrapper(model, self._hcg, strategy=self._strategy)
+        shard_model_state(wrapped, mesh)
+        return wrapped
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """reference fleet.py:1060 → HybridParallelOptimizer."""
+        from .hybrid_optimizer import HybridParallelOptimizer
+        if self._hcg is None:
+            self.init(strategy=strategy)
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       self._strategy)
+
+    def barrier_worker(self):
+        from ..env import barrier
+        barrier()
+
+    def stop_worker(self):
+        pass
+
+
+fleet = Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def is_first_worker():
+    return get_rank() == 0

@@ -1,0 +1,59 @@
+"""HybridParallelOptimizer + grad clip across axes (reference:
+fleet/meta_parallel/.../hybrid_parallel_optimizer.py:254 and
+HybridParallelClipGrad:43 — global-norm allreduced across dp/mp/pp/sharding).
+
+On TPU the compiled step computes the clip inside the program: grads are
+global arrays (GSPMD), so a plain global-norm clip IS the cross-axis clip —
+no manual allreduce chain."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn.clip import ClipGradByGlobalNorm
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelClipGrad"]
+
+
+class HybridParallelClipGrad(ClipGradByGlobalNorm):
+    """reference hybrid_parallel_optimizer.py:43."""
+
+    def __init__(self, clip, hcg=None):
+        clip_norm = clip.clip_norm if hasattr(clip, "clip_norm") else float(clip)
+        super().__init__(clip_norm)
+        self._hcg = hcg
+
+
+class HybridParallelOptimizer:
+    """reference :254 — wraps the inner optimizer; under hybrid parallelism
+    rewrites the grad clip to the cross-axis variant and (stage-1 sharding)
+    partitions optimizer state."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if optimizer._grad_clip is not None and not isinstance(
+                optimizer._grad_clip, HybridParallelClipGrad):
+            optimizer._grad_clip = HybridParallelClipGrad(
+                optimizer._grad_clip, hcg)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner_opt.minimize(loss, startup_program, parameters,
+                                        no_grad_set)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)

@@ -1,0 +1,138 @@
+"""Mixture-of-Experts with expert parallelism (reference:
+python/paddle/incubate/distributed/models/moe/moe_layer.py:263 MoELayer,
+MoEScatter:99/MoEGather:149 PyLayers, gates in moe/gate/, native all2all
+dispatch global_scatter_op.cc/global_gather_op.cc).
+
+TPU-native: capacity-bucketed dense dispatch — tokens are combined into
+[experts, capacity, d] via one-hot matmuls (MXU-friendly, no dynamic
+shapes), experts run batched, and under an 'ep' mesh axis the expert dim is
+sharded so XLA inserts the all-to-all the reference issued manually."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import defop
+from ...core.tensor import Tensor
+from ... import nn
+from .mp_layers import shard_hint
+
+__all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate",
+           "moe_dispatch_combine"]
+
+
+class NaiveGate(nn.Layer):
+    """reference moe/gate/naive_gate.py: linear router, top-k."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__()
+        self.gate = nn.Linear(d_model, num_expert * world_size)
+        self.top_k = topk
+
+    def forward(self, x):
+        return self.gate(x)
+
+
+class GShardGate(NaiveGate):
+    """reference moe/gate/gshard_gate.py: adds aux load-balancing loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.capacity = capacity
+
+
+class SwitchGate(NaiveGate):
+    """reference moe/gate/switch_gate.py: top-1 routing."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk)
+
+
+@defop("moe_dispatch")
+def _dispatch(x, logits, num_experts, capacity, top_k):
+    """tokens [N, d], logits [N, E] -> (expert_inputs [E, C, d],
+    combine_weights [N, E, C], aux_loss). Dense Switch/GShard-style dispatch."""
+    N, d = x.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, top_k)            # [N, k]
+    # position of each token within its expert's buffer, per k-choice
+    onehot = jax.nn.one_hot(topi, num_experts, dtype=jnp.float32)  # [N,k,E]
+    # priority: earlier tokens first; cumsum over tokens per expert
+    pos_in_expert = (jnp.cumsum(onehot.sum(1), axis=0) - onehot.sum(1))  # [N,E]
+    keep = pos_in_expert < capacity                                     # [N,E]
+    disp = onehot * keep[:, None, :]                    # [N,k,E]
+    gates = topv[..., None] * disp                      # [N,k,E]
+    denom = gates.sum(axis=(1, 2), keepdims=True)
+    gates = gates / jnp.maximum(denom, 1e-9)
+    pos = jnp.einsum("nke,ne->nke", disp, pos_in_expert)  # clipped positions
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=jnp.float32) * disp[..., None]  # [N,k,E,C]
+    combine = jnp.einsum("nke,nkec->nec", gates, pos_oh)  # [N,E,C]
+    dispatch_mask = (combine > 0).astype(x.dtype)
+    expert_inputs = jnp.einsum("nec,nd->ecd", dispatch_mask, x)
+    # GShard aux loss: mean_prob * mean_assignment per expert
+    me = probs.mean(axis=0)
+    ce = onehot.sum(1).mean(axis=0)
+    aux = (me * ce).sum() * num_experts
+    return expert_inputs, combine.astype(x.dtype), aux.astype(x.dtype)
+
+
+@defop("moe_combine")
+def _combine(expert_outputs, combine_weights):
+    # expert_outputs [E, C, d], combine [N, E, C] -> [N, d]
+    return jnp.einsum("ecd,nec->nd", expert_outputs, combine_weights)
+
+
+def moe_dispatch_combine(x, logits, num_experts, capacity, top_k):
+    return _dispatch(x, logits, num_experts=num_experts, capacity=capacity,
+                     top_k=top_k)
+
+
+class MoELayer(nn.Layer):
+    """reference moe_layer.py:263. gate → dispatch (all2all over 'ep') →
+    expert FFN (batched) → gather.
+
+    ``experts`` is a list of expert Layers with identical structure; their
+    parameters are stacked into [E, ...] buffers so one batched einsum runs
+    all experts (vmap-style), and the E dim shards over the 'ep' axis."""
+
+    def __init__(self, d_model=None, experts=None, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, top_k=2,
+                 capacity_factor=1.25, **kwargs):
+        super().__init__()
+        if isinstance(gate, dict):
+            gate_type = gate.get("type", "gshard")
+            cls = {"naive": NaiveGate, "gshard": GShardGate,
+                   "switch": SwitchGate}[gate_type]
+            gate = cls(d_model, len(experts), topk=gate.get("top_k", top_k))
+        self.gate = gate or NaiveGate(d_model, len(experts), topk=top_k)
+        self.experts = nn.LayerList(experts)
+        self.num_experts = len(experts)
+        self.top_k = getattr(self.gate, "top_k", top_k)
+        self.capacity_factor = capacity_factor
+
+    def forward(self, x):
+        orig_shape = x.shape
+        from ...ops.manipulation import reshape
+        d = orig_shape[-1]
+        x2 = reshape(x, [-1, d])
+        n_tokens = x2.shape[0]
+        capacity = max(1, int(self.capacity_factor * n_tokens
+                              * self.top_k / self.num_experts))
+        logits = self.gate(x2)
+        expert_in, combine, aux = moe_dispatch_combine(
+            x2, logits, self.num_experts, capacity, self.top_k)
+        # shard expert dim over 'ep' (all-to-all inserted by GSPMD)
+        expert_in = shard_hint(expert_in, "ep", None, None)
+        outs = []
+        for i, expert in enumerate(self.experts):
+            outs.append(expert(expert_in[i]))
+        from ...ops.manipulation import stack
+        expert_out = stack(outs, axis=0)
+        expert_out = shard_hint(expert_out, "ep", None, None)
+        y = _combine(expert_out, combine)
+        self.l_aux = aux
+        return reshape(y, orig_shape)

@@ -1,0 +1,258 @@
+"""Tensor-parallel layers (reference: python/paddle/distributed/fleet/layers/
+mpu/mp_layers.py — VocabParallelEmbedding:47, ColumnParallelLinear:326,
+RowParallelLinear:533, ParallelCrossEntropy:734; comm prims mp_ops.py).
+
+TPU-native: instead of explicitly slicing weights per rank and issuing NCCL
+collectives (identity-fwd/allreduce-bwd PyLayers), each parameter carries a
+PartitionSpec over the 'mp' mesh axis and activations get sharding hints;
+GSPMD partitions the matmuls and inserts the same collectives the reference
+hand-wrote — but fused into the program and overlapped by XLA's scheduler.
+The module-level ``sharding_ctx`` is how hints apply only under a mesh."""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.dispatch import defop
+from ...core.tensor import Tensor
+from ... import nn
+from ...nn import functional as F
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy", "shard_hint",
+           "sharding_ctx", "current_mesh", "RNGStatesTracker",
+           "get_rng_state_tracker", "model_parallel_random_seed"]
+
+
+class _MeshCtx(threading.local):
+    def __init__(self):
+        self.mesh = None  # jax.sharding.Mesh
+
+
+_CTX = _MeshCtx()
+
+
+@contextmanager
+def sharding_ctx(jax_mesh):
+    """Activate a mesh so shard_hint emits with_sharding_constraint.
+    DistTrainStep enters this around tracing."""
+    prev = _CTX.mesh
+    _CTX.mesh = jax_mesh
+    try:
+        yield
+    finally:
+        _CTX.mesh = prev
+
+
+def current_mesh():
+    return _CTX.mesh
+
+
+def _filter_spec(spec_axes, mesh) -> P:
+    names = set(mesh.axis_names)
+    def keep(a):
+        if a is None:
+            return None
+        if isinstance(a, (tuple, list)):
+            kept = tuple(x for x in a if x in names and mesh.shape[x] > 1)
+            return kept if kept else None
+        return a if a in names and mesh.shape[a] > 1 else None
+    return P(*[keep(a) for a in spec_axes])
+
+
+def shard_hint_raw(a, spec, mesh):
+    """with_sharding_constraint on a raw jax array, normalizing the spec to
+    the array's rank. Specs are written for [batch, seq, hidden]; lower-rank
+    arrays keep the first (batch) and last (feature) axes of the spec."""
+    if mesh is None:
+        return a
+    spec = tuple(spec)
+    if len(spec) != a.ndim:
+        if a.ndim == 0:
+            spec = ()
+        elif a.ndim == 1:
+            spec = (spec[-1],)
+        elif len(spec) > a.ndim:
+            spec = (spec[0],) + (None,) * (a.ndim - 2) + (spec[-1],)
+        else:
+            spec = spec + (None,) * (a.ndim - len(spec))
+    return jax.lax.with_sharding_constraint(
+        a, NamedSharding(mesh, _filter_spec(spec, mesh)))
+
+
+@defop("shard_hint")
+def _shard_hint(x, spec_axes, mesh):
+    return shard_hint_raw(x, spec_axes, mesh)
+
+
+def shard_hint(x, *spec_axes):
+    """Annotate activation sharding (GSPMD hint). Identity without a mesh."""
+    mesh = _CTX.mesh
+    t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    if mesh is None:
+        return t
+    return _shard_hint(t, spec_axes=tuple(spec_axes), mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# Parallel RNG (reference mpu/random.py RNGStatesTracker:34)
+# ---------------------------------------------------------------------------
+class RNGStatesTracker:
+    """Named RNG states so e.g. dropout is identical across mp ranks for
+    replicated activations and distinct for sharded ones. With counter-based
+    JAX PRNG a state is just a key."""
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states_:
+            self.add(name, hash(name) % (2 ** 31))
+        from ...ops import random as R
+        prev = R.default_generator._key
+        R.default_generator._key = self.states_[name]
+        try:
+            yield
+        finally:
+            self.states_[name] = R.default_generator._key
+            R.default_generator._key = prev
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+    seed = seed or pyrandom.randint(0, 2 ** 31 - 1)
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add("model_parallel_rng", seed)
+
+
+# ---------------------------------------------------------------------------
+# Layers
+# ---------------------------------------------------------------------------
+class VocabParallelEmbedding(nn.Layer):
+    """reference mp_layers.py:47. Vocab dim sharded over 'mp'; GSPMD turns
+    the gather into per-shard lookup + psum (the reference's masked lookup +
+    allreduce)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        from .. import env
+        self._num_embeddings = num_embeddings
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        self.weight._dist_spec = ("mp", None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return shard_hint(out, "dp", None, None)
+
+
+class ColumnParallelLinear(nn.Layer):
+    """reference mp_layers.py:326. Weight [in, out] sharded on out ('mp');
+    output stays mp-sharded unless gather_output."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        self.weight._dist_spec = (None, "mp")
+        if has_bias in (True, None):
+            self.bias = self.create_parameter(shape=[out_features],
+                                              is_bias=True)
+            self.bias._dist_spec = ("mp",)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self._gather_output:
+            return shard_hint(out, "dp", None, None)
+        return shard_hint(out, "dp", None, "mp")
+
+
+class RowParallelLinear(nn.Layer):
+    """reference mp_layers.py:533. Weight [in, out] sharded on in ('mp');
+    partial output reduced by GSPMD (the reference's allreduce)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        self.weight._dist_spec = ("mp", None)
+        if has_bias:
+            self.bias = self.create_parameter(shape=[out_features],
+                                              is_bias=True)
+            self.bias._dist_spec = (None,)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if not self._input_is_parallel:
+            x = shard_hint(x, "dp", None, "mp")
+        out = F.linear(x, self.weight, self.bias)
+        return shard_hint(out, "dp", None, None)
+
+
+@defop("parallel_cross_entropy")
+def _parallel_ce(logits, label, ignore_index):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ids = label.astype(jnp.int32)
+    valid = ids != ignore_index
+    safe = jnp.where(valid, ids, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.where(valid, -picked, 0.0)[..., None]
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """reference mp_layers.py:734 (_c_softmax_with_cross_entropy). With the
+    logits mp-sharded on vocab, GSPMD partitions the softmax reduction the
+    way the reference's fused kernel + allreduce-of-max/sum did."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self._ignore_index = ignore_index
+
+    def forward(self, input, label):
+        lbl = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+        return _parallel_ce(input, lbl, ignore_index=self._ignore_index)

@@ -1,0 +1,140 @@
+"""Activation recomputation (reference: python/paddle/distributed/fleet/
+utils/recompute/recompute.py — RecomputeFunction:108, recompute:404,
+recompute_sequential:535).
+
+TPU-native: jax.checkpoint (remat) — residuals are dropped and the forward
+replays in backward; XLA fuses the replay into the backward program (the
+reference re-ran eager forward under a saved RNG state)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def _collect_layer(fn):
+    from ...nn.layer.layers import Layer
+    if isinstance(fn, Layer):
+        return fn, fn.forward
+    if hasattr(fn, "__self__") and isinstance(fn.__self__, Layer):
+        return fn.__self__, fn
+    return None, fn
+
+
+def recompute(function: Callable, *args, use_reentrant=True,
+              preserve_rng_state=True, **kwargs):
+    """Run ``function(*args)`` without saving intermediates; recompute them
+    during backward. ``function`` may be a Layer (its parameters become
+    differentiable primals) or any Tensor function."""
+    layer, callable_fn = _collect_layer(function)
+    params = list(layer.parameters()) if layer is not None else []
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other_args = [(i, a) for i, a in enumerate(args)
+                  if not isinstance(a, Tensor)]
+
+    def pure(*flat):
+        p_vals = flat[:len(params)]
+        in_vals = flat[len(params):]
+        saved = [(p, p._value, p._grad_node, p._out_index) for p in params]
+        try:
+            for p, v in zip(params, p_vals):
+                p._value = v
+                p._grad_node = None
+            rebuilt = []
+            it = iter(in_vals)
+            for i in range(len(args)):
+                match = next((a for j, a in other_args if j == i), None)
+                if match is not None:
+                    rebuilt.append(match)
+                else:
+                    rebuilt.append(Tensor(next(it)))
+            for t, orig in zip([r for r in rebuilt if isinstance(r, Tensor)],
+                               tensor_args):
+                t.stop_gradient = orig.stop_gradient
+            out = callable_fn(*rebuilt, **kwargs)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._value if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._value
+        finally:
+            for p, v, n, i in saved:
+                p._value = v
+                p._grad_node = n
+                p._out_index = i
+
+    ckpt = jax.checkpoint(pure)
+    return apply_op("recompute", ckpt, tuple(params) + tuple(tensor_args), {})
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference recompute.py:535 — checkpoint a Sequential in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    from ...nn.layer.layers import Sequential
+    if isinstance(functions, Sequential):
+        layers = list(functions._sub_layers.values())
+    else:
+        layers = list(functions)
+    n = len(layers)
+    seg_size = max(1, n // segments)
+    x = args[0]
+    i = 0
+    while i < n:
+        chunk = layers[i:i + seg_size]
+
+        class _Chunk:
+            def __init__(self, ls):
+                self.ls = ls
+
+            def parameters(self):
+                out = []
+                for l in self.ls:
+                    out.extend(l.parameters())
+                return out
+
+            def __call__(self, x):
+                for l in self.ls:
+                    x = l(x)
+                return x
+
+        holder = _Chunk(chunk)
+
+        def fwd(x, _h=holder):
+            return _h(x)
+        fwd.__self__ = None
+        # route through recompute with explicit params
+        x = _recompute_with_params(holder.parameters(), holder, x)
+        i += seg_size
+    return x
+
+
+def _recompute_with_params(params, callable_fn, *tensor_args):
+    def pure(*flat):
+        p_vals = flat[:len(params)]
+        in_vals = flat[len(params):]
+        saved = [(p, p._value, p._grad_node, p._out_index) for p in params]
+        try:
+            for p, v in zip(params, p_vals):
+                p._value = v
+                p._grad_node = None
+            rebuilt = [Tensor(v) for v in in_vals]
+            for t, orig in zip(rebuilt, tensor_args):
+                t.stop_gradient = orig.stop_gradient
+            out = callable_fn(*rebuilt)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._value if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._value
+        finally:
+            for p, v, n, i in saved:
+                p._value = v
+                p._grad_node = n
+                p._out_index = i
+
+    ckpt = jax.checkpoint(pure)
+    return apply_op("recompute", ckpt, tuple(params) + tuple(tensor_args), {})

@@ -1,0 +1,107 @@
+"""Sequence parallelism utilities (reference: fleet/utils/
+sequence_parallel_utils.py — scatter/allgather/reduce-scatter PyLayers
+:83-141, ColumnSequenceParallelLinear:228, RowSequenceParallelLinear:338,
+mark_as_sequence_parallel_parameter:146).
+
+TPU-native: Megatron-SP = activations sharded on the sequence dim over the
+'mp' axis between TP regions — a sharding annotation; GSPMD inserts the
+allgather before column-parallel matmuls and reduce-scatter after
+row-parallel ones. The 'sep' long-context axis (SegmentParallel) is handled
+in paddle_tpu.distributed.sep (ring attention / all-to-all)."""
+
+from __future__ import annotations
+
+from ...core.tensor import Tensor
+from ... import nn
+from ...nn import functional as F
+from .mp_layers import shard_hint
+
+__all__ = ["scatter", "all_gather", "mark_as_sequence_parallel_parameter",
+           "is_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "GatherOp", "ScatterOp", "AllGatherOp", "ReduceScatterOp"]
+
+
+def scatter(input):
+    """Split activations along seq dim across mp ranks (reference :83
+    ScatterOp) — here a resharding hint [b, s/mp, h]."""
+    return shard_hint(input, "dp", "mp", None)
+
+
+def all_gather(input):
+    """Gather seq-sharded activations (reference AllGatherOp)."""
+    return shard_hint(input, "dp", None, None)
+
+
+GatherOp = AllGatherOp = type("AllGatherOp", (), {"apply": staticmethod(all_gather)})
+ScatterOp = type("ScatterOp", (), {"apply": staticmethod(scatter)})
+ReduceScatterOp = type("ReduceScatterOp", (), {"apply": staticmethod(scatter)})
+
+
+_SP_PARAMS: set[int] = set()
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """reference :146 — LN/bias params replicated across mp but living in
+    the SP region; under GSPMD their grads are already correctly psummed, we
+    keep the mark for parity and checkpoint tools."""
+    _SP_PARAMS.add(id(parameter))
+
+
+def is_sequence_parallel_parameter(parameter):
+    return id(parameter) in _SP_PARAMS
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """reference :190 — no-op under GSPMD (grad reduction compiled in);
+    kept for recipe compatibility."""
+    return model
+
+
+class ColumnSequenceParallelLinear(nn.Layer):
+    """reference :228 — input seq-sharded, allgather(seq) then column matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        self.weight._dist_spec = (None, "mp")
+        if has_bias in (True, None):
+            self.bias = self.create_parameter(shape=[out_features], is_bias=True)
+            self.bias._dist_spec = ("mp",)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        x = all_gather(x)  # [b, s, h] replicated on seq
+        out = F.linear(x, self.weight, self.bias)
+        if self._gather_output:
+            return shard_hint(out, "dp", None, None)
+        return shard_hint(out, "dp", None, "mp")
+
+
+class RowSequenceParallelLinear(nn.Layer):
+    """reference :338 — row matmul then reduce-scatter onto seq dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        self.weight._dist_spec = ("mp", None)
+        if has_bias:
+            self.bias = self.create_parameter(shape=[out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        return scatter(out)  # reduce-scatter onto seq dim

@@ -1,0 +1,59 @@
+"""fleet.utils (reference: fleet/utils/hybrid_parallel_util.py —
+broadcast_dp_parameters:221, fused_allreduce_gradients:241,
+broadcast_sharding_parameters:265; tensor_fusion_helper.py;
+mix_precision_utils.py main-grad fp32).
+
+Under GSPMD most of these are no-ops kept for recipe compatibility: param
+broadcast/grad fusion happen inside the compiled step."""
+
+from __future__ import annotations
+
+from ..communication import broadcast
+from ..env import get_world_size
+
+__all__ = ["broadcast_dp_parameters", "broadcast_mp_parameters",
+           "broadcast_sharding_parameters", "broadcast_sep_parameters",
+           "fused_allreduce_gradients", "mix_precision_utils", "recompute"]
+
+
+def broadcast_dp_parameters(model, hcg):
+    """reference :221 — on TPU params are global arrays; replication is the
+    sharding, nothing to send."""
+    if get_world_size() > 1:
+        for p in model.parameters():
+            broadcast(p, src=0)
+
+
+def broadcast_mp_parameters(model, hcg):
+    return None
+
+
+def broadcast_sharding_parameters(model, hcg):
+    return None
+
+
+def broadcast_sep_parameters(model, hcg):
+    return None
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """reference :241 — grads already globally reduced by GSPMD when the
+    loss was computed over a dp-sharded batch."""
+    return None
+
+
+class mix_precision_utils:
+    """reference mix_precision_utils.py MixPrecisionLayer/Optimizer — fp32
+    main-grad accumulation. Our optimizers keep fp32 moments + optional
+    master weights (multi_precision=True), so these are identity wrappers."""
+
+    class MixPrecisionLayer:
+        def __new__(cls, layer, dtype="float16"):
+            return layer
+
+    class MixPrecisionOptimizer:
+        def __new__(cls, optimizer):
+            return optimizer
+
+
+from .recompute import recompute  # noqa: E402  (reference re-exports here)

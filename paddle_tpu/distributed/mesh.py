@@ -1,0 +1,249 @@
+"""ProcessMesh / placements / DistTensor attrs.
+
+Reference analogue: paddle/phi/core/distributed/auto_parallel/
+(DistTensor dist_tensor.h:26, ProcessMesh process_mesh.h:31, placements) and
+python/paddle/distributed/auto_parallel/api.py (shard_tensor:94, reshard:202).
+
+TPU-native: a ProcessMesh wraps jax.sharding.Mesh; placements map 1:1 onto
+PartitionSpec axes; "reshard" is jax.device_put with a new NamedSharding —
+XLA inserts the collective (the reference hand-wrote r_to_s/s_to_r/p_to_r...
+reshard functions; GSPMD derives them)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+
+__all__ = ["ProcessMesh", "Placement", "Replicate", "Shard", "Partial",
+           "shard_tensor", "reshard", "dtensor_from_fn", "get_mesh",
+           "set_mesh", "to_partition_spec", "placements_to_spec"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Partial(Placement):
+    """Pending-reduction placement (reference partial status in
+    TensorDistAttr). GSPMD materializes the reduction on the next reshard."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial)
+
+    def __hash__(self):
+        return hash("partial")
+
+
+class ProcessMesh:
+    """reference: python/paddle/distributed/auto_parallel/process_mesh.py."""
+
+    def __init__(self, mesh=None, dim_names: Sequence[str] | None = None,
+                 shape: Sequence[int] | None = None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.arange(int(np.prod(shape))).reshape(tuple(shape))
+        self._shape = list(arr.shape)
+        self._process_ids = arr.reshape(-1).tolist()
+        self._dim_names = list(dim_names) if dim_names is not None else [
+            f"d{i}" for i in range(arr.ndim)]
+        self._mesh_arr = arr
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def mesh(self):
+        return self._mesh_arr
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id):
+        axis = self._dim_names.index(dim_name)
+        pos = np.argwhere(self._mesh_arr == process_id)
+        return int(pos[0][axis]) if len(pos) else -1
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            n = int(np.prod(self._shape))
+            if len(devices) < n:
+                raise RuntimeError(
+                    f"mesh needs {n} devices, only {len(devices)} visible")
+            dev_arr = np.array([devices[p] for p in self._process_ids]
+                               ).reshape(tuple(self._shape))
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._dim_names == other._dim_names
+                and self._process_ids == other._process_ids)
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+
+_GLOBAL_MESH: list[ProcessMesh | None] = [None]
+
+
+def set_mesh(mesh: ProcessMesh):
+    _GLOBAL_MESH[0] = mesh
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _GLOBAL_MESH[0]
+
+
+def placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
+                       ndim: int | None = None) -> PartitionSpec:
+    """placements[i] describes how mesh dim i maps onto tensor dims →
+    PartitionSpec over tensor dims (reference dims_mapping inversion)."""
+    dim_map: dict[int, list[str]] = {}
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            dim_map.setdefault(p.dim, []).append(mesh._dim_names[mesh_dim])
+    if not dim_map:
+        return PartitionSpec()
+    max_dim = (ndim - 1) if ndim is not None else max(dim_map)
+    axes = []
+    for d in range(max_dim + 1):
+        names = dim_map.get(d)
+        if names is None:
+            axes.append(None)
+        elif len(names) == 1:
+            axes.append(names[0])
+        else:
+            axes.append(tuple(names))
+    return PartitionSpec(*axes)
+
+
+to_partition_spec = placements_to_spec
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """reference distributed/auto_parallel/api.py:94 shard_tensor."""
+    t = data if isinstance(data, Tensor) else Tensor(
+        jax.numpy.asarray(np.asarray(data)))
+    spec = placements_to_spec(placements, mesh, ndim=t.ndim)
+    sharding = NamedSharding(mesh.jax_mesh, spec)
+    v = jax.device_put(t._value, sharding)
+    out = Tensor(v, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient)
+    out._grad_node = t._grad_node
+    out._out_index = t._out_index
+    out.dist_attr = DistAttr(mesh, list(placements))
+    return out
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements: Sequence[Placement]
+            ) -> Tensor:
+    """reference api.py:202 reshard — placement conversion; GSPMD inserts
+    the collective (allgather/slice/reduce) on device_put."""
+    spec = placements_to_spec(placements, mesh, ndim=x.ndim)
+    sharding = NamedSharding(mesh.jax_mesh, spec)
+    out = Tensor(jax.device_put(x._value, sharding),
+                 stop_gradient=x.stop_gradient)
+    out.dist_attr = DistAttr(mesh, list(placements))
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh, placements)
+
+
+class DistAttr:
+    """reference TensorDistAttr (auto_parallel.proto)."""
+
+    def __init__(self, mesh: ProcessMesh, placements: list[Placement]):
+        self.process_mesh = mesh
+        self.placements = placements
+
+    @property
+    def dims_mapping(self):
+        # tensor-dim -> mesh-dim mapping (reference encoding)
+        mapping = {}
+        for mesh_dim, p in enumerate(self.placements):
+            if isinstance(p, Shard):
+                mapping[p.dim] = mesh_dim
+        return mapping
+
+    def __repr__(self):
+        return f"DistAttr(mesh={self.process_mesh}, placements={self.placements})"

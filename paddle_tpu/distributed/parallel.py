@@ -1,0 +1,68 @@
+"""DataParallel (reference: python/paddle/distributed/parallel.py:199
+DataParallel + C++ EagerReducer bucketed allreduce, reducer.cc 1345 l).
+
+TPU-native: no reducer. Params stay replicated global arrays; when the step
+is compiled with a 'dp'-sharded batch, XLA emits ONE fused gradient
+reduction (the bucketing+overlap the reference hand-tuned). In eager
+multi-process mode, grads sync lazily on step via the communication API."""
+
+from __future__ import annotations
+
+from .. import nn
+from .env import get_world_size
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(nn.Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._grads_synced = False
+        if get_world_size() > 1:
+            from .fleet.utils import broadcast_dp_parameters
+            broadcast_dp_parameters(layers, None)
+        # register grad hooks: on backward completion grads are averaged
+        if get_world_size() > 1:
+            from .communication import ReduceOp, all_reduce
+            for p in layers.parameters():
+                if not p.stop_gradient:
+                    def _hook(g, _p=p):
+                        return g  # eager sync happens in sync_gradients
+                    p.register_hook(_hook)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def sync_gradients(self):
+        if get_world_size() <= 1:
+            return
+        from .communication import ReduceOp, all_reduce
+        n = get_world_size()
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.SUM)
+                p.grad._in_place_update(p.grad._value / n)
+
+    # passthrough API parity
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, state, *a, **k):
+        return self._layers.set_state_dict(state, *a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def no_sync(self):
+        from contextlib import nullcontext
+        return nullcontext()

@@ -68,15 +68,14 @@ class StaticFunction:
         self._raw_fn = function
         from ..nn.layer.layers import Layer
         self._layer = function if isinstance(function, Layer) else None
+        # capture the ORIGINAL forward now: to_static may later rebind
+        # layer.forward to the compiled path
+        self._callable = (function.forward if self._layer is not None
+                          else function)
         self._input_spec = input_spec
         self._jitted = None
         self._state_items: list[tuple[str, Tensor]] = []
-        functools.update_wrapper(
-            self, function.forward if self._layer is not None else function)
-
-    @property
-    def _callable(self):
-        return self._layer.forward if self._layer is not None else self._raw_fn
+        functools.update_wrapper(self, self._callable)
 
     def _build(self):
         self._state_items = _collect_state(
@@ -149,8 +148,6 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         if isinstance(fn, Layer):
             static = StaticFunction(fn, input_spec, **kwargs)
             fn.forward_static = static
-            orig_forward = fn.forward
-            fn.__call__  # noqa: B018
             # wrap the layer: calling it goes through the compiled path
             def compiled_call(*a, **k):
                 return static(*a, **k)

@@ -1,0 +1,4 @@
+"""Pallas TPU kernels — the ops XLA can't synthesize optimally
+(SURVEY §7.1: flash/ring attention, fused rope+rmsnorm, MoE dispatch)."""
+
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,157 @@
+"""Pallas flash attention (TPU).
+
+Replaces the reference's flashattn CUDA library
+(reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu wrapping
+third_party/flashattn; python surface nn/functional/flash_attention.py:142).
+
+Design (FlashAttention-2 style, online softmax):
+- layout in: [B, S, H, D] (paddle flash layout) → internally [B*H, S, D]
+- grid (B*H, S/BQ): each program owns one query block; K/V for its (b,h)
+  stream through VMEM in BK-sized chunks inside a fori_loop
+- f32 accumulators for m/l/acc regardless of input dtype (bf16-safe)
+- causal masking skips fully-masked K blocks (loop bound depends on the
+  query block index)
+- backward: recompute-based VJP in pure XLA (fused well by Mosaic/XLA); a
+  dedicated Pallas backward kernel is a planned optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on pure-CPU builds
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # noqa: BLE001
+    pltpu = None
+    _HAS_PLTPU = False
+
+__all__ = ["flash_attention_fwd", "flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, seq_len, causal,
+                scale):
+    qblk = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    d = q.shape[-1]
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    n_kblocks = seq_len // bk
+    if causal:
+        # last K block that intersects this query block
+        upper = (qblk + 1) * bq + bk - 1
+        n_loop = jnp.minimum(upper // bk, n_kblocks)
+    else:
+        n_loop = n_kblocks
+
+    q_ids = qblk * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)   # [BK, D]
+        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = q @ k.T                                               # [BQ, BK]
+        if causal:
+            k_ids = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + p @ v
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_loop, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _choose_blocks(seq_len, head_dim, dtype):
+    bq = 512
+    while seq_len % bq != 0 and bq > 8:
+        bq //= 2
+    bk = 512
+    while seq_len % bk != 0 and bk > 8:
+        bk //= 2
+    # keep q/k/v blocks + accumulators well under VMEM (~16MB)
+    return bq, bk
+
+
+def _flash_fwd_impl(q, k, v, causal, interpret=False):
+    B, S, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    qf = jnp.swapaxes(q, 1, 2).reshape(B * H, S, D)
+    kf = jnp.swapaxes(k, 1, 2).reshape(B * H, S, D)
+    vf = jnp.swapaxes(v, 1, 2).reshape(B * H, S, D)
+    bq, bk = _choose_blocks(S, D, q.dtype)
+
+    kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_len=S,
+                               causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, S // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
+
+
+def _sdpa_reference(q, k, v, causal):
+    d = q.shape[-1]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    s = jnp.einsum("bhsd,bhtd->bhst", qh, kh).astype(jnp.float32) / (d ** 0.5)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", p, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=False, interpret=False):
+    """Differentiable flash attention, [B, S, H, D] layout."""
+    return _flash_fwd_impl(q, k, v, causal, interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, interpret):
+    out = _flash_fwd_impl(q, k, v, causal, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _sdpa_reference(q, k, v, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_fwd(q, k, v, causal=False):
+    """Entry used by nn.functional: picks pallas when shapes are tileable,
+    else the XLA reference."""
+    B, S, H, D = q.shape
+    if S % 8 != 0 or D % 8 != 0:
+        return _sdpa_reference(q, k, v, causal)
+    interpret = jax.default_backend() != "tpu"
+    return flash_attention(q, k, v, causal, interpret)

@@ -1,0 +1,10 @@
+"""paddle_tpu.models — flagship model families (BASELINE configs 3-5).
+
+Vision models (LeNet/ResNet/VGG/MobileNet — configs 1-2) live in
+paddle_tpu.vision.models."""
+
+from .llama import LlamaConfig, LlamaForCausalLM, llama_loss_fn, LLAMA_PRESETS  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM, GPT_PRESETS  # noqa: F401
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "llama_loss_fn",
+           "LLAMA_PRESETS", "GPTConfig", "GPTForCausalLM", "GPT_PRESETS"]

@@ -1,0 +1,127 @@
+"""GPT family (reference anchor: PaddleNLP gpt + test/auto_parallel
+get_gpt_model.py fixture). Same stacked-scan architecture as Llama with
+learned positions, LayerNorm and GELU MLP."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+
+__all__ = ["GPTConfig", "GPTForCausalLM", "GPT_PRESETS"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    recompute: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+GPT_PRESETS = {
+    "gpt2": dict(),
+    "gpt2-medium": dict(hidden_size=1024, num_hidden_layers=24,
+                        num_attention_heads=16, intermediate_size=4096),
+    "debug": dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  max_position_embeddings=128),
+}
+
+
+def _ln(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    return ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+def _gpt_layer(cfg: GPTConfig, lp, x):
+    h, hd = cfg.num_attention_heads, cfg.head_dim
+    b, s, d = x.shape
+    y = _ln(x, lp["ln1_w"], lp["ln1_b"], cfg.layer_norm_eps)
+    qkv = y @ lp["w_qkv"] + lp["b_qkv"]
+    q, k, v = jnp.split(qkv.reshape(b, s, 3, h, hd), 3, axis=2)
+    q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
+    from .llama import _attention
+    attn = _attention(q, k, v, causal=True).reshape(b, s, d)
+    x = x + attn @ lp["w_proj"] + lp["b_proj"]
+    y = _ln(x, lp["ln2_w"], lp["ln2_b"], cfg.layer_norm_eps)
+    hmid = jax.nn.gelu(y @ lp["w_fc"] + lp["b_fc"])
+    x = x + hmid @ lp["w_out"] + lp["b_out"]
+    return x
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig | str = "debug"):
+        super().__init__()
+        if isinstance(config, str):
+            config = GPTConfig(**GPT_PRESETS[config])
+        self.config = cfg = config
+        d, L, ff = cfg.hidden_size, cfg.num_hidden_layers, cfg.intermediate_size
+
+        def mk(name, shape, spec, std=0.02, zeros=False, ones=False):
+            from ..nn import initializer as I
+            init = I.Constant(1.0 if ones else 0.0) if (zeros or ones) \
+                else I.Normal(0.0, std)
+            p = self.create_parameter(shape=shape, default_initializer=init)
+            p._dist_spec = spec
+            self.add_parameter(name, p)
+            return p
+
+        mk("wte", [cfg.vocab_size, d], ("mp", None))
+        mk("wpe", [cfg.max_position_embeddings, d], (None, None))
+        mk("w_qkv", [L, d, 3 * d], ("pp", None, "mp"))
+        mk("b_qkv", [L, 3 * d], ("pp", "mp"), zeros=True)
+        mk("w_proj", [L, d, d], ("pp", "mp", None))
+        mk("b_proj", [L, d], ("pp", None), zeros=True)
+        mk("ln1_w", [L, d], ("pp", None), ones=True)
+        mk("ln1_b", [L, d], ("pp", None), zeros=True)
+        mk("ln2_w", [L, d], ("pp", None), ones=True)
+        mk("ln2_b", [L, d], ("pp", None), zeros=True)
+        mk("w_fc", [L, d, ff], ("pp", None, "mp"))
+        mk("b_fc", [L, ff], ("pp", "mp"), zeros=True)
+        mk("w_out", [L, ff, d], ("pp", "mp", None))
+        mk("b_out", [L, d], ("pp", None), zeros=True)
+        mk("lnf_w", [d], (None,), ones=True)
+        mk("lnf_b", [d], (None,), zeros=True)
+
+    def forward(self, input_ids):
+        cfg = self.config
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        names = ["w_qkv", "b_qkv", "w_proj", "b_proj", "ln1_w", "ln1_b",
+                 "ln2_w", "ln2_b", "w_fc", "b_fc", "w_out", "b_out"]
+        params = self._parameters
+
+        def fwd(*arrays):
+            stacked = dict(zip(names, arrays[:len(names)]))
+            wte, wpe, lnf_w, lnf_b = arrays[len(names):]
+            b, s = ids.shape
+            x = jnp.take(wte, ids, axis=0) + wpe[None, :s]
+
+            def layer_fn(carry, lp):
+                return _gpt_layer(cfg, lp, carry), None
+
+            if cfg.recompute:
+                layer_fn = jax.checkpoint(layer_fn)
+            x, _ = jax.lax.scan(layer_fn, x, stacked)
+            x = _ln(x, lnf_w, lnf_b, cfg.layer_norm_eps)
+            return x @ wte.T
+
+        from ..core.dispatch import apply_op
+        args = tuple(params[n] for n in names) + (
+            params["wte"], params["wpe"], params["lnf_w"], params["lnf_b"])
+        return apply_op("gpt_forward", fwd, args, {})

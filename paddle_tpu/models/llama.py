@@ -1,0 +1,303 @@
+"""Llama family — the flagship model (BASELINE config 3: Llama-3-8B
+pretraining, TP+PP; reference recipe anchor: PaddleNLP llm/ with
+fleet/layers/mpu/mp_layers.py + pipeline_parallel.py:397).
+
+TPU-first architecture:
+- ONE decoder-layer function scanned over a stacked parameter tree
+  ([n_layers, ...] leaves) via lax.scan — constant compile time in depth,
+  and the layer dim doubles as the pipeline-stage dim (sharded over 'pp'
+  through fleet.pipeline.spmd_pipeline inside shard_map).
+- TP via GSPMD: weights carry PartitionSpecs over 'mp' (Megatron
+  column/row pattern from reference mp_layers.py), activations steered by
+  shard_hint.
+- Long context: activations sequence-sharded over 'sep' between attention
+  blocks (reference SegmentParallel); attention gathers K/V over sep
+  (ring-attention Pallas kernel replaces the gather on TPU when enabled).
+- bf16 compute / fp32 master weights via AMP + multi_precision AdamW.
+- Flash attention via nn.functional.scaled_dot_product_attention (Pallas on
+  TPU, XLA fallback elsewhere).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import defop
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..distributed.fleet.mp_layers import shard_hint
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "llama_loss_fn",
+           "LLAMA_PRESETS"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    tie_word_embeddings: bool = False
+    recompute: bool = False
+    dtype: str = "float32"
+    # moe (0 experts = dense)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 1.25
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+LLAMA_PRESETS = {
+    # BASELINE config 3 target
+    "llama3-8b": dict(vocab_size=128256, hidden_size=4096,
+                      intermediate_size=14336, num_hidden_layers=32,
+                      num_attention_heads=32, num_key_value_heads=8,
+                      rope_theta=500000.0),
+    "llama2-7b": dict(vocab_size=32000, hidden_size=4096,
+                      intermediate_size=11008, num_hidden_layers=32,
+                      num_attention_heads=32, num_key_value_heads=32,
+                      rope_theta=10000.0),
+    "tiny": dict(vocab_size=1024, hidden_size=256, intermediate_size=688,
+                 num_hidden_layers=4, num_attention_heads=8,
+                 num_key_value_heads=4, max_position_embeddings=2048),
+    "debug": dict(vocab_size=128, hidden_size=64, intermediate_size=172,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=2, max_position_embeddings=256),
+    # BASELINE config 5 anchor (Mixtral-style EP)
+    "tiny-moe": dict(vocab_size=1024, hidden_size=256, intermediate_size=512,
+                     num_hidden_layers=4, num_attention_heads=8,
+                     num_key_value_heads=4, num_experts=4,
+                     num_experts_per_tok=2, max_position_embeddings=2048),
+}
+
+
+def _rope(x, positions, theta, head_dim):
+    """Rotary embedding on [b, s, h, d] (reference
+    fused_rotary_position_embedding, incubate/nn/functional)."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[:, :, None].astype(jnp.float32) * freqs  # [b, s, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _attention(q, k, v, causal=True):
+    """[b, s, h, d] flash attention (Pallas on TPU) with GQA key/value
+    broadcast."""
+    from .. import flags
+    n_rep = q.shape[2] // k.shape[2]
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    if flags.flag("use_pallas_kernels") and jax.default_backend() == "tpu":
+        from ..kernels.flash_attention import flash_attention_fwd
+        return flash_attention_fwd(q, k, v, causal=causal)
+    from ..nn.functional.attention import _sdpa_ref
+    return _sdpa_ref(q, k, v, causal=causal)
+
+
+def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint):
+    """One decoder layer on raw arrays. lp = this layer's parameter dict."""
+    h = cfg.num_attention_heads
+    kvh = cfg.num_key_value_heads
+    hd = cfg.head_dim
+    b, s, d = x.shape
+
+    def hint(a, *spec):
+        return mesh_hint(a, spec)
+
+    # attention block
+    y = _rms(x, lp["input_ln"], cfg.rms_norm_eps)
+    q = (y @ lp["wq"]).reshape(b, s, h, hd)
+    k = (y @ lp["wk"]).reshape(b, s, kvh, hd)
+    v = (y @ lp["wv"]).reshape(b, s, kvh, hd)
+    q = hint(_rope(q, positions, cfg.rope_theta, hd), "dp", "sep", "mp", None)
+    k = hint(_rope(k, positions, cfg.rope_theta, hd), "dp", None, "mp", None)
+    v = hint(v, "dp", None, "mp", None)
+    attn = _attention(q, k, v, causal=True)
+    attn = attn.reshape(b, s, h * hd)
+    x = x + hint(attn @ lp["wo"], "dp", "sep", None)
+
+    # mlp block (SwiGLU)
+    y = _rms(x, lp["post_ln"], cfg.rms_norm_eps)
+    if cfg.num_experts > 0:
+        x = x + _moe_mlp(cfg, lp, y, mesh_hint)
+    else:
+        gate = jax.nn.silu(y @ lp["w_gate"])
+        up = y @ lp["w_up"]
+        x = x + hint((gate * up) @ lp["w_down"], "dp", "sep", None)
+    return x
+
+
+def _moe_mlp(cfg: LlamaConfig, lp: dict, y, mesh_hint):
+    """Expert-parallel SwiGLU MoE (BASELINE config 5; reference
+    moe_layer.py:263 semantics, dense-dispatch formulation — expert dim
+    sharded over 'ep', all-to-all inserted by GSPMD)."""
+    b, s, d = y.shape
+    E = cfg.num_experts
+    tokens = y.reshape(b * s, d)
+    logits = tokens @ lp["router"]
+    capacity = max(1, int(cfg.moe_capacity_factor * b * s
+                          * cfg.num_experts_per_tok / E))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, cfg.num_experts_per_tok)
+    onehot = jax.nn.one_hot(topi, E, dtype=jnp.float32)
+    pos_in_expert = jnp.cumsum(onehot.sum(1), axis=0) - onehot.sum(1)
+    keep = pos_in_expert < capacity
+    disp = onehot * keep[:, None, :]
+    gates = topv[..., None] * disp
+    gates = gates / jnp.maximum(gates.sum(axis=(1, 2), keepdims=True), 1e-9)
+    pos = jnp.einsum("nke,ne->nke", disp, pos_in_expert)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=jnp.float32) * disp[..., None]
+    combine = jnp.einsum("nke,nkec->nec", gates, pos_oh).astype(y.dtype)
+    dispatch_mask = (combine > 0).astype(y.dtype)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch_mask, tokens)
+    expert_in = mesh_hint(expert_in, ("ep", None, None))
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["we_gate"]))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, lp["we_up"])
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["we_down"])
+    expert_out = mesh_hint(expert_out, ("ep", None, None))
+    out = jnp.einsum("ecd,nec->nd", expert_out, combine)
+    return out.reshape(b, s, d)
+
+
+@defop("llama_forward")
+def _llama_forward(stacked, embed, final_norm, lm_head, token_ids, cfg,
+                   mesh_hint):
+    """Full forward on raw arrays: embed → scan(decoder) → norm → logits."""
+    x = jnp.take(embed, token_ids, axis=0)
+    x = mesh_hint(x, ("dp", "sep", None))
+    b, s = token_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+
+    def layer_fn(carry, lp):
+        out = _decoder_layer(cfg, lp, carry, positions, mesh_hint)
+        return out, None
+
+    if cfg.recompute:
+        layer_fn = jax.checkpoint(layer_fn)
+    x, _ = jax.lax.scan(layer_fn, x, stacked)
+    x = _rms(x, final_norm, cfg.rms_norm_eps)
+    logits = x @ lm_head
+    return mesh_hint(logits, ("dp", "sep", "mp"))
+
+
+class LlamaForCausalLM(nn.Layer):
+    """Stacked-parameter Llama. state_dict keys: ``layers.<name>`` hold the
+    stacked [L, ...] arrays (cross-topology checkpoints reshard on load)."""
+
+    def __init__(self, config: LlamaConfig | str = "tiny"):
+        super().__init__()
+        if isinstance(config, str):
+            config = LlamaConfig(**LLAMA_PRESETS[config])
+        self.config = cfg = config
+        d = cfg.hidden_size
+        L = cfg.num_hidden_layers
+        h, kvh, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        ff = cfg.intermediate_size
+        init_std = 0.02
+
+        def mk(name, shape, spec, std=init_std, ones=False):
+            from ..nn import initializer as I
+            init = I.Constant(1.0) if ones else I.Normal(0.0, std)
+            p = self.create_parameter(shape=shape, default_initializer=init)
+            p._dist_spec = spec
+            self.add_parameter(name, p)
+            return p
+
+        self.embed_tokens = mk("embed_tokens", [cfg.vocab_size, d],
+                               ("mp", None))
+        # stacked decoder params; dim0 = layers (sharded over 'pp' when a
+        # pipeline axis exists — spec applied to dims 1+ via offset)
+        mk("wq", [L, d, h * hd], ("pp", None, "mp"))
+        mk("wk", [L, d, kvh * hd], ("pp", None, "mp"))
+        mk("wv", [L, d, kvh * hd], ("pp", None, "mp"))
+        mk("wo", [L, h * hd, d], ("pp", "mp", None))
+        mk("input_ln", [L, d], ("pp", None), ones=True)
+        mk("post_ln", [L, d], ("pp", None), ones=True)
+        if cfg.num_experts > 0:
+            E = cfg.num_experts
+            mk("router", [L, d, E], ("pp", None, None))
+            mk("we_gate", [L, E, d, ff], ("pp", "ep", None, "mp"))
+            mk("we_up", [L, E, d, ff], ("pp", "ep", None, "mp"))
+            mk("we_down", [L, E, ff, d], ("pp", "ep", "mp", None))
+        else:
+            mk("w_gate", [L, d, ff], ("pp", None, "mp"))
+            mk("w_up", [L, d, ff], ("pp", None, "mp"))
+            mk("w_down", [L, ff, d], ("pp", "mp", None))
+        self.final_norm = mk("final_norm", [d], (None,), ones=True)
+        if cfg.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = mk("lm_head", [d, cfg.vocab_size], (None, "mp"))
+
+    def _stacked_names(self):
+        base = ["wq", "wk", "wv", "wo", "input_ln", "post_ln"]
+        if self.config.num_experts > 0:
+            return base + ["router", "we_gate", "we_up", "we_down"]
+        return base + ["w_gate", "w_up", "w_down"]
+
+    def forward(self, input_ids):
+        cfg = self.config
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        stacked_params = [self._parameters[n] for n in self._stacked_names()]
+        names = self._stacked_names()
+        head = self._parameters.get("lm_head")
+
+        from ..distributed.fleet.mp_layers import current_mesh, shard_hint_raw
+
+        def mesh_hint(a, spec):
+            return shard_hint_raw(a, spec, current_mesh())
+
+        def fwd(*arrays):
+            n = len(names)
+            stacked = dict(zip(names, arrays[:n]))
+            embed = arrays[n]
+            final_norm = arrays[n + 1]
+            lm_head = arrays[n + 2] if head is not None else embed.T
+            return _llama_forward.raw(stacked, embed, final_norm, lm_head,
+                                      ids, cfg, mesh_hint)
+
+        from ..core.dispatch import apply_op
+        args = tuple(stacked_params) + (self._parameters["embed_tokens"],
+                                        self._parameters["final_norm"])
+        if head is not None:
+            args = args + (head,)
+        return apply_op("llama_forward", fwd, args, {})
+
+
+def llama_loss_fn(model, input_ids, labels):
+    """Causal LM loss (reference PaddleNLP criterion): next-token
+    prediction — logits[:, :-1] scored against labels[:, 1:],
+    ignore_index=-100."""
+    logits = model(input_ids)
+    from ..ops.manipulation import reshape
+    vocab = logits.shape[-1]
+    shifted_logits = logits[:, :-1, :]
+    shifted_labels = labels[:, 1:]
+    return F.cross_entropy(reshape(shifted_logits, [-1, vocab]),
+                           reshape(shifted_labels, [-1]), ignore_index=-100)

@@ -219,7 +219,8 @@ class Layer:
                 if tuple(val.shape) != tuple(tgt._value.shape):
                     raise ValueError(
                         f"shape mismatch for {k}: {val.shape} vs {tgt._value.shape}")
-                tgt._in_place_update(val.astype(tgt._value.dtype))
+                # copy: the source may later be donated to a compiled step
+                tgt._in_place_update(jnp.array(val, dtype=tgt._value.dtype))
             else:
                 unexpected.append(k)
         for k in own:

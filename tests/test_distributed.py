@@ -1,0 +1,376 @@
+"""Distributed tests on the 8-device virtual CPU mesh (SURVEY §4: reference
+uses multi-process localhost; our analogue is a real multi-device mesh in
+one process — collectives actually execute)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestMeshAndPlacement:
+    def test_process_mesh_props(self):
+        mesh = dist.ProcessMesh(shape=[2, 4], dim_names=["dp", "mp"])
+        assert mesh.shape == [2, 4]
+        assert mesh.get_dim_size("mp") == 4
+        assert len(mesh.process_ids) == 8
+
+    def test_shard_and_reshard_values(self):
+        mesh = dist.ProcessMesh(shape=[8], dim_names=["x"])
+        x = paddle.arange(0, 32, dtype="float32").reshape([8, 4])
+        xs = dist.shard_tensor(x, mesh, [dist.Shard(0)])
+        assert np.allclose(_np(xs), _np(x))
+        xr = dist.reshard(xs, mesh, [dist.Replicate()])
+        assert np.allclose(_np(xr), _np(x))
+        # sharded compute produces correct global result
+        y = paddle.sum(xs * 2)
+        assert float(y) == float(paddle.sum(x * 2))
+
+    def test_partial_placement_repr(self):
+        p = dist.Partial()
+        assert p.is_partial()
+        s = dist.Shard(1)
+        assert s.is_shard(1) and not s.is_shard(0)
+
+
+class TestTopology:
+    def test_communicate_topology(self):
+        from paddle_tpu.distributed.fleet import CommunicateTopology
+        topo = CommunicateTopology(["data", "pipe", "sharding", "sep", "model"],
+                                   [2, 2, 1, 1, 2])
+        assert topo.world_size() == 8
+        coord = topo.get_coord(5)
+        assert topo.get_rank(**coord) == 5
+        groups = topo.get_comm_list("model")
+        assert len(groups) == 4 and all(len(g) == 2 for g in groups)
+
+    def test_hybrid_group(self):
+        from paddle_tpu.distributed.fleet import (CommunicateTopology,
+                                                  HybridCommunicateGroup)
+        topo = CommunicateTopology(["data", "pipe", "sharding", "sep", "model"],
+                                   [2, 1, 1, 1, 4])
+        hcg = HybridCommunicateGroup(topo, rank=0)
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_model_parallel_world_size() == 4
+        mesh = hcg.get_mesh()
+        assert mesh.shape == [2, 1, 1, 1, 4]
+
+    def test_fleet_init(self):
+        from paddle_tpu.distributed import fleet
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4,
+                                   "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_model_parallel_world_size() == 4
+
+
+class TestCollectivesCompiled:
+    """Functional collectives inside shard_map over the 8-device mesh."""
+
+    def test_psum_allgather(self):
+        from jax.experimental.shard_map import shard_map
+        mesh = dist.ProcessMesh(shape=[8], dim_names=["x"]).jax_mesh
+
+        def f(x):
+            s = jax.lax.psum(x, "x")
+            g = jax.lax.all_gather(x, "x", tiled=True)
+            return s, g
+
+        xs = jnp.arange(8.0).reshape(8, 1)
+        f_sharded = shard_map(f, mesh=mesh, in_specs=P("x", None),
+                              out_specs=(P("x", None), P("x", None)))
+        s, g = f_sharded(xs)
+        assert np.allclose(np.asarray(s), 28.0)
+
+    def test_fcollectives_through_tape(self):
+        """fcollectives ops record on the tape; grad of psum is identity
+        broadcast."""
+        from jax.experimental.shard_map import shard_map
+        from paddle_tpu.distributed import fcollectives as fc
+        mesh = dist.ProcessMesh(shape=[8], dim_names=["x"]).jax_mesh
+
+        def step(x):
+            def inner(xv):
+                return jax.lax.psum(xv * 2.0, "x")
+            return shard_map(inner, mesh=mesh, in_specs=P("x"),
+                             out_specs=P())(x)
+
+        x = jnp.arange(8.0)
+        out = step(x)
+        assert float(np.asarray(out).reshape(())) == 2 * sum(range(8))
+        g = jax.grad(lambda x: step(x).reshape(()))(x)
+        assert np.allclose(np.asarray(g), 2.0)
+
+
+class TestEagerCommAPI:
+    def test_single_process_semantics(self):
+        t = paddle.to_tensor([1.0, 2.0])
+        dist.all_reduce(t)
+        assert np.allclose(_np(t), [1, 2])
+        out = []
+        dist.all_gather(out, t)
+        assert len(out) == 1
+        g = dist.new_group([0])
+        assert g.nranks == 1
+        objs = []
+        dist.all_gather_object(objs, {"a": 1})
+        assert objs == [{"a": 1}]
+
+    def test_reduce_scatter_local(self):
+        t = paddle.zeros([2])
+        dist.reduce_scatter(t, [paddle.ones([2]), paddle.ones([2])])
+        assert np.allclose(_np(t), [2, 2])
+
+
+class TestTPLayers:
+    def _mesh(self):
+        return dist.ProcessMesh(shape=[2, 4], dim_names=["dp", "mp"])
+
+    def test_column_row_parallel_match_dense(self):
+        paddle.seed(3)
+        col = dist.fleet.ColumnParallelLinear(8, 16, has_bias=True,
+                                              gather_output=False)
+        row = dist.fleet.RowParallelLinear(16, 8, input_is_parallel=True)
+        x = paddle.randn([4, 8])
+        ref = F.linear(F.linear(x, col.weight, col.bias), row.weight, row.bias)
+        # under mesh ctx with sharding hints
+        from paddle_tpu.distributed.fleet.mp_layers import sharding_ctx
+        with sharding_ctx(self._mesh().jax_mesh):
+            out = row(col(x))
+        assert np.allclose(_np(out), _np(ref), atol=1e-5)
+        assert col.weight._dist_spec == (None, "mp")
+        assert row.weight._dist_spec == ("mp", None)
+
+    def test_vocab_parallel_embedding(self):
+        emb = dist.fleet.VocabParallelEmbedding(100, 16)
+        ids = paddle.to_tensor(np.array([[1, 5], [7, 99]]))
+        out = emb(ids)
+        assert out.shape == [2, 2, 16]
+        assert emb.weight._dist_spec == ("mp", None)
+
+    def test_parallel_cross_entropy(self):
+        pce = dist.fleet.ParallelCrossEntropy()
+        logits = paddle.randn([4, 10])
+        labels = paddle.to_tensor(np.random.randint(0, 10, (4,)))
+        loss = pce(logits, labels)
+        ref = F.cross_entropy(logits, labels, reduction="none")
+        assert np.allclose(_np(loss)[:, 0], _np(ref), atol=1e-5)
+
+    def test_rng_tracker(self):
+        tracker = dist.fleet.get_rng_state_tracker()
+        tracker.reset()
+        tracker.add("test_rng", 1234)
+        with tracker.rng_state("test_rng"):
+            a = paddle.randn([4])
+        tracker.reset()
+        tracker.add("test_rng", 1234)
+        with tracker.rng_state("test_rng"):
+            b = paddle.randn([4])
+        assert np.allclose(_np(a), _np(b))
+
+
+class TestRecompute:
+    def test_recompute_grads_match(self):
+        from paddle_tpu.distributed.fleet import recompute
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 8))
+        x = paddle.randn([4, 8])
+        x.stop_gradient = False
+        out1 = paddle.sum(net(x) ** 2)
+        out1.backward()
+        g_ref = [_np(p.grad) for p in net.parameters()]
+        gx_ref = _np(x.grad)
+        net.clear_gradients()
+        x2 = paddle.to_tensor(_np(x), stop_gradient=False)
+        out2 = paddle.sum(recompute(net, x2) ** 2)
+        out2.backward()
+        assert np.allclose(float(out1), float(out2), atol=1e-5)
+        for p, g in zip(net.parameters(), g_ref):
+            assert np.allclose(_np(p.grad), g, atol=1e-5)
+        assert np.allclose(_np(x2.grad), gx_ref, atol=1e-5)
+
+
+class TestShardingStages:
+    def test_group_sharded_api(self):
+        model = nn.Sequential(nn.Linear(64, 64), nn.ReLU(), nn.Linear(64, 8))
+        opt = paddle.optimizer.AdamW(parameters=model.parameters())
+        m2, o2, _ = dist.group_sharded_parallel(model, opt, "p_g_os")
+        specs = [p._dist_spec for p in m2.parameters() if p.size >= 1024]
+        assert any(s is not None and "sharding" in str(s) for s in specs)
+
+    def test_stage1_partition_balanced(self):
+        from paddle_tpu.distributed.fleet import DygraphShardingOptimizer
+        model = nn.Sequential(*[nn.Linear(32, 32) for _ in range(4)])
+        opt = paddle.optimizer.SGD(parameters=model.parameters())
+        mapping = DygraphShardingOptimizer._partition_parameters(
+            opt._parameter_list, 2)
+        s0 = sum(p.size for p in mapping[0])
+        s1 = sum(p.size for p in mapping[1])
+        assert abs(s0 - s1) <= 32 * 32
+
+
+class TestDistTrainStep:
+    def test_dp_mp_train_step_matches_single(self):
+        """The compiled hybrid step on a dp×mp mesh must match single-device
+        SGD numerics."""
+        paddle.seed(11)
+
+        class TPNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.col = dist.fleet.ColumnParallelLinear(
+                    16, 32, has_bias=True, gather_output=False)
+                self.row = dist.fleet.RowParallelLinear(
+                    32, 4, input_is_parallel=True)
+
+            def forward(self, x):
+                return self.row(F.relu(self.col(x)))
+
+        def loss_fn(model, x, y):
+            return F.cross_entropy(model(x), y)
+
+        x = np.random.randn(8, 16).astype(np.float32)
+        y = np.random.randint(0, 4, (8,))
+
+        # single-device reference
+        net1 = TPNet()
+        opt1 = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=net1.parameters())
+        losses1 = []
+        for _ in range(3):
+            loss = loss_fn(net1, paddle.to_tensor(x), paddle.to_tensor(y))
+            loss.backward()
+            opt1.step()
+            opt1.clear_grad()
+            losses1.append(float(loss))
+
+        # mesh step
+        paddle.seed(11)
+        net2 = TPNet()
+        opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=net2.parameters())
+        mesh = dist.ProcessMesh(shape=[2, 4], dim_names=["dp", "mp"])
+        dist.shard_model_state(net2, mesh)
+        step = dist.DistTrainStep(net2, opt2, loss_fn, mesh, donate=False)
+        losses2 = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+                   for _ in range(3)]
+        assert np.allclose(losses1, losses2, atol=1e-4), (losses1, losses2)
+        for p1, p2 in zip(net1.parameters(), net2.parameters()):
+            assert np.allclose(_np(p1), _np(p2), atol=1e-4)
+
+    def test_fsdp_step_runs_sharded(self):
+        model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                              nn.Linear(128, 8))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        mesh = dist.ProcessMesh(shape=[8], dim_names=["sharding"])
+        from paddle_tpu.distributed.fleet.sharding import apply_sharding_specs
+        apply_sharding_specs(model, stage=3, min_size_to_shard=64)
+        dist.shard_model_state(model, mesh)
+        # params physically sharded
+        w = model[0].weight
+        assert "sharding" in str(w._value.sharding.spec)
+        step = dist.DistTrainStep(
+            model, opt,
+            lambda m, a, b: F.cross_entropy(m(a), b), mesh, donate=False)
+        x = paddle.randn([16, 64])
+        y = paddle.to_tensor(np.random.randint(0, 8, (16,)))
+        l0 = float(step(x, y))
+        for _ in range(5):
+            l = float(step(x, y))
+        assert l < l0
+
+
+class TestMoE:
+    def test_moe_layer_forward_backward(self):
+        d = 16
+        experts = [nn.Sequential(nn.Linear(d, 32), nn.ReLU(),
+                                 nn.Linear(32, d)) for _ in range(4)]
+        moe = dist.fleet.MoELayer(d_model=d, experts=experts,
+                                  gate={"type": "gshard", "top_k": 2})
+        x = paddle.randn([2, 6, d])
+        x.stop_gradient = False
+        out = moe(x)
+        assert out.shape == [2, 6, d]
+        loss = paddle.sum(out ** 2) + moe.l_aux
+        loss.backward()
+        # gate + experts must receive gradient
+        assert moe.gate.gate.weight.grad is not None
+        assert experts[0][0].weight.grad is not None
+
+    def test_moe_routes_tokens(self):
+        """With an identity-ish single expert dominating, output is close to
+        that expert's transform."""
+        d = 8
+        experts = [nn.Linear(d, d, bias_attr=False) for _ in range(2)]
+        moe = dist.fleet.MoELayer(d_model=d, experts=experts, top_k=1,
+                                  capacity_factor=4.0)
+        # force router to expert 0
+        gate_w = np.zeros((d, 2), np.float32)
+        moe.gate.gate.weight.set_value(gate_w)
+        moe.gate.gate.bias.set_value(np.array([100.0, -100.0], np.float32))
+        x = paddle.randn([1, 4, d])
+        out = moe(x)
+        ref = F.linear(x, experts[0].weight)
+        assert np.allclose(_np(out), _np(ref), atol=1e-4)
+
+
+class TestSpmdPipeline:
+    def test_pipeline_matches_sequential(self):
+        """2-stage compiled pipeline over the pp axis == running both stages
+        sequentially."""
+        from jax.experimental.shard_map import shard_map
+        from paddle_tpu.distributed.fleet.pipeline import spmd_pipeline
+        n_stages, n_mb, mb, d = 2, 4, 3, 8
+        mesh = dist.ProcessMesh(shape=[2], dim_names=["pp"]).jax_mesh
+        rng = np.random.RandomState(0)
+        w = rng.randn(n_stages, d, d).astype(np.float32) * 0.3
+        x = rng.randn(n_mb, mb, d).astype(np.float32)
+
+        def stage_fn(wi, xi):
+            return jnp.tanh(xi @ wi[0])
+
+        pipe = spmd_pipeline(stage_fn, n_stages, n_mb, axis_name="pp")
+        f = shard_map(pipe, mesh=mesh, in_specs=(P("pp"), P()),
+                      out_specs=P())
+        out = np.asarray(f(jnp.asarray(w), jnp.asarray(x)))
+        ref = np.tanh(np.tanh(x @ w[0]) @ w[1])
+        assert np.allclose(out, ref, atol=1e-5)
+
+    def test_pipeline_layer_segmentation(self):
+        from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+        descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(6)]
+        pp = PipelineLayer(descs, num_stages=3)
+        assert pp.segment_parts == [0, 2, 4, 6]
+        assert pp.get_stage_from_index(3) == 1
+        out = pp(paddle.randn([2, 8]))
+        assert out.shape == [2, 8]
+
+
+class TestShardedCheckpoint:
+    def test_save_load_reshard(self, tmp_path):
+        mesh1 = dist.ProcessMesh(shape=[8], dim_names=["x"])
+        model = nn.Linear(32, 16)
+        model.weight._dist_spec = ("x", None)
+        dist.shard_model_state(model, mesh1)
+        ref = _np(model.weight)
+        path = str(tmp_path / "ckpt")
+        dist.save_state_dict(model.state_dict(), path)
+        # perturb then reload with a DIFFERENT placement
+        model.weight.set_value(np.zeros_like(ref))
+        model.weight._dist_spec = (None, "x")
+        dist.shard_model_state(model, mesh1)
+        dist.load_state_dict(model.state_dict(), path)
+        assert np.allclose(_np(model.weight), ref)
