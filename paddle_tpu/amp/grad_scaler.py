@@ -25,7 +25,16 @@ class GradScaler:
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
-        self._found_inf = False
+        # per-optimizer unscale/inf state (reference grad_scaler.py
+        # OptimizerState INIT/UNSCALED/STEPPED): prevents double unscaling
+        # in the recipe unscale_(opt); clip; step(opt), and keeps inf
+        # detection per optimizer for multi-optimizer setups
+        self._unscaled_opts: set[int] = set()
+        self._found_inf_per_opt: dict[int, bool] = {}
+
+    @property
+    def _found_inf(self):
+        return any(self._found_inf_per_opt.values())
 
     def is_enable(self):
         return self._enable
@@ -52,41 +61,47 @@ class GradScaler:
             if not bool(jnp.all(jnp.isfinite(g))):
                 found = True
             p.grad._in_place_update(g * inv)
-        self._found_inf = found
+        self._found_inf_per_opt[id(optimizer)] = found
+        self._unscaled_opts.add(id(optimizer))
 
     def unscale_(self, optimizer):
-        if self._enable:
+        if self._enable and id(optimizer) not in self._unscaled_opts:
             self._unscale(optimizer)
 
     def step(self, optimizer):
+        """Unscale (if not already) and step; does NOT update the scale —
+        call update() once per iteration (reference semantics)."""
         if not self._enable:
             optimizer.step()
             return
-        self._unscale(optimizer)
-        if not self._found_inf:
+        if id(optimizer) not in self._unscaled_opts:
+            self._unscale(optimizer)
+        if not self._found_inf_per_opt.get(id(optimizer), False):
             optimizer.step()
-        self.update()
 
     def update(self):
-        if not (self._enable and self._dynamic):
+        if not self._enable:
             return
-        if self._found_inf:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every_n_nan_or_inf:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
-                self._bad_steps = 0
-        else:
-            self._good_steps += 1
-            self._bad_steps = 0
-            if self._good_steps >= self._incr_every_n_steps:
-                self._scale *= self._incr_ratio
+        if self._dynamic:
+            if self._found_inf:
+                self._bad_steps += 1
                 self._good_steps = 0
-        self._found_inf = False
+                if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                    self._scale = max(self._scale * self._decr_ratio, 1.0)
+                    self._bad_steps = 0
+            else:
+                self._good_steps += 1
+                self._bad_steps = 0
+                if self._good_steps >= self._incr_every_n_steps:
+                    self._scale *= self._incr_ratio
+                    self._good_steps = 0
+        self._unscaled_opts.clear()
+        self._found_inf_per_opt.clear()
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
         optimizer.clear_grad()
 
     def state_dict(self):

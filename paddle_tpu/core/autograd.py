@@ -125,8 +125,11 @@ def _toposort_count(roots: list[GradNode]) -> dict[GradNode, int]:
     (reference backward.cc in-degree counting)."""
     indeg: dict[GradNode, int] = {}
     seen = set()
-    stack = list(roots)
-    for r in roots:
+    # dedupe roots: two outputs of one multi-output op (qr, svd, ...) seed
+    # the same node twice; walking it twice would double-count producer
+    # in-degrees and strand the upstream subgraph
+    stack = list({id(n): n for n in roots}.values())
+    for r in stack:
         indeg.setdefault(r, 0)
         seen.add(id(r))
     while stack:

@@ -108,6 +108,7 @@ class PipelineParallel(MetaParallelBase):
             total = loss if total is None else total + loss.detach()
         if scaler is not None:
             scaler.step(optimizer)
+            scaler.update()
         else:
             optimizer.step()
         optimizer.clear_grad()

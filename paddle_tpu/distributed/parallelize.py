@@ -109,7 +109,7 @@ class DistTrainStep:
             old_key = R.default_generator._key
             old_acc = {k: list(v) for k, v in opt._accumulators.items()}
             old_step = opt._global_step
-            old_fn = opt._update_fn
+            old_fns = dict(opt._update_fns)
             opt.get_lr = lambda: lr
             try:
                 for t, v in zip(self._params, param_vals):
@@ -123,7 +123,7 @@ class DistTrainStep:
                 for slot in opt._accumulators:
                     opt._accumulators[slot] = list(opt_state[slot])
                 opt._global_step = step_count
-                opt._update_fn = None  # force inline (no nested donation)
+                opt._update_fns = {}  # force fresh trace (no nested donation)
                 with sharding_ctx(jm):
                     loss = self.loss_fn(self.model, *args)
                     loss.backward()
@@ -140,7 +140,7 @@ class DistTrainStep:
                     t.grad = g
                 opt._accumulators = old_acc
                 opt._global_step = old_step
-                opt._update_fn = old_fn
+                opt._update_fns = old_fns
                 del opt.get_lr
                 R.default_generator._key = old_key
 

@@ -205,7 +205,7 @@ class TrainStep:
             old_key = R.default_generator._key
             old_acc = {k: list(v) for k, v in opt._accumulators.items()}
             old_step = opt._global_step
-            old_fn = opt._update_fn
+            old_fns = dict(opt._update_fns)
             opt.get_lr = lambda: lr  # traced lr (scheduler-safe)
             try:
                 for t, v in zip(self._params, param_vals):
@@ -234,7 +234,7 @@ class TrainStep:
                     t.grad = g
                 opt._accumulators = old_acc
                 opt._global_step = old_step
-                opt._update_fn = old_fn
+                opt._update_fns = old_fns
                 del opt.get_lr  # restore class method
                 R.default_generator._key = old_key
 

@@ -29,7 +29,8 @@ def _use_pallas() -> bool:
             and jax.default_backend() == "tpu")
 
 
-def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
+def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None,
+              training=True):
     """Reference attention in pure XLA ops. Layout: [B, S, H, D] (paddle
     flash_attention layout)."""
     qh = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
@@ -48,16 +49,25 @@ def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None):
         else:
             scores = scores + mask
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        from ...ops.random import next_key
+        keep = jax.random.bernoulli(next_key(), 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros((), probs.dtype))
     out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
     return jnp.swapaxes(out, 1, 2)  # [B, S, H, D]
 
 
 @defop("scaled_dot_product_attention")
-def _sdpa(q, k, v, mask=None, dropout_p=0.0, causal=False):
-    if _use_pallas() and mask is None:
+def _sdpa(q, k, v, mask=None, dropout_p=0.0, causal=False, training=True):
+    # attention dropout routes around the Pallas kernel (reference applies
+    # dropout inside flash-attn; the Pallas path here is inference/pretrain
+    # style with no attention dropout)
+    if _use_pallas() and mask is None and not (dropout_p > 0.0 and training):
         from ...kernels.flash_attention import flash_attention_fwd
         return flash_attention_fwd(q, k, v, causal=causal)
-    return _sdpa_ref(q, k, v, mask=mask, causal=causal)
+    return _sdpa_ref(q, k, v, mask=mask, dropout_p=dropout_p, causal=causal,
+                     training=training)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -66,9 +76,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """Layout [batch, seq, num_heads, head_dim] (reference :440)."""
     if attn_mask is not None:
         return _sdpa(_t(query), _t(key), _t(value), _t(attn_mask),
-                     dropout_p=dropout_p, causal=is_causal)
+                     dropout_p=dropout_p, causal=is_causal, training=training)
     return _sdpa(_t(query), _t(key), _t(value), dropout_p=dropout_p,
-                 causal=is_causal)
+                 causal=is_causal, training=training)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,

@@ -135,6 +135,10 @@ def split(x, num_or_sections, axis=0, name=None):
     axis = axis % x.ndim
     dim = x.shape[axis]
     if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {axis} of size {dim} is not evenly "
+                f"divisible by num_or_sections={num_or_sections}")
         sizes = [dim // num_or_sections] * num_or_sections
     else:
         sizes = [int(s) for s in num_or_sections]

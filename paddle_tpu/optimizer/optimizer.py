@@ -34,7 +34,7 @@ class Optimizer:
         # per-parameter slot state, keyed by slot name then param index
         self._accumulators: dict[str, list[jax.Array]] = {}
         self._global_step = 0
-        self._update_fn = None  # cached jitted update
+        self._update_fns = {}  # cached jitted updates keyed by static config
 
     # -- API parity ---------------------------------------------------------
     def get_lr(self) -> float:
@@ -95,16 +95,33 @@ class Optimizer:
 
     def _apply_weight_decay(self, p, g):
         """L2Decay-style decay applied to the gradient (reference
-        regularizer semantics); AdamW overrides step-coupled decay."""
+        regularizer semantics); AdamW overrides step-coupled decay.
+
+        The coefficient arrives as a traced scalar (set by step() via
+        _wd_traced) so scheduled/callable decay values don't bake a stale
+        constant into the compiled update."""
+        coeff = getattr(self, "_wd_traced", None)
+        if coeff is None:
+            return g
+        return g + coeff * p
+
+    def _decay_coeff_value(self):
+        """Current weight-decay coefficient as a float, or None when decay
+        is disabled. Evaluated eagerly each step; fed to the compiled
+        update as a traced operand."""
         wd = self._weight_decay
         if wd is None:
-            return g
-        coeff = float(wd) if not callable(wd) else float(wd())
-        return g + coeff * p
+            return None
+        return float(wd()) if callable(wd) else float(wd)
 
     @property
     def _param_groups_key(self):
         return tuple(id(p) for p in self._parameter_list)
+
+    def _update_static_key(self):
+        """Hashable static config consumed by _update at trace time;
+        subclasses override so the jit cache retraces when it changes."""
+        return None
 
     def step(self):
         self._ensure_state()
@@ -123,9 +140,30 @@ class Optimizer:
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         step = jnp.asarray(self._global_step + 1, jnp.int32)
 
-        if self._update_fn is None:
-            self._update_fn = jax.jit(self._update, donate_argnums=(0, 2))
-        new_params, new_state = self._update_fn(params, grads, state, lr, step)
+        # jit cache keyed on the param subset + subclass static config
+        # (e.g. AdamW's decay mask): shape-only keying could silently reuse
+        # a stale trace when the params-with-grads subset changes but shapes
+        # coincide
+        wd_val = self._decay_coeff_value()
+        has_wd = wd_val is not None
+        cache_key = (tuple(idxs), has_wd, self._update_static_key())
+        fn = self._update_fns.get(cache_key)
+        if fn is None:
+            # a fresh def per cache entry: bound methods of one object
+            # compare equal, so jax.jit(self._update) would silently share
+            # one trace across different static configs (verified:
+            # two jax.jit wrappers over self._update share the trace)
+            def _entry(params, grads, state, lr, step, wd):
+                self._wd_traced = wd if has_wd else None
+                try:
+                    return self._update(params, grads, state, lr, step)
+                finally:
+                    self._wd_traced = None
+            fn = jax.jit(_entry, donate_argnums=(0, 2))
+            self._update_fns[cache_key] = fn
+        new_params, new_state = fn(
+            params, grads, state, lr, step,
+            jnp.asarray(wd_val if has_wd else 0.0, jnp.float32))
         for (i, p), np_ in zip(params_with_grad, new_params):
             p._in_place_update(np_)
         for slot in new_state:

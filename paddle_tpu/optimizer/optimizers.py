@@ -142,13 +142,14 @@ class AdamW(Adam):
                 True if f is None else bool(f(p.name or f"param_{i}"))
                 for i, p in enumerate(self._parameter_list)]
 
+    def _decay_coeff_value(self):
+        return float(self._coeff()) if callable(self._coeff) else float(self._coeff)
+
     def _update(self, params, grads, state, lr, step):
-        # mark which params decay, then run Adam with decoupled decay
-        self._current_masks = self._decay_mask
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         bc1 = 1 - b1 ** step.astype(jnp.float32)
         bc2 = 1 - b2 ** step.astype(jnp.float32)
-        coeff = float(self._coeff) if not callable(self._coeff) else float(self._coeff())
+        coeff = self._wd_traced  # traced scalar: schedule-safe, no retrace
         new_p, new_m, new_v, new_vmax = [], [], [], []
         masters = state.get("master_weight")
         new_masters = []
@@ -178,6 +179,9 @@ class AdamW(Adam):
         if masters is not None:
             out_state["master_weight"] = new_masters
         return new_p, out_state
+
+    def _update_static_key(self):
+        return tuple(self._decay_mask or ())
 
     def step(self):
         # decay mask indexing must follow the filtered param subset

@@ -1,0 +1,213 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+
+
+class TestAutogradMultiOutputRoots:
+    def test_qr_both_outputs_backward(self):
+        # ADVICE #1: backward over two outputs of one multi-output op must
+        # not double-count producer in-degrees
+        x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"),
+                             stop_gradient=False)
+        y = x * 2.0  # producer node upstream of qr
+        q, r = paddle.qr(y)
+        loss = (q.sum() + r.sum())
+        loss.backward()
+        assert x.grad is not None
+        assert np.isfinite(np.asarray(x.grad._value)).all()
+
+    def test_grad_two_outputs(self):
+        x = paddle.to_tensor(np.random.randn(3, 3).astype("float32"),
+                             stop_gradient=False)
+        y = x + 1.0
+        q, r = paddle.qr(y)
+        ones_q = paddle.to_tensor(np.ones(q.shape, "float32"))
+        ones_r = paddle.to_tensor(np.ones(r.shape, "float32"))
+        gs = paddle.grad([q, r], [x], grad_outputs=[ones_q, ones_r],
+                         allow_unused=False)
+        assert gs[0] is not None
+        assert np.isfinite(np.asarray(gs[0]._value)).all()
+
+    def test_same_tensor_twice_as_root(self):
+        x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+        y = x * 3.0
+        z = y.sum()
+        z2 = (y * 1.0).sum()
+        paddle.autograd.backward([z, z2])
+        np.testing.assert_allclose(np.asarray(x.grad._value), [6.0])
+
+
+class TestGradScalerUnscaleOnce:
+    def test_unscale_then_step_no_double_divide(self):
+        # ADVICE #2: scaler.unscale_(opt); clip; scaler.step(opt) must
+        # divide gradients by the scale exactly once
+        p = paddle.to_tensor(np.zeros(3, "float32"), stop_gradient=False)
+        from paddle_tpu.core.tensor import Parameter
+        param = Parameter(p._value)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[param])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+
+        loss = (param * 3.0).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.unscale_(opt)
+        g_after_unscale = np.asarray(param.grad._value).copy()
+        np.testing.assert_allclose(g_after_unscale, [3.0, 3.0, 3.0])
+        scaler.step(opt)  # must NOT unscale again
+        scaler.update()
+        # sgd with lr=1: p = 0 - 3
+        np.testing.assert_allclose(np.asarray(param._value), [-3.0] * 3)
+
+    def test_step_without_unscale_still_unscales(self):
+        from paddle_tpu.core.tensor import Parameter
+        param = Parameter(np.zeros(2, "float32"))
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[param])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        loss = (param * 2.0).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(np.asarray(param._value), [-2.0] * 2)
+
+    def test_two_cycles_state_resets(self):
+        from paddle_tpu.core.tensor import Parameter
+        param = Parameter(np.zeros(2, "float32"))
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[param])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        for i in range(2):
+            opt.clear_grad()
+            loss = (param * 1.0).sum()
+            scaler.scale(loss).backward()
+            scaler.unscale_(opt)
+            scaler.step(opt)
+            scaler.update()
+        np.testing.assert_allclose(np.asarray(param._value), [-2.0] * 2)
+
+
+class TestSplitRemainder:
+    def test_non_divisible_split_raises(self):
+        # ADVICE #3: split(5, 2) must raise, not silently drop the tail
+        x = paddle.to_tensor(np.arange(5, dtype="float32"))
+        with pytest.raises(ValueError):
+            paddle.split(x, 2)
+
+    def test_divisible_split_ok(self):
+        x = paddle.to_tensor(np.arange(6, dtype="float32"))
+        a, b = paddle.split(x, 2)
+        np.testing.assert_allclose(np.asarray(a._value), [0, 1, 2])
+
+
+class TestAttentionDropout:
+    def test_dropout_applied_in_training(self):
+        # ADVICE #4: dropout_p must actually change the output
+        q = paddle.to_tensor(np.random.randn(2, 8, 4, 16).astype("float32"))
+        out0 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.0)
+        out9 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9,
+                                              training=True)
+        assert not np.allclose(np.asarray(out0._value),
+                               np.asarray(out9._value))
+
+    def test_dropout_off_in_eval(self):
+        q = paddle.to_tensor(np.random.randn(2, 8, 4, 16).astype("float32"))
+        out0 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.0)
+        oute = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9,
+                                              training=False)
+        np.testing.assert_allclose(np.asarray(out0._value),
+                                   np.asarray(oute._value), rtol=1e-6)
+
+
+class TestAdamWDecayMaskCache:
+    def test_changed_grad_subset_same_shapes(self):
+        # ADVICE #5: two same-shape params, alternate which one has a grad;
+        # decay must follow the active subset, not a stale trace
+        from paddle_tpu.core.tensor import Parameter
+        a = Parameter(np.ones(4, "float32"))
+        b = Parameter(np.ones(4, "float32"))
+        a.name, b.name = "w_decay", "b_nodecay"
+        # decay is lr-scaled, so use lr>0 with zero grads to isolate it
+        opt2 = paddle.optimizer.AdamW(
+            learning_rate=0.1, parameters=[a, b], weight_decay=0.5,
+            apply_decay_param_fun=lambda n: n == "w_decay")
+        # step 1: only `a` has a grad (zero grad → pure decay effect)
+        a.grad = Tensor(np.zeros(4, "float32"))
+        b.grad = None
+        opt2.step()
+        va1 = np.asarray(a._value).copy()
+        assert va1[0] < 1.0  # decayed
+        # step 2: only `b` has a grad — same shapes, different subset;
+        # b must NOT be decayed
+        a.grad = None
+        b.grad = Tensor(np.zeros(4, "float32"))
+        opt2.step()
+        vb = np.asarray(b._value)
+        np.testing.assert_allclose(vb, np.ones(4), rtol=1e-6)
+
+    def test_callable_weight_decay_schedule_not_stale(self):
+        # callable weight_decay must be re-evaluated each step, not baked
+        # into the first trace (and must not retrace per step)
+        from paddle_tpu.core.tensor import Parameter
+        coeffs = [0.5, 0.25]
+        it = {"i": 0}
+        param = Parameter(np.ones(4, "float32"))
+        opt = paddle.optimizer.Momentum(
+            learning_rate=1.0, momentum=0.0, parameters=[param],
+            weight_decay=lambda: coeffs[it["i"]])
+        param.grad = Tensor(np.zeros(4, "float32"))
+        opt.step()  # g + 0.5*p = 0.5 -> p = 1 - 0.5 = 0.5
+        np.testing.assert_allclose(np.asarray(param._value), [0.5] * 4)
+        it["i"] = 1
+        param.grad = Tensor(np.zeros(4, "float32"))
+        opt.step()  # g + 0.25*0.5 = 0.125 -> p = 0.5 - 0.125 = 0.375
+        np.testing.assert_allclose(np.asarray(param._value), [0.375] * 4)
+        assert len(opt._update_fns) == 1  # one trace for both coeffs
+
+    def test_adamw_scheduled_decay_single_trace(self):
+        from paddle_tpu.core.tensor import Parameter
+        vals = iter([0.5, 0.25, 0.125])
+        param = Parameter(np.ones(4, "float32"))
+        opt = paddle.optimizer.AdamW(
+            learning_rate=0.1, parameters=[param],
+            weight_decay=lambda: next(vals))
+        for _ in range(3):
+            param.grad = Tensor(np.zeros(4, "float32"))
+            opt.step()
+        assert len(opt._update_fns) == 1
+
+
+class TestGradScalerMultiOptimizer:
+    def test_two_optimizers_one_scaler(self):
+        # step(opt1) must not clear opt2's unscaled state mid-iteration
+        from paddle_tpu.core.tensor import Parameter
+        p1 = Parameter(np.zeros(2, "float32"))
+        p2 = Parameter(np.zeros(2, "float32"))
+        o1 = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p1])
+        o2 = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p2])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+        loss = (p1 * 3.0).sum() + (p2 * 5.0).sum()
+        scaler.scale(loss).backward()
+        scaler.unscale_(o1)
+        scaler.unscale_(o2)
+        scaler.step(o1)
+        scaler.step(o2)
+        scaler.update()
+        np.testing.assert_allclose(np.asarray(p1._value), [-3.0] * 2)
+        np.testing.assert_allclose(np.asarray(p2._value), [-5.0] * 2)
+
+    def test_scale_update_bookkeeping_once_per_iteration(self):
+        from paddle_tpu.core.tensor import Parameter
+        param = Parameter(np.zeros(2, "float32"))
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[param])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                       incr_every_n_steps=2)
+        for _ in range(2):
+            opt.clear_grad()
+            scaler.scale((param * 1.0).sum()).backward()
+            scaler.step(opt)
+            scaler.update()
+        # exactly 2 good steps -> one doubling
+        assert scaler._scale == 8.0
