@@ -43,15 +43,17 @@ def main():
     import os
     paddle.seed(0)
     if on_tpu:
-        # ~350M-param model, bf16 compute — big enough for stable MFU
+        # ~350M-param model, bf16 storage / fp32 master weights — big
+        # enough for stable MFU
         cfg = LlamaConfig(
             vocab_size=int(os.environ.get("BENCH_VOCAB", 32000)),
             hidden_size=int(os.environ.get("BENCH_HIDDEN", 1024)),
             intermediate_size=int(os.environ.get("BENCH_FF", 2816)),
             num_hidden_layers=int(os.environ.get("BENCH_LAYERS", 16)),
             num_attention_heads=16, num_key_value_heads=8,
-            max_position_embeddings=4096,
-            recompute=bool(int(os.environ.get("BENCH_RECOMPUTE", 1))))
+            max_position_embeddings=4096, dtype="bfloat16",
+            recompute=bool(int(os.environ.get("BENCH_RECOMPUTE", 1))),
+            recompute_granularity=os.environ.get("BENCH_REMAT", "core_attn"))
         batch = int(os.environ.get("BENCH_BATCH", 8))
         seq = int(os.environ.get("BENCH_SEQ", 2048))
         iters = int(os.environ.get("BENCH_ITERS", 20))
@@ -64,15 +66,12 @@ def main():
     model = LlamaForCausalLM(cfg)
     n_params = sum(p.size for p in model.parameters())
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters())
+                                 parameters=model.parameters(),
+                                 multi_precision=on_tpu)
     mesh = dist.ProcessMesh(shape=[len(jax.devices())], dim_names=["dp"])
     dist.shard_model_state(model, mesh)
 
-    def loss_fn(m, x, y):
-        with paddle.amp.auto_cast(dtype="bfloat16"):
-            return llama_loss_fn(m, x, y)
-
-    step = dist.DistTrainStep(model, opt, loss_fn, mesh, donate=True)
+    step = dist.DistTrainStep(model, opt, llama_loss_fn, mesh, donate=True)
     ids = paddle.to_tensor(
         np.random.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
 

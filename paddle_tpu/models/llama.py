@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from .. import nn
 from ..core.dispatch import defop
@@ -49,6 +50,10 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     tie_word_embeddings: bool = False
     recompute: bool = False
+    # reference recompute_granularity (fleet/meta_parallel recompute):
+    # "full" remats the whole layer; "core_attn" saves the projection /
+    # mlp matmul outputs and recomputes only the cheap elementwise core
+    recompute_granularity: str = "full"
     dtype: str = "float32"
     # moe (0 experts = dense)
     num_experts: int = 0
@@ -104,18 +109,15 @@ def _rms(x, w, eps):
 
 
 def _attention(q, k, v, causal=True):
-    """[b, s, h, d] flash attention (Pallas on TPU) with GQA key/value
-    broadcast."""
+    """[b, s, h, d] flash attention (Pallas on TPU). GQA-native: grouped
+    K/V are consumed directly (kernel indexes KV by head//group) instead
+    of materializing repeated heads on HBM."""
     from .. import flags
-    n_rep = q.shape[2] // k.shape[2]
-    if n_rep > 1:
-        k = jnp.repeat(k, n_rep, axis=2)
-        v = jnp.repeat(v, n_rep, axis=2)
     if flags.flag("use_pallas_kernels") and jax.default_backend() == "tpu":
         from ..kernels.flash_attention import flash_attention_fwd
         return flash_attention_fwd(q, k, v, causal=causal)
-    from ..nn.functional.attention import _sdpa_ref
-    return _sdpa_ref(q, k, v, causal=causal)
+    from ..kernels.flash_attention import _sdpa_reference
+    return _sdpa_reference(q, k, v, causal=causal)
 
 
 def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint):
@@ -130,13 +132,14 @@ def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint):
 
     # attention block
     y = _rms(x, lp["input_ln"], cfg.rms_norm_eps)
-    q = (y @ lp["wq"]).reshape(b, s, h, hd)
-    k = (y @ lp["wk"]).reshape(b, s, kvh, hd)
-    v = (y @ lp["wv"]).reshape(b, s, kvh, hd)
+    q = checkpoint_name(y @ lp["wq"], "qkv").reshape(b, s, h, hd)
+    k = checkpoint_name(y @ lp["wk"], "qkv").reshape(b, s, kvh, hd)
+    v = checkpoint_name(y @ lp["wv"], "qkv").reshape(b, s, kvh, hd)
     q = hint(_rope(q, positions, cfg.rope_theta, hd), "dp", "sep", "mp", None)
     k = hint(_rope(k, positions, cfg.rope_theta, hd), "dp", None, "mp", None)
     v = hint(v, "dp", None, "mp", None)
     attn = _attention(q, k, v, causal=True)
+    attn = checkpoint_name(attn, "attn_out")
     attn = attn.reshape(b, s, h * hd)
     x = x + hint(attn @ lp["wo"], "dp", "sep", None)
 
@@ -145,8 +148,8 @@ def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint):
     if cfg.num_experts > 0:
         x = x + _moe_mlp(cfg, lp, y, mesh_hint)
     else:
-        gate = jax.nn.silu(y @ lp["w_gate"])
-        up = y @ lp["w_up"]
+        gate = jax.nn.silu(checkpoint_name(y @ lp["w_gate"], "mlp_gate"))
+        up = checkpoint_name(y @ lp["w_up"], "mlp_up")
         x = x + hint((gate * up) @ lp["w_down"], "dp", "sep", None)
     return x
 
@@ -198,7 +201,17 @@ def _llama_forward(stacked, embed, final_norm, lm_head, token_ids, cfg,
         return out, None
 
     if cfg.recompute:
-        layer_fn = jax.checkpoint(layer_fn)
+        if cfg.recompute_granularity == "core_attn":
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "mlp_gate", "mlp_up", "qkv")
+            layer_fn = jax.checkpoint(layer_fn, policy=policy)
+        elif cfg.recompute_granularity == "full":
+            layer_fn = jax.checkpoint(layer_fn)
+        else:
+            raise ValueError(
+                f"unknown recompute_granularity "
+                f"{cfg.recompute_granularity!r}; expected 'full' or "
+                f"'core_attn'")
     x, _ = jax.lax.scan(layer_fn, x, stacked)
     x = _rms(x, final_norm, cfg.rms_norm_eps)
     logits = x @ lm_head
@@ -224,6 +237,10 @@ class LlamaForCausalLM(nn.Layer):
             from ..nn import initializer as I
             init = I.Constant(1.0) if ones else I.Normal(0.0, std)
             p = self.create_parameter(shape=shape, default_initializer=init)
+            if cfg.dtype != "float32":
+                # bf16 parameter storage (fp32 master weights live in the
+                # multi_precision optimizer; reference mix_precision_utils)
+                p._in_place_update(p._value.astype(cfg.dtype))
             p._dist_spec = spec
             self.add_parameter(name, p)
             return p

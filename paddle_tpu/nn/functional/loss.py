@@ -35,6 +35,10 @@ def _reduce(out, reduction):
 def _cross_entropy(logits, label, soft_label=False, ignore_index=-100,
                    reduction="mean", axis=-1, use_softmax=True,
                    label_smoothing=0.0, weight=None):
+    # softmax/log in f32 for bf16-stored models (reference numeric_stable
+    # softmax_with_cross_entropy semantics)
+    if logits.dtype in (jnp.bfloat16, jnp.float16):
+        logits = logits.astype(jnp.float32)
     if use_softmax:
         logp = jax.nn.log_softmax(logits, axis=axis)
     else:
