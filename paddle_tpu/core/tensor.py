@@ -26,7 +26,7 @@ __all__ = ["Tensor", "Parameter", "to_tensor"]
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_out_index",
                  "_grad_hooks", "name", "persistable", "dist_attr",
-                 "_dist_spec", "__weakref__")
+                 "_dist_spec", "_opt_shard_spec", "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True, name: str | None = None):
         if isinstance(value, Tensor):
@@ -43,6 +43,7 @@ class Tensor:
         self.persistable = False
         self.dist_attr = None
         self._dist_spec = None  # PartitionSpec annotation for pjit paths
+        self._opt_shard_spec = None  # ZeRO-1/2 optimizer-slot sharding
 
     # -- metadata ----------------------------------------------------------
     @property

@@ -49,10 +49,29 @@ def shard_layer(layer, process_mesh: ProcessMesh,
     return layer
 
 
-def shard_optimizer(optimizer, shard_fn=None):
-    """reference api.py shard_optimizer — optimizer state inherits parameter
-    placements; our Optimizer creates state per-param so this is structural.
-    shard_fn can override per-state specs."""
+def shard_optimizer(optimizer, shard_fn=None, axis="sharding"):
+    """reference api.py shard_optimizer — ZeRO-style optimizer-state
+    sharding: annotates each parameter with an ``_opt_shard_spec`` that
+    DistTrainStep applies to the param's optimizer slots (moments, master
+    weights), sharding the largest free dim over `axis` while the param
+    itself keeps its own placement. ``shard_fn(param, base_spec) -> spec``
+    overrides per-param."""
+    from .fleet.sharding import _best_shard_dim, _merge_spec
+    for p in optimizer._parameter_list:
+        if p.size < 1024:  # small params (biases) aren't worth sharding
+            continue
+        base = p._dist_spec if p._dist_spec is not None else (None,) * p.ndim
+        if shard_fn is not None:
+            spec = shard_fn(p, base)
+            if spec is not None:
+                p._opt_shard_spec = tuple(spec)
+            continue
+        if axis in str(base):
+            p._opt_shard_spec = tuple(base)
+            continue
+        dim = _best_shard_dim(p.shape, base, axis)
+        if dim is not None:
+            p._opt_shard_spec = _merge_spec(base, axis, dim)
     return optimizer
 
 

@@ -46,21 +46,49 @@ class ShardingSpec:
         self.axis = axis
 
 
+def _best_shard_dim(shape, spec, axis):
+    """Largest dim not already carrying `axis` (None if none usable)."""
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for d in order:
+        cur = spec[d] if d < len(spec) else None
+        axes = (cur,) if not isinstance(cur, (tuple, list)) else tuple(cur)
+        if axis not in axes:
+            return d
+    return None
+
+
 def apply_sharding_specs(model, stage=3, axis="sharding",
                          min_size_to_shard=1024):
-    """Annotate parameters for ZeRO-3: shard each parameter's largest dim
-    over the sharding axis (stage 3). Stage 1/2 leave parameters replicated
-    (optimizer state sharding is handled by the compiled step's state specs).
+    """Annotate parameters for ZeRO:
+
+    - stage 3: shard each parameter's largest dim over the sharding axis
+      (params + grads + optimizer state all follow).
+    - stage 1/2: parameters stay replicated, but each param gets an
+      ``_opt_shard_spec`` that DistTrainStep applies to its optimizer
+      slots (moments, master weights) — the reference's per-rank
+      optimizer-state partition (dygraph_sharding_optimizer.py:188 /
+      group_sharded_optimizer_stage2.py:53) expressed as GSPMD sharding.
+      Stage 2 additionally reduce-scatters grads into that layout before
+      the update (DistTrainStep applies the constraint).
     """
     for p in model.parameters():
         if p.size < min_size_to_shard:
             continue
+        base = p._dist_spec if p._dist_spec is not None else (None,) * p.ndim
         if stage >= 3:
-            dim = int(np.argmax(p.shape))
-            base = p._dist_spec if p._dist_spec is not None else (None,) * p.ndim
             if axis in str(base):
                 continue
+            dim = int(np.argmax(p.shape))
             p._dist_spec = _merge_spec(base, axis, dim)
+        else:
+            # slots carry the param's own spec (mp/pp axes) PLUS the
+            # sharding axis on the largest free dim
+            if axis in str(base):
+                p._opt_shard_spec = tuple(base)
+                continue
+            dim = _best_shard_dim(p.shape, base, axis)
+            if dim is not None:
+                p._opt_shard_spec = _merge_spec(base, axis, dim)
     model._sharding_spec = ShardingSpec(stage, axis)
     return model
 

@@ -27,11 +27,45 @@ __all__ = ["param_partition_spec", "shard_model_state", "DistTrainStep",
            "parallelize"]
 
 
+def _drop_indivisible(spec: P, shape, jax_mesh) -> P:
+    """Remove sharding axes whose mesh size doesn't divide the dim —
+    jax.device_put rejects uneven shards (annotations are written before
+    the mesh is known, so the guard lives here where the mesh is)."""
+    out = []
+    for d, entry in enumerate(spec):
+        if entry is None or d >= len(shape):
+            out.append(entry)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        kept, div = [], 1
+        for a in axes:
+            n = jax_mesh.shape[a]
+            if shape[d] % (div * n) == 0:
+                kept.append(a)
+                div *= n
+        out.append(tuple(kept) if len(kept) > 1 else
+                   (kept[0] if kept else None))
+    return P(*out)
+
+
 def param_partition_spec(p: Tensor, jax_mesh) -> P:
     spec = p._dist_spec
     if spec is None:
         return P()
-    return _filter_spec(tuple(spec), jax_mesh)
+    return _drop_indivisible(_filter_spec(tuple(spec), jax_mesh),
+                             p._value.shape, jax_mesh)
+
+
+def opt_slot_partition_spec(p: Tensor, jax_mesh) -> P:
+    """Sharding for a parameter's optimizer slots. ZeRO stage 1/2 shards
+    optimizer state over the 'sharding' axis even while the param itself
+    is replicated (reference dygraph_sharding_optimizer /
+    group_sharded_optimizer_stage2); stage 3 state follows the param."""
+    spec = getattr(p, "_opt_shard_spec", None)
+    if spec is None:
+        return param_partition_spec(p, jax_mesh)
+    return _drop_indivisible(_filter_spec(tuple(spec), jax_mesh),
+                             p._value.shape, jax_mesh)
 
 
 def _batch_spec(jax_mesh, ndim: int) -> P:
@@ -83,9 +117,11 @@ class DistTrainStep:
         buffer_shardings = [NamedSharding(jm, param_partition_spec(b, jm))
                             for b in self._buffers]
         opt_shardings = {
-            slot: [NamedSharding(jm, param_partition_spec(p, jm))
+            slot: [NamedSharding(jm, opt_slot_partition_spec(p, jm))
                    for p in self._params]
             for slot in opt._accumulators}
+        zero_stage = getattr(
+            getattr(self.model, "_sharding_spec", None), "stage", 0)
         # commit optimizer state to its shardings now — otherwise the first
         # call compiles against uncommitted arrays and the second call
         # (committed outputs fed back in) recompiles
@@ -127,6 +163,16 @@ class DistTrainStep:
                 with sharding_ctx(jm):
                     loss = self.loss_fn(self.model, *args)
                     loss.backward()
+                    if zero_stage >= 2:
+                        # stage-2: reduce-scatter grads into the optimizer
+                        # shard layout before the update (reference
+                        # group_sharded_stage2 grad hooks)
+                        for t in self._params:
+                            if t.grad is None:
+                                continue
+                            spec = opt_slot_partition_spec(t, jm)
+                            t.grad._value = jax.lax.with_sharding_constraint(
+                                t.grad._value, NamedSharding(jm, spec))
                     opt.step()
                 new_params = [t._value for t in self._params]
                 new_buffers = [t._value for t in self._buffers]

@@ -60,6 +60,13 @@ class LlamaConfig:
     num_experts_per_tok: int = 2
     moe_capacity_factor: float = 1.25
 
+    def __post_init__(self):
+        if self.recompute_granularity not in ("full", "core_attn"):
+            raise ValueError(
+                f"unknown recompute_granularity "
+                f"{self.recompute_granularity!r}; expected 'full' or "
+                f"'core_attn'")
+
     @property
     def head_dim(self):
         return self.hidden_size // self.num_attention_heads

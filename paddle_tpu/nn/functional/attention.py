@@ -33,6 +33,14 @@ def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None,
               training=True):
     """Reference attention in pure XLA ops. Layout: [B, S, H, D] (paddle
     flash_attention layout)."""
+    if k.shape[2] != q.shape[2]:  # GQA on the fallback path: repeat K/V
+        if q.shape[2] % k.shape[2] != 0:
+            raise ValueError(
+                f"query heads ({q.shape[2]}) must be a multiple of "
+                f"key/value heads ({k.shape[2]})")
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qh = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)

@@ -374,3 +374,75 @@ class TestShardedCheckpoint:
         dist.shard_model_state(model, mesh1)
         dist.load_state_dict(model.state_dict(), path)
         assert np.allclose(_np(model.weight), ref)
+
+
+class TestZeroStage12:
+    """ZeRO-1/2: optimizer state sharded over 'sharding' while params stay
+    replicated (reference dygraph_sharding_optimizer.py:39,
+    group_sharded_optimizer_stage2.py:53)."""
+
+    def _run(self, stage):
+        paddle.seed(33)
+        model = nn.Sequential(nn.Linear(64, 64), nn.ReLU(),
+                              nn.Linear(64, 8))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        from paddle_tpu.distributed.fleet.sharding import apply_sharding_specs
+        apply_sharding_specs(model, stage=stage)
+        mesh = dist.ProcessMesh(shape=[2, 4], dim_names=["dp", "sharding"])
+        dist.shard_model_state(model, mesh)
+        step = dist.DistTrainStep(
+            model, opt, lambda m, a, b: F.cross_entropy(m(a), b), mesh,
+            donate=False)
+        x = np.random.RandomState(5).randn(16, 64).astype(np.float32)
+        y = np.random.RandomState(6).randint(0, 8, (16,))
+        losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+                  for _ in range(3)]
+        return model, opt, losses
+
+    def _reference(self):
+        paddle.seed(33)
+        model = nn.Sequential(nn.Linear(64, 64), nn.ReLU(),
+                              nn.Linear(64, 8))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        x = np.random.RandomState(5).randn(16, 64).astype(np.float32)
+        y = np.random.RandomState(6).randint(0, 8, (16,))
+        losses = []
+        for _ in range(3):
+            loss = F.cross_entropy(model(paddle.to_tensor(x)),
+                                   paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        return model, losses
+
+    @pytest.mark.parametrize("stage", [1, 2])
+    def test_opt_state_sharded_param_replicated(self, stage):
+        model, opt, _ = self._run(stage)
+        w = model[0].weight  # 64x64 >= min_size_to_shard
+        # param replicated
+        assert "sharding" not in str(w._value.sharding.spec)
+        # its moments sharded over the sharding axis
+        idx = [id(p) for p in opt._parameter_list].index(id(w))
+        m1 = opt._accumulators["moment1"][idx]
+        assert "sharding" in str(m1.sharding.spec), m1.sharding
+        m2 = opt._accumulators["moment2"][idx]
+        assert "sharding" in str(m2.sharding.spec)
+
+    @pytest.mark.parametrize("stage", [1, 2])
+    def test_numeric_parity_vs_single_device(self, stage):
+        ref_model, ref_losses = self._reference()
+        model, _, losses = self._run(stage)
+        assert np.allclose(ref_losses, losses, atol=1e-4), (ref_losses,
+                                                            losses)
+        for p1, p2 in zip(ref_model.parameters(), model.parameters()):
+            assert np.allclose(_np(p1), _np(p2), atol=1e-4)
+
+    def test_shard_optimizer_api(self):
+        model = nn.Sequential(nn.Linear(64, 64))
+        opt = paddle.optimizer.AdamW(parameters=model.parameters())
+        opt = dist.shard_optimizer(opt)
+        w = model[0].weight
+        assert "sharding" in str(w._opt_shard_spec)
