@@ -56,22 +56,16 @@ def shard_optimizer(optimizer, shard_fn=None, axis="sharding"):
     weights), sharding the largest free dim over `axis` while the param
     itself keeps its own placement. ``shard_fn(param, base_spec) -> spec``
     overrides per-param."""
-    from .fleet.sharding import _best_shard_dim, _merge_spec
+    from .fleet.sharding import annotate_opt_shard_spec
     for p in optimizer._parameter_list:
-        if p.size < 1024:  # small params (biases) aren't worth sharding
-            continue
-        base = p._dist_spec if p._dist_spec is not None else (None,) * p.ndim
         if shard_fn is not None:
+            base = p._dist_spec if p._dist_spec is not None \
+                else (None,) * p.ndim
             spec = shard_fn(p, base)
             if spec is not None:
                 p._opt_shard_spec = tuple(spec)
             continue
-        if axis in str(base):
-            p._opt_shard_spec = tuple(base)
-            continue
-        dim = _best_shard_dim(p.shape, base, axis)
-        if dim is not None:
-            p._opt_shard_spec = _merge_spec(base, axis, dim)
+        annotate_opt_shard_spec(p, axis)
     return optimizer
 
 

@@ -57,6 +57,23 @@ def _best_shard_dim(shape, spec, axis):
     return None
 
 
+def annotate_opt_shard_spec(p, axis="sharding", min_size_to_shard=1024):
+    """Stage-1/2 annotation for ONE param: keep the param's own placement
+    but give its optimizer slots the sharding axis on the largest free dim
+    (shared by apply_sharding_specs and distributed.shard_optimizer)."""
+    if p.size < min_size_to_shard:
+        return
+    base = p._dist_spec if p._dist_spec is not None else (None,) * p.ndim
+    axes_used = {a for e in base for a in
+                 (e if isinstance(e, (tuple, list)) else (e,))}
+    if axis in axes_used:
+        p._opt_shard_spec = tuple(base)
+        return
+    dim = _best_shard_dim(p.shape, base, axis)
+    if dim is not None:
+        p._opt_shard_spec = _merge_spec(base, axis, dim)
+
+
 def apply_sharding_specs(model, stage=3, axis="sharding",
                          min_size_to_shard=1024):
     """Annotate parameters for ZeRO:
@@ -83,12 +100,7 @@ def apply_sharding_specs(model, stage=3, axis="sharding",
         else:
             # slots carry the param's own spec (mp/pp axes) PLUS the
             # sharding axis on the largest free dim
-            if axis in str(base):
-                p._opt_shard_spec = tuple(base)
-                continue
-            dim = _best_shard_dim(p.shape, base, axis)
-            if dim is not None:
-                p._opt_shard_spec = _merge_spec(base, axis, dim)
+            annotate_opt_shard_spec(p, axis, min_size_to_shard)
     model._sharding_spec = ShardingSpec(stage, axis)
     return model
 

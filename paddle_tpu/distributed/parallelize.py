@@ -30,7 +30,11 @@ __all__ = ["param_partition_spec", "shard_model_state", "DistTrainStep",
 def _drop_indivisible(spec: P, shape, jax_mesh) -> P:
     """Remove sharding axes whose mesh size doesn't divide the dim —
     jax.device_put rejects uneven shards (annotations are written before
-    the mesh is known, so the guard lives here where the mesh is)."""
+    the mesh is known, so the guard lives here where the mesh is). Dropping
+    an axis replicates that dim, so warn: it usually means a misconfigured
+    mesh degree (odd vocab/ff size vs mp), and the memory/perf cost is
+    silent otherwise."""
+    import warnings
     out = []
     for d, entry in enumerate(spec):
         if entry is None or d >= len(shape):
@@ -43,6 +47,11 @@ def _drop_indivisible(spec: P, shape, jax_mesh) -> P:
             if shape[d] % (div * n) == 0:
                 kept.append(a)
                 div *= n
+            else:
+                warnings.warn(
+                    f"sharding axis {a!r} (size {n}) dropped: dim {d} of "
+                    f"shape {tuple(shape)} is not divisible — the dim is "
+                    f"replicated instead", RuntimeWarning, stacklevel=3)
         out.append(tuple(kept) if len(kept) > 1 else
                    (kept[0] if kept else None))
     return P(*out)
@@ -87,6 +96,18 @@ def shard_model_state(model, mesh: ProcessMesh):
     return model
 
 
+def _resolve_zero_stage(model) -> int:
+    """apply_sharding_specs stamps ``_sharding_spec`` on the layer it was
+    given — which may be wrapped (GroupShardedStage2/3, meta_parallel
+    wrappers hold the inner layer as ``_layer``/``_layers``)."""
+    for obj in (model, getattr(model, "_layer", None),
+                getattr(model, "_layers", None)):
+        spec = getattr(obj, "_sharding_spec", None)
+        if spec is not None:
+            return spec.stage
+    return 0
+
+
 class DistTrainStep:
     """Whole hybrid-parallel train step in one XLA executable
     (dp/tp/fsdp/sep/ep via GSPMD; pp via spmd_pipeline models)."""
@@ -120,8 +141,7 @@ class DistTrainStep:
             slot: [NamedSharding(jm, opt_slot_partition_spec(p, jm))
                    for p in self._params]
             for slot in opt._accumulators}
-        zero_stage = getattr(
-            getattr(self.model, "_sharding_spec", None), "stage", 0)
+        zero_stage = _resolve_zero_stage(self.model)
         # commit optimizer state to its shardings now — otherwise the first
         # call compiles against uncommitted arrays and the second call
         # (committed outputs fed back in) recompiles

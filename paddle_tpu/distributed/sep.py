@@ -1,0 +1,189 @@
+"""Long-context attention over the 'sep' mesh axis.
+
+The reference's sep axis (fleet/base/topology.py:64,184,226 + SegmentParallel
+meta_parallel/segment_parallel.py:26 + four_directions_p2p_communication.py)
+shards the sequence across workers but ships no library attention op — the
+model must cooperate. SURVEY §5 mandates the TPU build supply a real one:
+
+- ``ring_attention``: K/V blocks rotate around the sep ring via
+  ``lax.ppermute`` (ICI neighbor exchange) while each shard's queries
+  accumulate with an online softmax — FlashAttention-style streaming where
+  the "blocks" are whole shards. Memory per chip is O(S/n), comm is the
+  bandwidth-optimal ring. (RingAttention, Liu et al.; blockwise parallel
+  transformers.)
+- ``ulysses_attention``: DeepSpeed-Ulysses-style all-to-all head-scatter —
+  seq-sharding is exchanged for head-sharding, each chip runs full-sequence
+  flash attention on H/n heads, and a reverse all-to-all restores the seq
+  sharding. Cheaper at moderate S (two all-to-alls vs n-1 permutes) but
+  requires num_kv_heads % sep == 0.
+
+Both run INSIDE the jitted program as ``jax.shard_map`` regions manual over
+{'sep'} only — dp/mp stay on GSPMD auto, so TP head-sharding composes with
+sequence sharding.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "ulysses_attention", "sep_attention",
+           "ring_attention_local"]
+
+_NEG_INF = -1e30
+
+
+def _grouped(x):
+    """[b, s, h, d] -> [b, hkv(=h), s, d] head-major."""
+    return jnp.swapaxes(x, 1, 2)
+
+
+def ring_attention_local(q, k, v, axis_name: str, n_shards: int,
+                         causal: bool = True):
+    """Per-shard ring attention body (call inside shard_map over
+    ``axis_name``). q: [b, sq, h, d]; k, v: [b, sk, hkv, d] — all local
+    shards of a sequence laid out in contiguous blocks (GSPMD 'sep'
+    sharding). Returns the local output [b, sq, h, d]."""
+    b, sq, h, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    g = h // hkv
+    scale = 1.0 / (d ** 0.5)
+    my = lax.axis_index(axis_name)
+
+    qh = _grouped(q).reshape(b, hkv, g, sq, d)
+    m0 = jnp.full((b, hkv, g, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, sq), jnp.float32)
+    acc0 = jnp.zeros((b, hkv, g, sq, d), jnp.float32)
+    if hasattr(lax, "pcast"):  # mark accumulators sep-varying (vma typing)
+        m0, l0, acc0 = (lax.pcast(a, (axis_name,), to="varying")
+                        for a in (m0, l0, acc0))
+    elif hasattr(lax, "pvary"):
+        m0, l0, acc0 = (lax.pvary(a, (axis_name,)) for a in (m0, l0, acc0))
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    q_pos = my * sq + jnp.arange(sq)
+
+    def block(t, k_cur, v_cur, m, l, acc):
+        # after t hops my block originated on rank (my - t) mod n
+        src = (my - t) % n_shards
+        kh = _grouped(k_cur)                                  # [b, hkv, sk, d]
+        vh = _grouped(v_cur)
+        s = jnp.einsum("bngsd,bntd->bngst", qh, kh,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = src * sk + jnp.arange(sk)
+            mask = q_pos[:, None] >= k_pos[None, :]           # [sq, sk]
+            s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bngst,bntd->bngsd", p.astype(v_cur.dtype), vh,
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    def step(carry, t):
+        k_cur, v_cur, m, l, acc = carry
+        m, l, acc = block(t, k_cur, v_cur, m, l, acc)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, m, l, acc), None
+
+    # n-1 compute+rotate steps, then the last block without the rotation
+    # (its permute result would be dead, but XLA can't DCE a collective
+    # inside the scan body)
+    (k, v, m, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n_shards - 1))
+    m, l, acc = block(n_shards - 1, k, v, m, l, acc)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    out = out.reshape(b, hkv * g, sq, d)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def _sep_specs(mesh, axis_name):
+    from jax.sharding import PartitionSpec as P
+    seq = P(None, axis_name, None, None)
+    return seq
+
+
+def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
+                   mesh=None):
+    """Ring attention on full [b, s, h, d] arrays whose seq dim is (to be)
+    sharded over ``axis_name``. Works under jit with a GSPMD mesh; falls
+    back to plain attention when the axis is absent or size 1."""
+    mesh = mesh or _current_mesh()
+    n = _axis_size(mesh, axis_name)
+    if n <= 1:
+        from ..kernels.flash_attention import _sdpa_reference
+        return _sdpa_reference(q, k, v, causal)
+    spec = _sep_specs(mesh, axis_name)
+    fn = functools.partial(ring_attention_local, axis_name=axis_name,
+                           n_shards=n, causal=causal)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={axis_name})(q, k, v)
+
+
+def ulysses_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
+                      mesh=None):
+    """All-to-all (Ulysses) attention: trade seq-sharding for head-sharding,
+    run full-sequence flash attention locally, trade back."""
+    mesh = mesh or _current_mesh()
+    n = _axis_size(mesh, axis_name)
+    if n <= 1:
+        from ..kernels.flash_attention import _sdpa_reference
+        return _sdpa_reference(q, k, v, causal)
+    if q.shape[2] % n or k.shape[2] % n:
+        raise ValueError(
+            f"ulysses_attention needs heads ({q.shape[2]}) and kv heads "
+            f"({k.shape[2]}) divisible by sep={n}; use ring_attention")
+    spec = _sep_specs(mesh, axis_name)
+
+    def local(q, k, v):
+        # [b, s/n, h, d] -> [b, s, h/n, d]
+        q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+        k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+        v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                           tiled=True)
+        from ..kernels.flash_attention import flash_attention_fwd
+        out = flash_attention_fwd(q, k, v, causal=causal)
+        return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    # check_vma off: pallas_call inside shard_map can't express output vma
+    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={axis_name},
+                         check_vma=False)(q, k, v)
+
+
+def sep_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
+                  mesh=None, mode: str | None = None):
+    """Dispatch: the library attention op over a sep-sharded sequence
+    (discharges the SegmentParallel promise — reference ships none). mode in
+    {'ring', 'alltoall', None=auto}: auto picks alltoall when heads divide
+    evenly (cheaper comm), else ring."""
+    mesh = mesh or _current_mesh()
+    n = _axis_size(mesh, axis_name)
+    if mode is None:
+        from .. import flags
+        mode = flags.flag("sep_attention_mode")
+    if mode == "alltoall" or (mode == "auto" and n > 1
+                              and q.shape[2] % n == 0
+                              and k.shape[2] % n == 0):
+        return ulysses_attention(q, k, v, causal, axis_name, mesh)
+    return ring_attention(q, k, v, causal, axis_name, mesh)
+
+
+def _current_mesh():
+    from .fleet.mp_layers import current_mesh
+    return current_mesh()
+
+
+def _axis_size(mesh, axis_name) -> int:
+    if mesh is None or axis_name not in getattr(mesh, "axis_names", ()):
+        return 1
+    return mesh.shape[axis_name]

@@ -106,5 +106,7 @@ _set_matmul_precision(flag("tpu_default_matmul_precision"))
 define_flag("eager_op_cache", True, "Cache per-op jitted executables for eager dispatch.")
 define_flag("use_pallas_kernels", True, "Use Pallas kernels (flash attention etc.) when on TPU.")
 define_flag("log_level", 0, "Verbose log level (reference GLOG_v analogue).")
+define_flag("sep_attention_mode", "ring",
+            "Attention over a sep-sharded sequence: ring|alltoall|auto.")
 define_flag("allocator_strategy", "auto_growth", "Kept for API parity; PJRT owns device memory on TPU.")
 define_flag("comm_timeout_seconds", 1800, "Collective watchdog timeout (reference NCCLCommTask 30min default).")

@@ -365,7 +365,10 @@ def flash_attention_fwd(q, k, v, causal=False):
         raise ValueError(
             f"query heads ({H}) must be a multiple of key/value heads "
             f"({Hkv}) for grouped-query attention")
-    if S % 8 != 0 or D % 8 != 0:
+    # S % 128: the q/k block sizes must be lane-aligned multiples of 128 —
+    # Mosaic rejects lse/delta blocks whose last-dim offset (qblk*bq) isn't
+    # provably 128-aligned (seen on v5e with S=64 → bq=64)
+    if S % 128 != 0 or D % 8 != 0:
         return _sdpa_reference(q, k, v, causal)
     interpret = jax.default_backend() != "tpu"
     return flash_attention(q, k, v, causal, interpret)

@@ -118,8 +118,16 @@ def _rms(x, w, eps):
 def _attention(q, k, v, causal=True):
     """[b, s, h, d] flash attention (Pallas on TPU). GQA-native: grouped
     K/V are consumed directly (kernel indexes KV by head//group) instead
-    of materializing repeated heads on HBM."""
+    of materializing repeated heads on HBM. When the sequence is sharded
+    over a sep axis (>1), attention runs as ring / all-to-all attention
+    over ICI neighbors (distributed.sep) instead of gathering K/V."""
     from .. import flags
+    from ..distributed.fleet.mp_layers import current_mesh
+    mesh = current_mesh()
+    if mesh is not None and "sep" in mesh.axis_names \
+            and mesh.shape["sep"] > 1:
+        from ..distributed.sep import sep_attention
+        return sep_attention(q, k, v, causal=causal, mesh=mesh)
     if flags.flag("use_pallas_kernels") and jax.default_backend() == "tpu":
         from ..kernels.flash_attention import flash_attention_fwd
         return flash_attention_fwd(q, k, v, causal=causal)
@@ -142,9 +150,11 @@ def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint):
     q = checkpoint_name(y @ lp["wq"], "qkv").reshape(b, s, h, hd)
     k = checkpoint_name(y @ lp["wk"], "qkv").reshape(b, s, kvh, hd)
     v = checkpoint_name(y @ lp["wv"], "qkv").reshape(b, s, kvh, hd)
+    # K/V stay sep-sharded: ring/all-to-all attention (distributed.sep)
+    # consumes them in place of the allgather the reference would issue
     q = hint(_rope(q, positions, cfg.rope_theta, hd), "dp", "sep", "mp", None)
-    k = hint(_rope(k, positions, cfg.rope_theta, hd), "dp", None, "mp", None)
-    v = hint(v, "dp", None, "mp", None)
+    k = hint(_rope(k, positions, cfg.rope_theta, hd), "dp", "sep", "mp", None)
+    v = hint(v, "dp", "sep", "mp", None)
     attn = _attention(q, k, v, causal=True)
     attn = checkpoint_name(attn, "attn_out")
     attn = attn.reshape(b, s, h * hd)
@@ -208,17 +218,13 @@ def _llama_forward(stacked, embed, final_norm, lm_head, token_ids, cfg,
         return out, None
 
     if cfg.recompute:
+        # granularity validated in LlamaConfig.__post_init__
         if cfg.recompute_granularity == "core_attn":
             policy = jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "mlp_gate", "mlp_up", "qkv")
             layer_fn = jax.checkpoint(layer_fn, policy=policy)
-        elif cfg.recompute_granularity == "full":
-            layer_fn = jax.checkpoint(layer_fn)
         else:
-            raise ValueError(
-                f"unknown recompute_granularity "
-                f"{cfg.recompute_granularity!r}; expected 'full' or "
-                f"'core_attn'")
+            layer_fn = jax.checkpoint(layer_fn)
     x, _ = jax.lax.scan(layer_fn, x, stacked)
     x = _rms(x, final_norm, cfg.rms_norm_eps)
     logits = x @ lm_head
