@@ -103,10 +103,10 @@ def ring_attention_local(q, k, v, axis_name: str, n_shards: int,
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-def _sep_specs(mesh, axis_name):
+def _seq_spec(axis_name):
+    """[b, s, h, d] with the seq dim over the sep axis."""
     from jax.sharding import PartitionSpec as P
-    seq = P(None, axis_name, None, None)
-    return seq
+    return P(None, axis_name, None, None)
 
 
 def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
@@ -119,7 +119,7 @@ def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
     if n <= 1:
         from ..kernels.flash_attention import _sdpa_reference
         return _sdpa_reference(q, k, v, causal)
-    spec = _sep_specs(mesh, axis_name)
+    spec = _seq_spec(axis_name)
     fn = functools.partial(ring_attention_local, axis_name=axis_name,
                            n_shards=n, causal=causal)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
@@ -139,7 +139,7 @@ def ulysses_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
         raise ValueError(
             f"ulysses_attention needs heads ({q.shape[2]}) and kv heads "
             f"({k.shape[2]}) divisible by sep={n}; use ring_attention")
-    spec = _sep_specs(mesh, axis_name)
+    spec = _seq_spec(axis_name)
 
     def local(q, k, v):
         # [b, s/n, h, d] -> [b, s, h/n, d]

@@ -545,3 +545,116 @@ class TestWrapperShardingVisibility:
         opt = paddle.optimizer.AdamW(parameters=model.parameters())
         wrapped, opt, _ = group_sharded_parallel(model, opt, "os_g")
         assert _resolve_zero_stage(wrapped) == 2
+
+
+class TestPipelineParallelFlagship:
+    """Real pipeline schedule wired into the flagship (VERDICT #3): when the
+    mesh has pp>1, the decoder stack runs through spmd_pipeline inside
+    shard_map (stage-local weights + microbatched ppermute), not
+    scan-over-pp-sharded-weights."""
+
+    def _mesh(self):
+        return dist.ProcessMesh(shape=[2, 2, 1, 1, 2],
+                                dim_names=["dp", "pp", "sep", "ep", "mp"])
+
+    def test_forward_and_grads_match_single_device(self):
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_loss_fn
+        from paddle_tpu.distributed.fleet.mp_layers import sharding_ctx
+        paddle.seed(3)
+        model = LlamaForCausalLM("debug")
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (4, 32), dtype=np.int32))
+        ref_out = _np(model(ids))
+        mesh = self._mesh()
+        dist.shard_model_state(model, mesh)
+        with sharding_ctx(mesh.jax_mesh):
+            out = _np(model(ids))
+            loss = llama_loss_fn(model, ids, ids)
+            loss.backward()
+        assert np.allclose(out, ref_out, atol=1e-4)
+        g_pp = {n: _np(p.grad) for n, p in model.named_parameters()
+                if p.grad is not None}
+
+        paddle.seed(3)
+        ref = LlamaForCausalLM("debug")
+        ref_loss = llama_loss_fn(ref, ids, ids)
+        ref_loss.backward()
+        assert abs(float(loss) - float(ref_loss)) < 1e-4
+        for n, p in ref.named_parameters():
+            if p.grad is None:
+                continue
+            assert np.allclose(g_pp[n], _np(p.grad), atol=1e-3), n
+
+    def test_no_full_weight_allgather_in_hlo(self):
+        """The pipelined program must not allgather the full stacked weight
+        (that would be the FSDP-over-depth failure mode)."""
+        import re
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        from paddle_tpu.distributed.fleet.mp_layers import sharding_ctx
+        paddle.seed(3)
+        model = LlamaForCausalLM("debug")
+        mesh = self._mesh()
+        dist.shard_model_state(model, mesh)
+        ids = np.random.randint(0, 128, (4, 32), dtype=np.int32)
+
+        def f(ids_arr):
+            with sharding_ctx(mesh.jax_mesh):
+                return model(Tensor(ids_arr))._value
+
+        txt = jax.jit(f).lower(jnp.asarray(ids)).compile().as_text()
+        L = model.config.num_hidden_layers          # 2, pp-sharded to 1
+        ff = model.config.intermediate_size
+        # an all-gather producing a full [L, *, ff] stacked weight means
+        # per-layer weight gathering; stage-local slices are [L/pp, ...]
+        pat = re.compile(r"all-gather[^\n]*\[%d,\d+,%d\]" % (L, ff))
+        assert not pat.search(txt), pat.search(txt).group(0)
+
+    def test_dist_train_step_pp_matches_single(self):
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_loss_fn
+        paddle.seed(5)
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (4, 32), dtype=np.int32))
+
+        ref = LlamaForCausalLM("debug")
+        ropt = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=ref.parameters())
+        ref_losses = []
+        for _ in range(3):
+            loss = llama_loss_fn(ref, ids, ids)
+            loss.backward()
+            ropt.step()
+            ropt.clear_grad()
+            ref_losses.append(float(loss))
+
+        paddle.seed(5)
+        model = LlamaForCausalLM("debug")
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        mesh = self._mesh()
+        dist.shard_model_state(model, mesh)
+        step = dist.DistTrainStep(model, opt, llama_loss_fn, mesh,
+                                  donate=False)
+        losses = [float(step(ids, ids)) for _ in range(3)]
+        assert np.allclose(ref_losses, losses, atol=1e-3), (ref_losses,
+                                                            losses)
+
+
+class TestPipelineSepComposition:
+    def test_pp_with_sep_axis_runs(self):
+        """pp>1 + sep>1: the pipeline stage must fall back to gathered
+        attention (nested sep shard_map doesn't compose inside the
+        manual-pp region) — regression for a crash."""
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        from paddle_tpu.distributed.fleet.mp_layers import sharding_ctx
+        paddle.seed(4)
+        model = LlamaForCausalLM("debug")
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (4, 32), dtype=np.int32))
+        ref = _np(model(ids))
+        mesh = dist.ProcessMesh(shape=[1, 2, 2, 1, 2],
+                                dim_names=["dp", "pp", "sep", "ep", "mp"])
+        dist.shard_model_state(model, mesh)
+        with sharding_ctx(mesh.jax_mesh):
+            out = _np(model(ids))
+        assert np.allclose(out, ref, atol=1e-4)
