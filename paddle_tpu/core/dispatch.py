@@ -37,11 +37,23 @@ def register_op(name: str, fn: Callable) -> None:
 
 
 def _check_nan_inf(name: str, arrays) -> None:
+    """reference FLAGS_check_nan_inf (eager nan_inf_utils.h:38). Jit-safe:
+    under a trace, concrete bool() would raise TracerBoolConversionError, so
+    traced values use jax.debug.check-style error (checkify-free
+    debug.print + error at runtime via error_if)."""
+    import jax
     for a in arrays:
-        if jnp.issubdtype(a.dtype, jnp.floating):
-            bad = ~jnp.isfinite(a)
-            if bool(bad.any()):
-                raise FloatingPointError(f"op {name!r} produced NaN/Inf")
+        if not jnp.issubdtype(a.dtype, jnp.floating):
+            continue
+        bad = ~jnp.isfinite(a)
+        if isinstance(a, jax.core.Tracer):
+            def _raise_if_bad(n_bad, name=name):
+                if int(n_bad) > 0:
+                    raise FloatingPointError(
+                        f"op {name!r} produced {int(n_bad)} NaN/Inf values")
+            jax.debug.callback(_raise_if_bad, bad.sum())
+        elif bool(bad.any()):
+            raise FloatingPointError(f"op {name!r} produced NaN/Inf")
 
 
 def apply_op(name: str, fn: Callable, args: tuple, kwargs: dict,

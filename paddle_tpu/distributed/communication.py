@@ -122,7 +122,18 @@ def _single_process(group) -> bool:
         and get_world_size() == 1
 
 
-def _mh():
+def _mh(group=None):
+    """Multihost collectives are FULL-WORLD (every process must enter);
+    entering with a proper subgroup would deadlock the other ranks, so
+    raise instead (reference subgroups ride per-ring NCCL comms we don't
+    have an eager analogue for yet)."""
+    if group is not None and group.ranks and \
+            len(group.ranks) != get_world_size():
+        raise NotImplementedError(
+            f"eager cross-host collectives support only the default "
+            f"(full-world) group; got subgroup ranks={group.ranks}. Use "
+            f"compiled collectives (fcollectives / shard_map) for "
+            f"per-axis communication.")
     from jax.experimental import multihost_utils
     return multihost_utils
 
@@ -134,7 +145,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if _single_process(group):
         return _Task(tensor._value)
     # cross-host: sum over all processes via global broadcast trick
-    mh = _mh()
+    mh = _mh(group)
     gathered = mh.process_allgather(np.asarray(tensor._value))
     if op in (ReduceOp.SUM, ReduceOp.AVG):
         out = gathered.sum(axis=0)
@@ -154,7 +165,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if _single_process(group):
         tensor_list.append(Tensor(tensor._value))
         return _Task(tensor._value)
-    mh = _mh()
+    mh = _mh(group)
     gathered = mh.process_allgather(np.asarray(tensor._value))
     for i in range(gathered.shape[0]):
         tensor_list.append(Tensor(jnp.asarray(gathered[i])))
@@ -166,7 +177,7 @@ def all_gather_object(object_list, obj, group=None):
         object_list.append(obj)
         return
     import pickle
-    mh = _mh()
+    mh = _mh(group)
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     # pad to max length across hosts
     n = np.asarray([payload.size])
@@ -182,7 +193,7 @@ def all_gather_object(object_list, obj, group=None):
 def broadcast(tensor, src=0, group=None, sync_op=True):
     if _single_process(group):
         return _Task(tensor._value)
-    mh = _mh()
+    mh = _mh(group)
     out = mh.broadcast_one_to_all(np.asarray(tensor._value),
                                   is_source=get_rank() == src)
     tensor._in_place_update(jnp.asarray(out))
@@ -194,43 +205,122 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """reference communication/scatter.py: src's tensor_list[i] → rank i.
+    Cross-host: the list is broadcast from src over DCN, each rank keeps
+    its element (control-plane path; the hot path is GSPMD sharding)."""
     if _single_process(group):
         if tensor_list:
             tensor._in_place_update(tensor_list[get_rank()]._value)
         return _Task(tensor._value)
-    raise NotImplementedError("cross-host eager scatter: use sharded io")
+    mh = _mh(group)
+    rank = get_rank()
+    stackd = (np.stack([np.asarray(t._value) for t in tensor_list])
+              if rank == src else
+              np.zeros((get_world_size(),) + tuple(np.asarray(
+                  tensor._value).shape), np.asarray(tensor._value).dtype))
+    out = mh.broadcast_one_to_all(stackd, is_source=rank == src)
+    tensor._in_place_update(jnp.asarray(out[rank]))
+    return _Task(tensor._value)
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """reference communication/all_to_all.py: rank r's out[i] = rank i's
+    in[r]. Cross-host control-plane form: allgather the stacked inputs,
+    slice my column (bandwidth-suboptimal but correct; the hot path — MoE
+    dispatch — is lax.all_to_all compiled inside the program)."""
     if _single_process(group):
         out_tensor_list.extend(Tensor(t._value) for t in in_tensor_list)
         return _Task(None)
-    raise NotImplementedError(
-        "cross-host eager all_to_all: the compiled path (fleet MoE) uses "
-        "lax.all_to_all inside shard_map")
+    mh = _mh(group)
+    rank = get_rank()
+    stacked = np.stack([np.asarray(t._value) for t in in_tensor_list])
+    gathered = mh.process_allgather(stacked)        # [world, world, ...]
+    for i in range(gathered.shape[0]):
+        out_tensor_list.append(Tensor(jnp.asarray(gathered[i][rank])))
+    return _Task(None)
 
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
+    """reference communication/reduce_scatter.py: elementwise reduce of the
+    per-rank lists, rank r keeps element r."""
     if _single_process(group):
         acc = tensor_list[0]._value
         for t in tensor_list[1:]:
             acc = acc + t._value
         tensor._in_place_update(acc)
         return _Task(tensor._value)
-    raise NotImplementedError("cross-host eager reduce_scatter")
+    mh = _mh(group)
+    rank = get_rank()
+    stacked = np.stack([np.asarray(t._value) for t in tensor_list])
+    gathered = mh.process_allgather(stacked)        # [world, world, ...]
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        red = gathered.sum(axis=0)
+        if op == ReduceOp.AVG:
+            red = red / gathered.shape[0]
+    elif op == ReduceOp.MAX:
+        red = gathered.max(axis=0)
+    elif op == ReduceOp.MIN:
+        red = gathered.min(axis=0)
+    else:
+        red = gathered.prod(axis=0)
+    tensor._in_place_update(jnp.asarray(red[rank]))
+    return _Task(tensor._value)
+
+
+# -- host-level p2p over the DCN KV store -----------------------------------
+# The reference's send/recv ride NCCL p2p (process_group.h:118-234). On TPU
+# the data plane between jitted programs is GSPMD/ppermute; the eager p2p
+# surface here is a control-plane channel over the jax.distributed
+# coordination service's KV store — correct, modest-bandwidth, and honest
+# about it (raises when no distributed runtime is initialized).
+_P2P_SEQ: dict[tuple[int, int], int] = {}
+
+
+def _kv_client():
+    from jax._src import distributed
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "send/recv need jax.distributed (init_parallel_env with "
+            "world_size > 1, e.g. via paddle_tpu.distributed.launch)")
+    return client
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
     if _single_process(group):
         return _Task(None)
-    raise NotImplementedError("host-level p2p: planned over DCN store")
+    import base64
+    client = _kv_client()
+    seq = _P2P_SEQ.get((get_rank(), dst), 0)
+    _P2P_SEQ[(get_rank(), dst)] = seq + 1
+    payload = base64.b64encode(np.asarray(tensor._value).tobytes()).decode()
+    client.key_value_set(f"ptpu_p2p/{get_rank()}/{dst}/{seq}", payload)
+    return _Task(None)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     if _single_process(group):
         return _Task(None)
-    raise NotImplementedError("host-level p2p: planned over DCN store")
+    import base64
+    client = _kv_client()
+    seq = _P2P_SEQ.get((src, get_rank()), 0)
+    from .. import flags
+    timeout_ms = 1000 * int(flags.flag("comm_timeout_seconds"))
+    key = f"ptpu_p2p/{src}/{get_rank()}/{seq}"
+    payload = client.blocking_key_value_get(key, timeout_ms)
+    # advance the stream only after a successful get (a timeout must not
+    # desynchronize subsequent messages) and free the coordinator's copy
+    _P2P_SEQ[(src, get_rank())] = seq + 1
+    try:
+        client.key_value_delete(key)
+    except Exception:  # noqa: BLE001 — cleanup is best-effort
+        pass
+    arr = np.frombuffer(base64.b64decode(payload),
+                        dtype=np.asarray(tensor._value).dtype)
+    tensor._in_place_update(
+        jnp.asarray(arr.reshape(np.asarray(tensor._value).shape)))
+    return _Task(tensor._value)
 
 
 isend = send
@@ -246,7 +336,19 @@ class P2POp:
 
 
 def batch_isend_irecv(p2p_op_list):
-    return [_Task(None) for _ in p2p_op_list]
+    """reference communication/batch_isend_irecv.py. Executes each op (sends
+    first so the KV channel is populated before blocking recvs)."""
+    if get_world_size() == 1:
+        if p2p_op_list and any(
+                op.op in (recv, irecv) for op in p2p_op_list):
+            raise RuntimeError(
+                "batch_isend_irecv with recv ops needs world_size > 1 "
+                "(single-process run has no peer to receive from)")
+        return [_Task(None) for _ in p2p_op_list]
+    tasks = []
+    for p in sorted(p2p_op_list, key=lambda p: p.op not in (send, isend)):
+        tasks.append(p.op(p.tensor, p.peer, p.group))
+    return tasks
 
 
 class stream:

@@ -29,7 +29,11 @@ def init_parallel_env():
                                 os.environ.get("JAX_NUM_PROCESSES", "1")))
     rank = int(os.environ.get("PADDLE_TRAINER_ID",
                               os.environ.get("JAX_PROCESS_ID", "0")))
-    if coord and nprocs > 1:
+    from jax._src import distributed as _jd
+    already = _jd.global_state.client is not None
+    if coord and nprocs > 1 and not already:
+        # normally already connected by the paddle_tpu import-time hook
+        # (package __init__) — this path covers raw-jax entrypoints
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nprocs, process_id=rank)
     _initialized[0] = True

@@ -1,0 +1,121 @@
+"""Distributed launcher (reference: python/paddle/distributed/launch/
+main.py:18, controllers/collective.py, job/pod.py).
+
+``python -m paddle_tpu.distributed.launch --nproc_per_node N script.py``
+spawns one worker process per rank on this host, wires the
+``PADDLE_TRAINER_*`` / JAX coordinator environment the same way the
+reference wires PADDLE_TRAINER_ENDPOINTS, tails logs, and propagates
+failures (kill the pod on first worker death, reference watchdog).
+
+TPU mapping: one process per HOST (each owning its local chips) is the
+JAX multi-controller model; rendezvous is jax.distributed.initialize
+(the reference's TCPStore). ``init_parallel_env`` in the child picks the
+env up.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(script: str, script_args=(), nproc_per_node: int = 1,
+           master: str | None = None, log_dir: str = "log",
+           job_id: str = "default", envs: dict | None = None,
+           python: str | None = None, tail: bool = True) -> int:
+    """Spawn ``nproc_per_node`` workers running ``script``; returns the
+    first nonzero exit code (0 if all succeed). Reference
+    controllers/collective.py CollectiveController.build_pod."""
+    master = master or f"127.0.0.1:{_free_port()}"
+    os.makedirs(log_dir, exist_ok=True)
+    endpoints = ",".join(f"127.0.0.1:{_free_port()}"
+                         for _ in range(nproc_per_node))
+    eps = endpoints.split(",")
+    procs: list[subprocess.Popen] = []
+    logs = []
+    for rank in range(nproc_per_node):
+        env = dict(os.environ)
+        env.update(envs or {})
+        # the launching dir stays importable in workers (python script.py
+        # puts the script's dir, not cwd, on sys.path)
+        env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        env.update({
+            "PADDLE_MASTER": master,
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nproc_per_node),
+            "PADDLE_CURRENT_ENDPOINT": eps[rank],
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_JOB_ID": job_id,
+            # JAX-native names too, so raw jax scripts work under launch
+            "JAX_COORDINATOR_ADDRESS": master,
+            "JAX_NUM_PROCESSES": str(nproc_per_node),
+            "JAX_PROCESS_ID": str(rank),
+        })
+        logf = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+        logs.append(logf)
+        procs.append(subprocess.Popen(
+            [python or sys.executable, "-u", script, *script_args],
+            env=env, stdout=logf, stderr=subprocess.STDOUT))
+
+    rc = 0
+    try:
+        pos = 0
+        log0 = os.path.join(log_dir, "workerlog.0")
+        while True:
+            codes = [p.poll() for p in procs]
+            if tail and os.path.exists(log0):
+                with open(log0) as f:
+                    f.seek(pos)
+                    chunk = f.read()
+                    pos = f.tell()
+                if chunk:
+                    sys.stdout.write(chunk)
+                    sys.stdout.flush()
+            if any(c not in (None, 0) for c in codes):
+                rc = next(c for c in codes if c not in (None, 0))
+                for p in procs:            # pod failure: kill siblings
+                    if p.poll() is None:
+                        p.send_signal(signal.SIGTERM)
+                break
+            if all(c == 0 for c in codes):
+                break
+            time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+    return rc
+
+
+def main(argv=None):
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="paddle_tpu distributed launcher")
+    parser.add_argument("--nproc_per_node", "--nprocs", "-nproc", type=int,
+                        default=1)
+    parser.add_argument("--master", default=None,
+                        help="coordinator host:port (default: local free port)")
+    parser.add_argument("--log_dir", default="log")
+    parser.add_argument("--job_id", default="default")
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    return launch(args.script, args.script_args,
+                  nproc_per_node=args.nproc_per_node, master=args.master,
+                  log_dir=args.log_dir, job_id=args.job_id)

@@ -22,30 +22,54 @@ class DataParallel(nn.Layer):
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
         self._grads_synced = False
+        self._in_no_sync = False
+        self._unsynced: set[int] = set()  # params with no_sync'd grads
         if get_world_size() > 1:
             from .fleet.utils import broadcast_dp_parameters
             broadcast_dp_parameters(layers, None)
-        # register grad hooks: on backward completion grads are averaged
+        # per-grad allreduce hooks — the reference EagerReducer's
+        # MarkVarReady→bucketed allreduce (reducer.h:107), unbucketed here:
+        # each grad is averaged across processes as backward produces it
         if get_world_size() > 1:
+            from ..core.tensor import Tensor
             from .communication import ReduceOp, all_reduce
+            n = get_world_size()
             for p in layers.parameters():
                 if not p.stop_gradient:
-                    def _hook(g, _p=p):
-                        return g  # eager sync happens in sync_gradients
+                    def _hook(g, _p=p, _n=n):
+                        # g is the raw cotangent array (autograd.py applies
+                        # _grad_hooks to cotangents, not Tensors)
+                        if self._in_no_sync:
+                            self._unsynced.add(id(_p))
+                            return g
+                        if id(_p) in self._unsynced and _p.grad is not None:
+                            # grads accumulated under no_sync: sync the
+                            # stored grad too so the total is avg(g1+g2),
+                            # matching the reference reducer (which reduces
+                            # the accumulated var, reducer.cc MarkVarReady)
+                            all_reduce(_p.grad, op=ReduceOp.SUM)
+                            _p.grad._in_place_update(_p.grad._value / _n)
+                            self._unsynced.discard(id(_p))
+                        t = Tensor(g._value if isinstance(g, Tensor) else g)
+                        all_reduce(t, op=ReduceOp.SUM)
+                        return t._value / _n
                     p.register_hook(_hook)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
     def sync_gradients(self):
+        """Explicit sync for grads produced under no_sync (reference
+        fused_allreduce_gradients)."""
         if get_world_size() <= 1:
             return
         from .communication import ReduceOp, all_reduce
         n = get_world_size()
         for p in self._layers.parameters():
-            if p.grad is not None:
+            if p.grad is not None and id(p) in self._unsynced:
                 all_reduce(p.grad, op=ReduceOp.SUM)
                 p.grad._in_place_update(p.grad._value / n)
+                self._unsynced.discard(id(p))
 
     # passthrough API parity
     def state_dict(self, *a, **k):
@@ -64,5 +88,15 @@ class DataParallel(nn.Layer):
         return loss
 
     def no_sync(self):
-        from contextlib import nullcontext
-        return nullcontext()
+        """Skip grad sync inside (gradient accumulation; reference
+        DataParallel.no_sync)."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def ctx():
+            self._in_no_sync = True
+            try:
+                yield
+            finally:
+                self._in_no_sync = False
+        return ctx()

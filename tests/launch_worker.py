@@ -1,0 +1,134 @@
+"""Worker script for the launch CLI test (reference analogue:
+test/collective/ per-API scripts run by TestDistBase multi-process).
+
+Run under ``python -m paddle_tpu.distributed.launch --nproc_per_node 2``;
+exercises the cross-host eager communication surface over the
+jax.distributed CPU rendezvous."""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == 2, f"expected world=2, got {world}"
+
+    # all_reduce
+    t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    np.testing.assert_allclose(np.asarray(t._value), np.full((4,), 3.0))
+
+    # all_gather
+    outs = []
+    dist.all_gather(outs, paddle.to_tensor(
+        np.full((2,), float(rank), np.float32)))
+    assert len(outs) == 2
+    np.testing.assert_allclose(np.asarray(outs[1]._value), [1.0, 1.0])
+
+    # broadcast
+    b = paddle.to_tensor(np.full((3,), float(rank * 7 + 1), np.float32))
+    dist.broadcast(b, src=0)
+    np.testing.assert_allclose(np.asarray(b._value), np.full((3,), 1.0))
+
+    # scatter (src=0 holds [10, 11])
+    target = paddle.zeros([2])
+    parts = [paddle.to_tensor(np.full((2,), 10.0 + i, np.float32))
+             for i in range(2)] if rank == 0 else None
+    dist.scatter(target, parts, src=0)
+    np.testing.assert_allclose(np.asarray(target._value),
+                               np.full((2,), 10.0 + rank))
+
+    # all_to_all: rank r sends [r*10+i] to rank i
+    ins = [paddle.to_tensor(np.full((2,), float(rank * 10 + i), np.float32))
+           for i in range(2)]
+    outs = []
+    dist.all_to_all(outs, ins)
+    for i in range(2):
+        np.testing.assert_allclose(np.asarray(outs[i]._value),
+                                   np.full((2,), float(i * 10 + rank)))
+
+    # reduce_scatter
+    rs = paddle.zeros([2])
+    dist.reduce_scatter(rs, ins)  # sum over ranks of ins[j], keep mine
+    expect = np.full((2,), float(0 * 10 + rank) + float(1 * 10 + rank))
+    np.testing.assert_allclose(np.asarray(rs._value), expect)
+
+    # send / recv over the KV store
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.arange(4, dtype=np.float32)), dst=1)
+    else:
+        buf = paddle.zeros([4])
+        dist.recv(buf, src=0)
+        np.testing.assert_allclose(np.asarray(buf._value), np.arange(4.0))
+
+    # batch_isend_irecv ring exchange
+    from paddle_tpu.distributed.communication import P2POp, batch_isend_irecv
+    send_t = paddle.to_tensor(np.full((2,), float(rank), np.float32))
+    recv_t = paddle.zeros([2])
+    ops = [P2POp(dist.communication.send, send_t, (rank + 1) % 2),
+           P2POp(dist.communication.recv, recv_t, (rank + 1) % 2)]
+    batch_isend_irecv(ops)
+    np.testing.assert_allclose(np.asarray(recv_t._value),
+                               np.full((2,), float((rank + 1) % 2)))
+
+    # eager DataParallel: per-grad allreduce hooks (EagerReducer analogue)
+    import paddle_tpu.nn as nn
+    paddle.seed(7)  # same init on both ranks
+    model = dist.DataParallel(nn.Linear(4, 2))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    np.random.seed(100 + rank)  # different data per rank
+    x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    # after averaged grads, params must be identical across ranks
+    w = np.asarray(model.parameters()[0]._value)
+    outs = []
+    dist.all_gather(outs, paddle.to_tensor(w))
+    np.testing.assert_allclose(np.asarray(outs[0]._value),
+                               np.asarray(outs[1]._value), atol=1e-6)
+
+    # no_sync gradient accumulation: avg(g1+g2) parity with the reference
+    # reducer (grads from the no_sync backward get synced on the next
+    # normal backward)
+    paddle.seed(9)
+    m2 = dist.DataParallel(nn.Linear(4, 2))
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                parameters=m2.parameters())
+    np.random.seed(200 + rank)
+    xa = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+    xb = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+    with m2.no_sync():
+        (m2(xa) ** 2).mean().backward()
+    (m2(xb) ** 2).mean().backward()
+    opt2.step()
+    w2 = np.asarray(m2.parameters()[0]._value)
+    outs2 = []
+    dist.all_gather(outs2, paddle.to_tensor(w2))
+    np.testing.assert_allclose(np.asarray(outs2[0]._value),
+                               np.asarray(outs2[1]._value), atol=1e-6)
+
+    # subgroup guard: eager cross-host collective with a proper subgroup
+    # must raise, not deadlock
+    g01 = dist.new_group([0])
+    try:
+        dist.all_reduce(paddle.to_tensor([1.0]), group=g01)
+        raise AssertionError("subgroup all_reduce should raise")
+    except NotImplementedError:
+        pass
+
+    dist.barrier()
+    print(f"rank {rank}: COMM_OK")
+
+
+if __name__ == "__main__":
+    main()

