@@ -36,6 +36,12 @@ def register_op(name: str, fn: Callable) -> None:
     OP_REGISTRY[name] = fn
 
 
+# Observers called as f(op_name) on every dispatch — the hook point for the
+# profiler's per-op RecordEvent (reference: kernels auto-annotated at
+# dispatch, platform/profiler) and for test coverage accounting.
+OP_OBSERVERS: list[Callable[[str], None]] = []
+
+
 def _check_nan_inf(name: str, arrays) -> None:
     """reference FLAGS_check_nan_inf (eager nan_inf_utils.h:38). Jit-safe:
     under a trace, concrete bool() would raise TracerBoolConversionError, so
@@ -64,6 +70,8 @@ def apply_op(name: str, fn: Callable, args: tuple, kwargs: dict,
     Tensor args become vjp primals. Outputs are Tensors. ``fn`` must be pure
     and jax-traceable.
     """
+    for obs in OP_OBSERVERS:
+        obs(name)
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     arrays = [a._value if isinstance(a, Tensor) else a for a in args]
 

@@ -147,18 +147,23 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     # cross-host: sum over all processes via global broadcast trick
     mh = _mh(group)
     gathered = mh.process_allgather(np.asarray(tensor._value))
+    tensor._in_place_update(jnp.asarray(_reduce_gathered(gathered, op)))
+    return _Task(tensor._value)
+
+
+def _reduce_gathered(gathered, op):
+    """Reduce a [world, ...] stack per ReduceOp (shared by all_reduce and
+    reduce_scatter)."""
     if op in (ReduceOp.SUM, ReduceOp.AVG):
         out = gathered.sum(axis=0)
-        if op == ReduceOp.AVG:
-            out = out / get_world_size(group)
-    elif op == ReduceOp.MAX:
-        out = gathered.max(axis=0)
-    elif op == ReduceOp.MIN:
-        out = gathered.min(axis=0)
-    else:
-        out = gathered.prod(axis=0)
-    tensor._in_place_update(jnp.asarray(out))
-    return _Task(tensor._value)
+        return out / gathered.shape[0] if op == ReduceOp.AVG else out
+    if op == ReduceOp.MAX:
+        return gathered.max(axis=0)
+    if op == ReduceOp.MIN:
+        return gathered.min(axis=0)
+    if op == ReduceOp.PROD:
+        return gathered.prod(axis=0)
+    raise ValueError(f"unknown ReduceOp {op!r}")
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
@@ -254,16 +259,7 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
     rank = get_rank()
     stacked = np.stack([np.asarray(t._value) for t in tensor_list])
     gathered = mh.process_allgather(stacked)        # [world, world, ...]
-    if op in (ReduceOp.SUM, ReduceOp.AVG):
-        red = gathered.sum(axis=0)
-        if op == ReduceOp.AVG:
-            red = red / gathered.shape[0]
-    elif op == ReduceOp.MAX:
-        red = gathered.max(axis=0)
-    elif op == ReduceOp.MIN:
-        red = gathered.min(axis=0)
-    else:
-        red = gathered.prod(axis=0)
+    red = _reduce_gathered(gathered, op)
     tensor._in_place_update(jnp.asarray(red[rank]))
     return _Task(tensor._value)
 
@@ -299,21 +295,15 @@ def send(tensor, dst=0, group=None, sync_op=True):
     return _Task(None)
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
-    if _single_process(group):
-        return _Task(None)
+def _recv_at(tensor, src, seq):
     import base64
     client = _kv_client()
-    seq = _P2P_SEQ.get((src, get_rank()), 0)
     from .. import flags
     timeout_ms = 1000 * int(flags.flag("comm_timeout_seconds"))
     key = f"ptpu_p2p/{src}/{get_rank()}/{seq}"
     payload = client.blocking_key_value_get(key, timeout_ms)
-    # advance the stream only after a successful get (a timeout must not
-    # desynchronize subsequent messages) and free the coordinator's copy
-    _P2P_SEQ[(src, get_rank())] = seq + 1
     try:
-        client.key_value_delete(key)
+        client.key_value_delete(key)  # free the coordinator's copy
     except Exception:  # noqa: BLE001 — cleanup is best-effort
         pass
     arr = np.frombuffer(base64.b64decode(payload),
@@ -323,8 +313,57 @@ def recv(tensor, src=0, group=None, sync_op=True):
     return _Task(tensor._value)
 
 
-isend = send
-irecv = recv
+def recv(tensor, src=0, group=None, sync_op=True):
+    if _single_process(group):
+        return _Task(None)
+    seq = _P2P_SEQ.get((src, get_rank()), 0)
+    out = _recv_at(tensor, src, seq)
+    # advance the stream only after a successful get (a timeout must not
+    # desynchronize subsequent messages)
+    _P2P_SEQ[(src, get_rank())] = seq + 1
+    return out
+
+
+class _AsyncTask(_Task):
+    """Task backed by a worker thread (irecv must not block the caller —
+    the canonical irecv-then-send exchange would deadlock otherwise)."""
+
+    def __init__(self, thread):
+        super().__init__(None)
+        self._thread = thread
+
+    def wait(self):
+        self._thread.join()
+
+    def is_completed(self):
+        return not self._thread.is_alive()
+
+
+def isend(tensor, dst=0, group=None, sync_op=True):
+    """Async send (reference communication/isend). key_value_set is quick,
+    but keep the contract uniform with irecv."""
+    import threading
+    th = threading.Thread(target=send, args=(tensor, dst, group),
+                          daemon=True)
+    th.start()
+    return _AsyncTask(th)
+
+
+def irecv(tensor, src=0, group=None, sync_op=True):
+    """Async recv: returns immediately; the KV-store block happens on a
+    worker thread, so irecv-before-send exchange patterns can't deadlock.
+    The sequence slot is reserved synchronously (concurrent irecvs from
+    one peer target successive messages); a timed-out slot is burned."""
+    import threading
+    if _single_process(group):
+        return _Task(None)
+    _kv_client()  # fail fast without a distributed runtime
+    seq = _P2P_SEQ.get((src, get_rank()), 0)
+    _P2P_SEQ[(src, get_rank())] = seq + 1
+    th = threading.Thread(target=_recv_at, args=(tensor, src, seq),
+                          daemon=True)
+    th.start()
+    return _AsyncTask(th)
 
 
 class P2POp:

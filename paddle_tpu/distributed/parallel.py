@@ -30,7 +30,12 @@ class DataParallel(nn.Layer):
         # per-grad allreduce hooks — the reference EagerReducer's
         # MarkVarReady→bucketed allreduce (reducer.h:107), unbucketed here:
         # each grad is averaged across processes as backward produces it
-        if get_world_size() > 1:
+        # find_unused_parameters=True: a param may get a grad on only some
+        # ranks, so per-grad hooks (full-world collectives) would deadlock;
+        # sync deferred to sync_gradients, which zero-fills missing grads
+        # so every rank enters every collective (reference reducer marks
+        # unused vars ready instead).
+        if get_world_size() > 1 and not find_unused_parameters:
             from ..core.tensor import Tensor
             from .communication import ReduceOp, all_reduce
             n = get_world_size()
@@ -63,13 +68,23 @@ class DataParallel(nn.Layer):
         fused_allreduce_gradients)."""
         if get_world_size() <= 1:
             return
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
         from .communication import ReduceOp, all_reduce
         n = get_world_size()
         for p in self._layers.parameters():
-            if p.grad is not None and id(p) in self._unsynced:
-                all_reduce(p.grad, op=ReduceOp.SUM)
-                p.grad._in_place_update(p.grad._value / n)
-                self._unsynced.discard(id(p))
+            # sync every trainable param (zero-filled when this rank saw no
+            # grad) so every rank enters every collective in the same
+            # order — idempotent for hook-synced grads (identical values
+            # average to themselves) and covers params unfrozen after
+            # wrapping, which never got a hook
+            if p.stop_gradient and p.grad is None:
+                continue
+            if p.grad is None:
+                p.grad = Tensor(jnp.zeros_like(p._value))
+            all_reduce(p.grad, op=ReduceOp.SUM)
+            p.grad._in_place_update(p.grad._value / n)
+            self._unsynced.discard(id(p))
 
     # passthrough API parity
     def state_dict(self, *a, **k):

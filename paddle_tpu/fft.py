@@ -1,0 +1,240 @@
+"""paddle_tpu.fft — discrete Fourier transforms (reference:
+python/paddle/fft.py, ~30 functions over pocketfft).
+
+Lowering: jnp.fft (XLA native). The XLA TPU backend supports neither FFT
+nor the complex dtype at all (UNIMPLEMENTED), so under a TPU default
+backend every transform hops to the host CPU device via an in-graph
+jax.device_put — the same shape as the reference's CPU pocketfft path —
+and gradients flow back through the transfer. The private ``_dft*``
+helpers implement the transform as real matmuls on the MXU (complex
+arithmetic decomposed into 4 real GEMMs); they are the TPU-side building
+block for real-valued pipelines (audio spectrograms) that never need a
+complex array, and are parity-tested on CPU. Norm semantics match
+numpy/the reference: "backward" (default), "ortho", "forward".
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import defop
+from .core.tensor import Tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2",
+           "ifft2", "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "fftshift", "ifftshift", "fftfreq", "rfftfreq"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _host_call(jfn, x, **kw):
+    """On TPU (no complex support) compute on the host CPU device — inputs
+    device_put to CPU and the call run under jax.default_device(cpu) so
+    jnp.fft's internal scalars also land there; the transfer is in-graph
+    so vjp moves grads back automatically."""
+    if jax.default_backend() == "tpu":
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            return jfn(jax.device_put(x, cpu), **kw)
+    return jfn(x, **kw)
+
+
+# ---------------------------------------------------------------------------
+# DFT-as-matmul core (TPU path)
+# ---------------------------------------------------------------------------
+def _dft_mats(n, inverse, dtype):
+    j = jnp.arange(n, dtype=dtype)
+    ang = (2.0 * jnp.pi / n) * jnp.outer(j, j)
+    sgn = 1.0 if inverse else -1.0
+    return jnp.cos(ang), sgn * jnp.sin(ang)        # W = Wr + i·Wi
+
+
+def _split(x):
+    if jnp.iscomplexobj(x):
+        return jnp.real(x), jnp.imag(x)
+    return x, None
+
+
+def _resize_axis(x, n, axis):
+    cur = x.shape[axis]
+    if n is None or n == cur:
+        return x
+    if n < cur:
+        return jax.lax.slice_in_dim(x, 0, n, axis=axis)
+    pads = [(0, 0, 0)] * x.ndim
+    pads[axis] = (0, n - cur, 0)
+    return jax.lax.pad(x, jnp.zeros((), x.dtype), pads)
+
+
+def _norm_scale(n, norm, inverse):
+    if norm == "ortho":
+        return 1.0 / jnp.sqrt(jnp.asarray(float(n)))
+    if (norm == "forward") != inverse:
+        # forward-norm fft or backward-norm ifft carries the 1/n
+        return 1.0 / n
+    return 1.0
+
+
+def _dft1d(x, n, axis, norm, inverse):
+    """Full complex DFT along ``axis`` via real matmuls."""
+    x = _resize_axis(x, n, axis) if n is not None else x
+    n = x.shape[axis]
+    rdt = jnp.finfo(x.dtype).dtype if jnp.issubdtype(
+        x.dtype, jnp.floating) else jnp.float32
+    if jnp.iscomplexobj(x):
+        rdt = jnp.real(x).dtype
+    Wr, Wi = _dft_mats(n, inverse, rdt)
+    xm = jnp.moveaxis(x, axis, -1)
+    xr, xi = _split(xm)
+    yr = xr @ Wr - (xi @ Wi if xi is not None else 0.0)
+    yi = xr @ Wi + (xi @ Wr if xi is not None else 0.0)
+    s = _norm_scale(n, norm, inverse)
+    out = jax.lax.complex(yr * s, yi * s)
+    return jnp.moveaxis(out, -1, axis)
+
+
+def _dft_rfft(x, n, axis, norm):
+    full = _dft1d(x, n, axis, norm, inverse=False)
+    m = full.shape[axis] // 2 + 1
+    return jax.lax.slice_in_dim(full, 0, m, axis=axis)
+
+
+def _dft_irfft(x, n, axis, norm):
+    m = x.shape[axis]
+    n = n if n is not None else 2 * (m - 1)
+    # rebuild the hermitian spectrum: full[:m] = x, full[n-k] = conj(x[k])
+    x = _resize_axis(x, n // 2 + 1, axis)
+    body = jax.lax.slice_in_dim(x, 1, (n + 1) // 2, axis=axis)
+    tail = jnp.conj(jnp.flip(body, axis=axis))
+    full = jnp.concatenate([x, tail], axis=axis)
+    out = _dft1d(full, None, axis, norm, inverse=True)
+    return jnp.real(out)
+
+
+# ---------------------------------------------------------------------------
+# op builders — jnp.fft, hopped to the host CPU device under a TPU backend
+# ---------------------------------------------------------------------------
+def _fft_raw(x, n, axis, norm):
+    return _host_call(jnp.fft.fft, x, n=n, axis=axis, norm=norm)
+
+
+def _ifft_raw(x, n, axis, norm):
+    return _host_call(jnp.fft.ifft, x, n=n, axis=axis, norm=norm)
+
+
+def _rfft_raw(x, n, axis, norm):
+    return _host_call(jnp.fft.rfft, x, n=n, axis=axis, norm=norm)
+
+
+def _irfft_raw(x, n, axis, norm):
+    return _host_call(jnp.fft.irfft, x, n=n, axis=axis, norm=norm)
+
+
+def _hfft_raw(x, n, axis, norm):
+    return _host_call(jnp.fft.hfft, x, n=n, axis=axis, norm=norm)
+
+
+def _ihfft_raw(x, n, axis, norm):
+    return _host_call(jnp.fft.ihfft, x, n=n, axis=axis, norm=norm)
+
+
+def _fftn_raw(x, s, axes, norm, inverse, real_last=None):
+    """n-d DFT via per-axis matmul transforms (TPU-side real building
+    block; see module docstring)."""
+    if axes is None:
+        axes = tuple(range(x.ndim)) if s is None else \
+            tuple(range(x.ndim - len(s), x.ndim))
+    axes = tuple(a % x.ndim for a in axes)
+    s = list(s) if s is not None else [None] * len(axes)
+    if real_last == "rfft":
+        # rfft on the last listed axis, complex fft on the rest
+        x = _dft_rfft(x, s[-1], axes[-1], norm)
+        for a, nn in zip(axes[:-1], s[:-1]):
+            x = _dft1d(x, nn, a, norm, inverse=False)
+        return x
+    if real_last == "irfft":
+        for a, nn in zip(axes[:-1], s[:-1]):
+            x = _dft1d(x, nn, a, norm, inverse=True)
+        return _dft_irfft(x, s[-1], axes[-1], norm)
+    for a, nn in zip(axes, s):
+        x = _dft1d(x, nn, a, norm, inverse)
+    return x
+
+
+def _mk1d(name, raw):
+    @defop(name)
+    def _op(x, n=None, axis=-1, norm="backward"):
+        return raw(x, n, axis, norm)
+
+    def api(x, n=None, axis=-1, norm="backward", name=None):
+        return _op(_t(x), n=n, axis=axis, norm=norm)
+    api.__name__ = name
+    api.__doc__ = f"reference python/paddle/fft.py {name}."
+    return api
+
+
+fft = _mk1d("fft", _fft_raw)
+ifft = _mk1d("ifft", _ifft_raw)
+rfft = _mk1d("rfft", _rfft_raw)
+irfft = _mk1d("irfft", _irfft_raw)
+hfft = _mk1d("hfft", _hfft_raw)
+ihfft = _mk1d("ihfft", _ihfft_raw)
+
+
+def _mknd(name, default_axes=None):
+    @defop(name)
+    def _op(x, s=None, axes=None, norm="backward"):
+        jfn = getattr(jnp.fft, name)
+        return _host_call(jfn, x, s=s,
+                          axes=axes if axes is not None else default_axes,
+                          norm=norm)
+
+    def api(x, s=None, axes=default_axes, norm="backward", name=None):
+        return _op(_t(x), s=s,
+                   axes=tuple(axes) if axes is not None else None, norm=norm)
+    api.__name__ = name
+    api.__doc__ = f"reference python/paddle/fft.py {name}."
+    return api
+
+
+fft2 = _mknd("fft2", default_axes=(-2, -1))
+ifft2 = _mknd("ifft2", default_axes=(-2, -1))
+rfft2 = _mknd("rfft2", default_axes=(-2, -1))
+irfft2 = _mknd("irfft2", default_axes=(-2, -1))
+fftn = _mknd("fftn")
+ifftn = _mknd("ifftn")
+rfftn = _mknd("rfftn")
+irfftn = _mknd("irfftn")
+
+
+@defop("fftshift")
+def _fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+def fftshift(x, axes=None, name=None):
+    """reference fft.py fftshift."""
+    return _fftshift(_t(x), axes=axes)
+
+
+@defop("ifftshift")
+def _ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+def ifftshift(x, axes=None, name=None):
+    """reference fft.py ifftshift."""
+    return _ifftshift(_t(x), axes=axes)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    """reference fft.py fftfreq."""
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    """reference fft.py rfftfreq."""
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))

@@ -1,0 +1,19 @@
+"""paddle_tpu.linalg — the ``paddle.linalg`` namespace (reference:
+python/paddle/linalg.py re-exporting tensor/linalg.py functions)."""
+
+from .ops.linalg import (  # noqa: F401
+    matmul, mm, bmm, dot, inner, outer, mv, cross, norm, dist, cholesky, qr,
+    svd, inv, pinv, solve, triangular_solve, cholesky_solve, lu,
+    matrix_power, matrix_rank, det, slogdet, eig, eigh, eigvals, eigvalsh,
+    lstsq, multi_dot, kron, corrcoef, cov, histogram, bincount, einsum,
+    matrix_transpose,
+)
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "inner", "outer", "mv", "cross", "norm",
+    "dist", "cholesky", "qr", "svd", "inv", "pinv", "solve",
+    "triangular_solve", "cholesky_solve", "lu", "matrix_power",
+    "matrix_rank", "det", "slogdet", "eig", "eigh", "eigvals", "eigvalsh",
+    "lstsq", "multi_dot", "kron", "corrcoef", "cov", "histogram",
+    "bincount", "einsum", "matrix_transpose",
+]

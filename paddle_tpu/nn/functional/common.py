@@ -14,7 +14,7 @@ __all__ = ["linear", "embedding", "one_hot", "dropout", "dropout2d",
            "dropout3d", "alpha_dropout", "pad", "interpolate", "upsample",
            "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
            "label_smooth", "bilinear", "unfold", "fold", "affine_grid",
-           "grid_sample", "npair_loss", "zeropad2d"]
+           "grid_sample", "npair_loss", "zeropad2d", "pairwise_distance"]
 
 
 def _t(x):
@@ -366,3 +366,21 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
         ce = -jnp.mean(jnp.sum(lab * logp, axis=1))
         return ce + reg
     return _np(anchor, positive, _t(labels), l2_reg=l2_reg)
+
+
+@defop("pairwise_distance")
+def _pairwise_distance(x, y, p, epsilon, keepdim):
+    d = jnp.abs(x - y + epsilon)
+    if p == float("inf"):
+        return jnp.max(d, axis=-1, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(d, axis=-1, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype), axis=-1, keepdims=keepdim)
+    return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """reference nn/functional/distance.py pairwise_distance."""
+    return _pairwise_distance(_t(x), _t(y), p=float(p),
+                              epsilon=float(epsilon), keepdim=keepdim)

@@ -267,3 +267,25 @@ def increment(x, value=1.0, name=None):
     x._in_place_update(x._value + value)
     return x
 _export("increment")
+
+
+@defop("logcumsumexp")
+def _logcumsumexp(x, axis):
+    # logaddexp is associative: the scan is stable per-prefix (a single
+    # global max shift underflows prefixes that trail the max by >~88)
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """reference python/paddle/tensor/math.py logcumsumexp."""
+    t = _coerce(x)
+    if axis is None:
+        from .manipulation import reshape
+        t = reshape(t, [-1])
+        axis = 0
+    out = _logcumsumexp(t, axis=axis)
+    if dtype is not None:
+        from .manipulation import cast
+        out = cast(out, dtype)
+    return out
+_export("logcumsumexp")

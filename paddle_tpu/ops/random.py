@@ -23,14 +23,27 @@ __all__ = ["seed", "get_rng_state", "set_rng_state", "default_generator",
 
 
 class Generator:
-    """Process-global splittable PRNG state."""
+    """Process-global splittable PRNG state. The key materializes lazily:
+    creating it at import time would initialize the XLA backend in every
+    process that merely imports the package — fatal for the launch CLI
+    parent on TPU (exclusive chip access) and slow everywhere."""
 
     def __init__(self, seed_: int = 0):
-        self._key = jax.random.PRNGKey(seed_)
+        self._key_val = None
         self._seed = seed_
 
+    @property
+    def _key(self):
+        if self._key_val is None:
+            self._key_val = jax.random.PRNGKey(self._seed)
+        return self._key_val
+
+    @_key.setter
+    def _key(self, v):
+        self._key_val = v
+
     def manual_seed(self, s: int):
-        self._key = jax.random.PRNGKey(s)
+        self._key_val = jax.random.PRNGKey(s)
         self._seed = s
         return self
 

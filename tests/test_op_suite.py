@@ -1,0 +1,857 @@
+"""OpTest harness (reference: test/legacy_test/op_test.py — OpTest:379
+compares against a NumPy reference and finite-difference gradients
+(get_numeric_gradient:135), sweeping dtypes; exemptions in
+test/white_list/).
+
+TPU analogue: for every case —
+1. forward fp32 vs a NumPy reference (when one is declared),
+2. analytic grads (jax.vjp via Tensor.backward) vs central finite
+   differences of the op itself,
+3. a bf16 sweep: the op must run in bf16 and agree with fp32 within
+   bf16 tolerance (catches dtype-handling crashes — VERDICT weak #6).
+
+A dispatch observer records every op name; the final test asserts the
+harness + declared exemptions account for >80% of OP_REGISTRY."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.dispatch import OP_OBSERVERS, OP_REGISTRY
+from paddle_tpu.core.tensor import Tensor
+
+_COVERED: set = set()
+
+
+def setup_module(module):
+    OP_OBSERVERS.append(_COVERED.add)
+
+
+def teardown_module(module):
+    OP_OBSERVERS.remove(_COVERED.add)
+
+
+def _rng(seed):
+    return np.random.RandomState(seed)
+
+
+def r(*shape, seed=0, lo=-1.0, hi=1.0):
+    """uniform in [lo, hi], kept away from 0 kinks by callers via lo/hi."""
+    return (_rng(seed).uniform(lo, hi, shape)).astype(np.float32)
+
+
+def rp(*shape, seed=0):
+    return r(*shape, seed=seed, lo=0.2, hi=2.0)
+
+
+def ri(*shape, seed=0, lo=0, hi=8):
+    return _rng(seed).randint(lo, hi, shape).astype(np.int64)
+
+
+def spd(n, seed=0):
+    """symmetric positive definite matrix."""
+    a = r(n, n, seed=seed)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+class C:
+    """One op case."""
+
+    def __init__(self, fn, inputs, npref=None, kwargs=None, grad=True,
+                 bf16=True, atol=1e-5, gtol=6e-2, name=None, out_sel=None):
+        self.fn_path = fn
+        self.inputs = inputs
+        self.npref = npref
+        self.kwargs = kwargs or {}
+        self.grad = grad
+        self.bf16 = bf16
+        self.atol = atol
+        self.gtol = gtol
+        self.name = name or fn
+        self.out_sel = out_sel  # select output for grad when tuple
+
+    def resolve(self):
+        obj = paddle
+        for part in self.fn_path.split("."):
+            obj = getattr(obj, part)
+        return obj
+
+    def __repr__(self):
+        return f"C({self.name})"
+
+
+def _call(case, arrays, cast=None):
+    fn = case.resolve()
+    args = []
+    for a in arrays:
+        if isinstance(a, np.ndarray):
+            v = a
+            if cast is not None and a.dtype == np.float32:
+                v = v.astype(cast)
+            args.append(paddle.to_tensor(v))
+        else:
+            args.append(a)
+    return fn(*args, **case.kwargs)
+
+
+def _outs(out):
+    if isinstance(out, (tuple, list)):
+        return [o for o in out if isinstance(o, Tensor)]
+    return [out]
+
+
+def _float_outs(out):
+    return [o for o in _outs(out)
+            if jnp.issubdtype(o._value.dtype, jnp.floating)]
+
+
+CASES = [
+    # ---- elementwise math -------------------------------------------------
+    C("add", lambda: (r(2, 3, seed=1), r(2, 3, seed=2)), np.add),
+    C("subtract", lambda: (r(2, 3, seed=1), r(2, 3, seed=2)), np.subtract),
+    C("multiply", lambda: (r(2, 3, seed=1), r(2, 3, seed=2)), np.multiply),
+    C("divide", lambda: (r(2, 3, seed=1), rp(2, 3, seed=2)), np.divide),
+    C("pow", lambda: (rp(2, 3, seed=1), 2.0), lambda x, p: x ** p),
+    C("maximum", lambda: (r(2, 3, seed=1), r(2, 3, seed=2)), np.maximum,
+      grad=False),
+    C("minimum", lambda: (r(2, 3, seed=1), r(2, 3, seed=2)), np.minimum,
+      grad=False),
+    C("fmax", lambda: (r(2, 3, seed=1), r(2, 3, seed=2)), np.fmax,
+      grad=False),
+    C("fmin", lambda: (r(2, 3, seed=1), r(2, 3, seed=2)), np.fmin,
+      grad=False),
+    C("mod", lambda: (rp(2, 3, seed=1), rp(2, 3, seed=2)), np.mod,
+      grad=False),
+    C("floor_divide", lambda: (rp(2, 3, seed=1), rp(2, 3, seed=2)),
+      np.floor_divide, grad=False, bf16=False),
+    C("remainder", lambda: (rp(2, 3, seed=1), rp(2, 3, seed=2)),
+      np.remainder, grad=False),
+    C("abs", lambda: (r(2, 3, seed=1, lo=0.2, hi=1.0),), np.abs),
+    C("neg", lambda: (r(2, 3, seed=1),), np.negative),
+    C("exp", lambda: (r(2, 3, seed=1),), np.exp),
+    C("expm1", lambda: (r(2, 3, seed=1),), np.expm1),
+    C("log", lambda: (rp(2, 3, seed=1),), np.log),
+    C("log2", lambda: (rp(2, 3, seed=1),), np.log2),
+    C("log10", lambda: (rp(2, 3, seed=1),), np.log10),
+    C("log1p", lambda: (rp(2, 3, seed=1),), np.log1p),
+    C("sqrt", lambda: (rp(2, 3, seed=1),), np.sqrt),
+    C("rsqrt", lambda: (rp(2, 3, seed=1),), lambda x: 1 / np.sqrt(x)),
+    C("square", lambda: (r(2, 3, seed=1),), np.square),
+    C("reciprocal", lambda: (rp(2, 3, seed=1),), np.reciprocal),
+    C("sign", lambda: (r(2, 3, seed=1, lo=0.3, hi=1.0),), np.sign,
+      grad=False),
+    C("floor", lambda: (r(2, 3, seed=1) * 3,), np.floor, grad=False, bf16=False),
+    C("ceil", lambda: (r(2, 3, seed=1) * 3,), np.ceil, grad=False, bf16=False),
+    C("round", lambda: (r(2, 3, seed=1) * 3,), np.round, grad=False, bf16=False),
+    C("trunc", lambda: (r(2, 3, seed=1) * 3,), np.trunc, grad=False, bf16=False),
+    C("frac", lambda: (rp(2, 3, seed=1) * 3,),
+      lambda x: x - np.trunc(x), grad=False, bf16=False),
+    C("sin", lambda: (r(2, 3, seed=1),), np.sin),
+    C("cos", lambda: (r(2, 3, seed=1),), np.cos),
+    C("tan", lambda: (r(2, 3, seed=1),), np.tan),
+    C("asin", lambda: (r(2, 3, seed=1, lo=-0.8, hi=0.8),), np.arcsin),
+    C("acos", lambda: (r(2, 3, seed=1, lo=-0.8, hi=0.8),), np.arccos),
+    C("atan", lambda: (r(2, 3, seed=1),), np.arctan),
+    C("sinh", lambda: (r(2, 3, seed=1),), np.sinh),
+    C("cosh", lambda: (r(2, 3, seed=1),), np.cosh),
+    C("tanh", lambda: (r(2, 3, seed=1),), np.tanh),
+    C("asinh", lambda: (r(2, 3, seed=1),), np.arcsinh),
+    C("acosh", lambda: (rp(2, 3, seed=1) + 1.2,), np.arccosh),
+    C("atanh", lambda: (r(2, 3, seed=1, lo=-0.8, hi=0.8),), np.arctanh),
+    C("atan2", lambda: (rp(2, 3, seed=1), rp(2, 3, seed=2)), np.arctan2),
+    C("hypot", lambda: (rp(2, 3, seed=1), rp(2, 3, seed=2)), np.hypot),
+    C("erf", lambda: (r(2, 3, seed=1),),
+      lambda x: np.vectorize(__import__("math").erf)(x).astype(np.float32)),
+    C("erfinv", lambda: (r(2, 3, seed=1, lo=-0.7, hi=0.7),), None),
+    C("lgamma", lambda: (rp(2, 3, seed=1) + 1,),
+      lambda x: np.vectorize(__import__("math").lgamma)(x).astype(np.float32),
+      gtol=1e-1),
+    C("digamma", lambda: (rp(2, 3, seed=1) + 1,), None),
+    C("logit", lambda: (r(2, 3, seed=1, lo=0.2, hi=0.8),),
+      lambda x: np.log(x / (1 - x))),
+    C("logaddexp", lambda: (r(2, 3, seed=1), r(2, 3, seed=2)),
+      np.logaddexp),
+    C("copysign", lambda: (rp(2, 3, seed=1), r(2, 3, seed=2, lo=0.3, hi=1)),
+      np.copysign, grad=False),
+    C("heaviside", lambda: (r(2, 3, seed=1, lo=0.2, hi=1), rp(2, 3, seed=2)),
+      np.heaviside, grad=False),
+    C("nextafter", lambda: (r(2, 3, seed=1), r(2, 3, seed=2)), np.nextafter,
+      grad=False, bf16=False),
+    C("ldexp", lambda: (r(2, 3, seed=1), ri(2, 3, seed=2, lo=0, hi=3)),
+      np.ldexp, grad=False, bf16=False),
+    C("deg2rad", lambda: (r(2, 3, seed=1) * 90,), np.deg2rad),
+    C("rad2deg", lambda: (r(2, 3, seed=1),), np.rad2deg),
+    C("gcd", lambda: (ri(4, seed=1, lo=1, hi=20), ri(4, seed=2, lo=1, hi=20)),
+      np.gcd, grad=False, bf16=False),
+    C("lcm", lambda: (ri(4, seed=1, lo=1, hi=9), ri(4, seed=2, lo=1, hi=9)),
+      np.lcm, grad=False, bf16=False),
+    C("clip", lambda: (r(2, 3, seed=1),), lambda x: np.clip(x, -0.5, 0.5),
+      kwargs={"min": -0.5, "max": 0.5}),
+    C("scale", lambda: (r(2, 3, seed=1),), lambda x: 3 * x + 1,
+      kwargs={"scale": 3.0, "bias": 1.0}),
+    C("lerp", lambda: (r(2, 3, seed=1), r(2, 3, seed=2), 0.3),
+      lambda x, y, w: x + w * (y - x)),
+    C("nan_to_num", lambda: (r(2, 3, seed=1),), np.nan_to_num),
+    C("i0", lambda: (rp(2, 3, seed=1),), np.i0, gtol=1e-1),
+    C("i0e", lambda: (rp(2, 3, seed=1),), None, gtol=1e-1),
+    C("i1", lambda: (rp(2, 3, seed=1),), None, gtol=1e-1),
+    C("i1e", lambda: (rp(2, 3, seed=1),), None, gtol=1e-1),
+    C("stanh", lambda: (r(2, 3, seed=1),), None),
+    # ---- logic / comparison ----------------------------------------------
+    C("equal", lambda: (ri(4, seed=1, hi=3), ri(4, seed=2, hi=3)),
+      lambda a, b: a == b, grad=False, bf16=False),
+    C("not_equal", lambda: (ri(4, seed=1, hi=3), ri(4, seed=2, hi=3)),
+      lambda a, b: a != b, grad=False, bf16=False),
+    C("greater_than", lambda: (r(4, seed=1), r(4, seed=2)),
+      lambda a, b: a > b, grad=False, bf16=False),
+    C("greater_equal", lambda: (r(4, seed=1), r(4, seed=2)),
+      lambda a, b: a >= b, grad=False, bf16=False),
+    C("less_than", lambda: (r(4, seed=1), r(4, seed=2)),
+      lambda a, b: a < b, grad=False, bf16=False),
+    C("less_equal", lambda: (r(4, seed=1), r(4, seed=2)),
+      lambda a, b: a <= b, grad=False, bf16=False),
+    C("logical_and", lambda: (ri(4, seed=1, hi=2).astype(bool),
+                              ri(4, seed=2, hi=2).astype(bool)),
+      np.logical_and, grad=False, bf16=False),
+    C("logical_or", lambda: (ri(4, seed=1, hi=2).astype(bool),
+                             ri(4, seed=2, hi=2).astype(bool)),
+      np.logical_or, grad=False, bf16=False),
+    C("logical_xor", lambda: (ri(4, seed=1, hi=2).astype(bool),
+                              ri(4, seed=2, hi=2).astype(bool)),
+      np.logical_xor, grad=False, bf16=False),
+    C("logical_not", lambda: (ri(4, seed=1, hi=2).astype(bool),),
+      np.logical_not, grad=False, bf16=False),
+    C("bitwise_and", lambda: (ri(4, seed=1), ri(4, seed=2)),
+      np.bitwise_and, grad=False, bf16=False),
+    C("bitwise_or", lambda: (ri(4, seed=1), ri(4, seed=2)),
+      np.bitwise_or, grad=False, bf16=False),
+    C("bitwise_xor", lambda: (ri(4, seed=1), ri(4, seed=2)),
+      np.bitwise_xor, grad=False, bf16=False),
+    C("bitwise_not", lambda: (ri(4, seed=1),), np.bitwise_not,
+      grad=False, bf16=False),
+    C("isnan", lambda: (r(4, seed=1),), np.isnan, grad=False),
+    C("isinf", lambda: (r(4, seed=1),), np.isinf, grad=False),
+    C("isfinite", lambda: (r(4, seed=1),), np.isfinite, grad=False),
+    C("allclose", lambda: (r(4, seed=1), r(4, seed=1)),
+      lambda a, b: np.allclose(a, b), grad=False),
+    C("isclose", lambda: (r(4, seed=1), r(4, seed=1)), np.isclose,
+      grad=False),
+    C("equal_all", lambda: (ri(4, seed=1), ri(4, seed=1)),
+      lambda a, b: np.array_equal(a, b), grad=False, bf16=False),
+    # ---- reductions -------------------------------------------------------
+    C("sum", lambda: (r(3, 4, seed=1),), np.sum),
+    C("mean", lambda: (r(3, 4, seed=1),), np.mean),
+    C("max", lambda: (r(3, 4, seed=1),), np.max, gtol=1e-1),
+    C("min", lambda: (r(3, 4, seed=1),), np.min, gtol=1e-1),
+    C("prod", lambda: (rp(3, 4, seed=1),), np.prod),
+    C("std", lambda: (r(3, 4, seed=1),),
+      lambda x: np.std(x, ddof=1).astype(np.float32)),
+    C("var", lambda: (r(3, 4, seed=1),),
+      lambda x: np.var(x, ddof=1).astype(np.float32)),
+    C("median", lambda: (r(3, 5, seed=1),), None, grad=False),
+    C("nanmedian", lambda: (r(3, 5, seed=1),), None, grad=False),
+    C("quantile", lambda: (r(3, 5, seed=1), 0.5), None, grad=False),
+    C("nanquantile", lambda: (r(3, 5, seed=1), 0.5), None, grad=False),
+    C("nansum", lambda: (r(3, 4, seed=1),), np.nansum),
+    C("nanmean", lambda: (r(3, 4, seed=1),), np.nanmean),
+    C("logsumexp", lambda: (r(3, 4, seed=1),),
+      lambda x: np.log(np.exp(x).sum())),
+    C("amax", lambda: (r(3, 4, seed=1),), np.amax, gtol=1e-1),
+    C("amin", lambda: (r(3, 4, seed=1),), np.amin, gtol=1e-1),
+    C("all", lambda: (ri(4, seed=1, hi=2).astype(bool),), np.all,
+      grad=False, bf16=False),
+    C("any", lambda: (ri(4, seed=1, hi=2).astype(bool),), np.any,
+      grad=False, bf16=False),
+    C("count_nonzero", lambda: (ri(3, 4, seed=1, hi=3),),
+      np.count_nonzero, grad=False, bf16=False),
+    C("cumsum", lambda: (r(3, 4, seed=1),),
+      lambda x: np.cumsum(x, axis=None).astype(np.float32)),
+    C("cumprod", lambda: (rp(6, seed=1), 0),
+      lambda x, d: np.cumprod(x, axis=0).astype(np.float32), name="cumprod"),
+    C("cummax", lambda: (r(6, seed=1),), None, grad=False),
+    C("logcumsumexp", lambda: (r(6, seed=1),),
+      lambda x: np.log(np.cumsum(np.exp(x))).astype(np.float32),
+      grad=False),
+    # ---- linalg -----------------------------------------------------------
+    C("matmul", lambda: (r(3, 4, seed=1), r(4, 2, seed=2)), np.matmul,
+      atol=1e-4),
+    C("dot", lambda: (r(5, seed=1), r(5, seed=2)), np.dot, atol=1e-4),
+    C("inner", lambda: (r(3, 4, seed=1), r(2, 4, seed=2)), np.inner,
+      atol=1e-4),
+    C("outer", lambda: (r(3, seed=1), r(4, seed=2)), np.outer),
+    C("cross", lambda: (r(3, 3, seed=1), r(3, 3, seed=2)),
+      lambda a, b: np.cross(a, b), kwargs={"axis": 1}),
+    C("kron", lambda: (r(2, 2, seed=1), r(2, 3, seed=2)), np.kron),
+    C("einsum", lambda: ("ij,jk->ik", r(3, 4, seed=1), r(4, 2, seed=2)),
+      None, atol=1e-4, name="einsum"),
+    C("tensordot", lambda: (r(3, 4, seed=1), r(4, 2, seed=2)), None,
+      atol=1e-4, kwargs={"axes": 1}),
+    C("linalg.cholesky", lambda: (spd(4, seed=1),),
+      lambda a: np.linalg.cholesky(a), atol=1e-4, gtol=1e-1, bf16=False),
+    C("linalg.inv", lambda: (spd(4, seed=1),), np.linalg.inv, atol=1e-3,
+      gtol=1e-1, bf16=False),
+    C("linalg.det", lambda: (spd(3, seed=1),), np.linalg.det, atol=1e-3,
+      gtol=2e-1),
+    C("linalg.solve", lambda: (spd(3, seed=1), r(3, 2, seed=2)),
+      np.linalg.solve, atol=1e-3, gtol=1e-1, bf16=False),
+    C("linalg.matrix_power", lambda: (r(3, 3, seed=1), 2),
+      lambda a, n: np.linalg.matrix_power(a, n), atol=1e-4),
+    C("linalg.pinv", lambda: (r(4, 3, seed=1),), np.linalg.pinv,
+      atol=1e-3, grad=False, bf16=False),
+    C("linalg.svd", lambda: (r(4, 3, seed=1),), None, grad=False,
+      name="svd", bf16=False),
+    C("linalg.qr", lambda: (r(4, 3, seed=1),), None, grad=False, name="qr", bf16=False),
+    C("linalg.norm", lambda: (r(3, 4, seed=1),),
+      lambda x: np.linalg.norm(x.ravel()), name="p_norm"),
+    C("linalg.triangular_solve",
+      lambda: (np.triu(spd(3, seed=1)).astype(np.float32), r(3, 2, seed=2)),
+      None, atol=1e-3, gtol=2e-1),
+    C("linalg.cholesky_solve",
+      lambda: (r(3, 2, seed=2), np.linalg.cholesky(spd(3, seed=1))
+               .astype(np.float32)), None, atol=1e-3, gtol=2e-1),
+    C("linalg.eigh", lambda: (spd(4, seed=1),), None, grad=False,
+      name="eigh", bf16=False),
+    C("linalg.eigvalsh", lambda: (spd(4, seed=1),), None, grad=False,
+      name="eigvalsh", bf16=False),
+    C("linalg.lstsq", lambda: (r(5, 3, seed=1), r(5, 2, seed=2)), None,
+      grad=False, name="lstsq", bf16=False),
+    C("linalg.slogdet", lambda: (spd(3, seed=1),), None, grad=False,
+      name="slogdet", bf16=False),
+    C("linalg.matrix_rank", lambda: (spd(3, seed=1),),
+      lambda a: np.linalg.matrix_rank(a), grad=False, bf16=False),
+    C("linalg.corrcoef", lambda: (r(3, 6, seed=1),), np.corrcoef,
+      atol=1e-4, grad=False),
+    C("linalg.cov", lambda: (r(3, 6, seed=1),), np.cov, atol=1e-4,
+      gtol=1e-1),
+    # ---- manipulation -----------------------------------------------------
+    C("reshape", lambda: (r(2, 6, seed=1), [3, 4]),
+      lambda x, s: x.reshape(s)),
+    C("transpose", lambda: (r(2, 3, 4, seed=1), [2, 0, 1]),
+      lambda x, p: x.transpose(p)),
+    C("concat", lambda: ([r(2, 3, seed=1), r(2, 3, seed=2)],),
+      lambda ts: np.concatenate(ts, 0), grad=False),
+    C("stack", lambda: ([r(2, 3, seed=1), r(2, 3, seed=2)],),
+      lambda ts: np.stack(ts, 0), grad=False),
+    C("squeeze", lambda: (r(2, 1, 3, seed=1),), np.squeeze),
+    C("unsqueeze", lambda: (r(2, 3, seed=1), 1),
+      lambda x, a: np.expand_dims(x, a)),
+    C("flatten", lambda: (r(2, 3, 4, seed=1),),
+      lambda x: x.reshape(2 * 3 * 4)),
+    C("flip", lambda: (r(2, 3, seed=1), 0), lambda x, a: np.flip(x, a)),
+    C("roll", lambda: (r(2, 3, seed=1), 1),
+      lambda x, s: np.roll(x, s)),
+    C("rot90", lambda: (r(2, 3, seed=1),), lambda x: np.rot90(x),
+      grad=False),
+    C("tile", lambda: (r(2, 3, seed=1), [2, 2]), np.tile),
+    C("expand", lambda: (r(1, 3, seed=1), [4, 3]),
+      lambda x, s: np.broadcast_to(x, s)),
+    C("tril", lambda: (r(3, 3, seed=1),), np.tril),
+    C("triu", lambda: (r(3, 3, seed=1),), np.triu),
+    C("diag", lambda: (r(4, seed=1),), np.diag),
+    C("diagflat", lambda: (r(4, seed=1),), np.diagflat),
+    C("gather", lambda: (r(5, 3, seed=1), ri(3, seed=2, hi=5)),
+      lambda x, i: x[i], grad=False, bf16=False),
+    C("index_sample",
+      lambda: (r(3, 5, seed=1), ri(3, 2, seed=2, hi=5)),
+      lambda x, i: np.take_along_axis(x, i, 1), grad=False, bf16=False),
+    C("take_along_axis",
+      lambda: (r(3, 5, seed=1), ri(3, 2, seed=2, hi=5), 1),
+      np.take_along_axis, grad=False, bf16=False),
+    C("repeat_interleave", lambda: (r(3, seed=1), 2),
+      lambda x, n: np.repeat(x, n), grad=False),
+    C("masked_fill",
+      lambda: (r(2, 3, seed=1), ri(2, 3, seed=2, hi=2).astype(bool), 0.0),
+      lambda x, m, v: np.where(m, v, x), grad=False),
+    C("where",
+      lambda: (ri(2, 3, seed=3, hi=2).astype(bool), r(2, 3, seed=1),
+               r(2, 3, seed=2)),
+      np.where, grad=False),
+    C("nn.functional.pad", lambda: (r(2, 3, seed=1), [1, 1, 0, 0]),
+      lambda x, p: np.pad(x, ((1, 1), (0, 0))), grad=False, name="pad"),
+    C("crop", lambda: (r(4, 5, seed=1), [2, 3], [1, 1]),
+      lambda x, s, o: x[1:3, 1:4], grad=False),
+    C("nn.functional.unfold", lambda: (r(1, 1, 4, 4, seed=1), 2),
+      None, grad=False, name="unfold"),
+    C("searchsorted",
+      lambda: (np.sort(r(6, seed=1)).astype(np.float32), r(3, seed=2)),
+      np.searchsorted, grad=False, bf16=False),
+    C("bincount", lambda: (ri(8, seed=1, hi=5),), np.bincount,
+      grad=False, bf16=False),
+    C("histogram", lambda: (r(10, seed=1),), None, grad=False, bf16=False),
+    C("multiplex",
+      lambda: ([r(3, 4, seed=1), r(3, 4, seed=2)],
+               ri(3, seed=3, hi=2)), None, grad=False, bf16=False),
+    # ---- search / sort ----------------------------------------------------
+    C("argmax", lambda: (r(3, 4, seed=1),), np.argmax, grad=False,
+      bf16=False),
+    C("argmin", lambda: (r(3, 4, seed=1),), np.argmin, grad=False,
+      bf16=False),
+    C("argsort", lambda: (r(5, seed=1),), np.argsort, grad=False,
+      bf16=False),
+    C("sort", lambda: (r(5, seed=1),), np.sort, grad=False),
+    C("topk", lambda: (r(8, seed=1), 3), None, grad=False),
+    C("kthvalue", lambda: (r(8, seed=1), 2), None, grad=False),
+    C("mode", lambda: (ri(2, 6, seed=1, hi=3).astype(np.float32),), None,
+      grad=False),
+    # ---- activations ------------------------------------------------------
+    C("nn.functional.relu", lambda: (r(2, 3, seed=1, lo=0.1, hi=1),),
+      lambda x: np.maximum(x, 0)),
+    C("nn.functional.relu6", lambda: (r(2, 3, seed=1) * 8,),
+      lambda x: np.clip(x, 0, 6), gtol=1e-1),
+    C("nn.functional.sigmoid", lambda: (r(2, 3, seed=1),),
+      lambda x: 1 / (1 + np.exp(-x))),
+    C("nn.functional.silu", lambda: (r(2, 3, seed=1),),
+      lambda x: x / (1 + np.exp(-x))),
+    C("nn.functional.gelu", lambda: (r(2, 3, seed=1),), None),
+    C("nn.functional.elu", lambda: (r(2, 3, seed=1),), None),
+    C("nn.functional.celu", lambda: (r(2, 3, seed=1),), None),
+    C("nn.functional.selu", lambda: (r(2, 3, seed=1),), None),
+    C("nn.functional.softplus", lambda: (r(2, 3, seed=1),),
+      lambda x: np.log1p(np.exp(x))),
+    C("nn.functional.softsign", lambda: (r(2, 3, seed=1),),
+      lambda x: x / (1 + np.abs(x))),
+    C("nn.functional.log_sigmoid", lambda: (r(2, 3, seed=1),),
+      lambda x: -np.log1p(np.exp(-x))),
+    C("nn.functional.leaky_relu", lambda: (r(2, 3, seed=1, lo=0.1, hi=1),),
+      lambda x: np.where(x > 0, x, 0.01 * x)),
+    C("nn.functional.prelu", lambda: (r(2, 3, seed=1, lo=0.1, hi=1),
+                                      np.full((1,), 0.25, np.float32)),
+      lambda x, w: np.where(x > 0, x, w * x)),
+    C("nn.functional.hardtanh", lambda: (r(2, 3, seed=1) * 2,), None),
+    C("nn.functional.hardsigmoid", lambda: (r(2, 3, seed=1),), None),
+    C("nn.functional.hardswish", lambda: (r(2, 3, seed=1),), None),
+    C("nn.functional.hardshrink", lambda: (r(2, 3, seed=1),), None,
+      gtol=1e-1),
+    C("nn.functional.softshrink", lambda: (r(2, 3, seed=1),), None,
+      gtol=1e-1),
+    C("nn.functional.tanhshrink", lambda: (r(2, 3, seed=1),),
+      lambda x: x - np.tanh(x)),
+    C("nn.functional.thresholded_relu", lambda: (r(2, 3, seed=1) * 2,),
+      None, gtol=1e-1),
+    C("nn.functional.mish", lambda: (r(2, 3, seed=1),), None),
+    C("nn.functional.swish", lambda: (r(2, 3, seed=1),), None),
+    C("nn.functional.glu", lambda: (r(2, 4, seed=1),), None),
+    C("nn.functional.maxout", lambda: (r(2, 4, 3, 3, seed=1), 2), None,
+      gtol=1e-1),
+    C("nn.functional.softmax", lambda: (r(2, 5, seed=1),),
+      lambda x: np.exp(x) / np.exp(x).sum(-1, keepdims=True)),
+    C("nn.functional.log_softmax", lambda: (r(2, 5, seed=1),),
+      lambda x: x - x.max(-1, keepdims=True)
+      - np.log(np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True))),
+    C("nn.functional.gumbel_softmax", lambda: (r(2, 5, seed=1),), None,
+      grad=False, bf16=False),
+    # ---- losses -----------------------------------------------------------
+    C("nn.functional.mse_loss", lambda: (r(4, 3, seed=1), r(4, 3, seed=2)),
+      lambda a, b: np.mean((a - b) ** 2)),
+    C("nn.functional.l1_loss", lambda: (r(4, 3, seed=1), r(4, 3, seed=2)),
+      lambda a, b: np.mean(np.abs(a - b))),
+    C("nn.functional.smooth_l1_loss",
+      lambda: (r(4, 3, seed=1), r(4, 3, seed=2)), None),
+    C("nn.functional.huber_loss",
+      lambda: (r(4, 3, seed=1), r(4, 3, seed=2)), None),
+    C("nn.functional.kl_div",
+      lambda: (np.log(rp(4, 3, seed=1) / rp(4, 3, seed=1).sum()),
+               rp(4, 3, seed=2) / rp(4, 3, seed=2).sum()), None),
+    C("nn.functional.cross_entropy",
+      lambda: (r(4, 5, seed=1), ri(4, seed=2, hi=5)), None, bf16=False),
+    C("nn.functional.nll_loss",
+      lambda: (np.log(rp(4, 5, seed=1) / rp(4, 5, seed=1).sum(-1,
+                                                              keepdims=True)),
+               ri(4, seed=2, hi=5)), None, bf16=False),
+    C("nn.functional.binary_cross_entropy",
+      lambda: (r(4, seed=1, lo=0.2, hi=0.8), r(4, seed=2, lo=0.0, hi=1.0)),
+      None, name="bce_loss"),
+    C("nn.functional.binary_cross_entropy_with_logits",
+      lambda: (r(4, seed=1), r(4, seed=2, lo=0.0, hi=1.0)), None,
+      name="bce_with_logits"),
+    C("nn.functional.margin_ranking_loss",
+      lambda: (r(4, seed=1), r(4, seed=2), r(4, seed=3, lo=0.3, hi=1)),
+      None, gtol=1e-1),
+    C("nn.functional.cosine_embedding_loss",
+      lambda: (r(4, 3, seed=1), r(4, 3, seed=2),
+               np.sign(r(4, seed=3, lo=0.3, hi=1))), None, grad=False),
+    C("nn.functional.triplet_margin_loss",
+      lambda: (r(4, 3, seed=1), r(4, 3, seed=2), r(4, 3, seed=3)), None),
+    C("nn.functional.hinge_embedding_loss",
+      lambda: (r(4, 3, seed=1), np.sign(r(4, 3, seed=3, lo=0.3, hi=1))),
+      None, gtol=1e-1),
+    C("nn.functional.soft_margin_loss",
+      lambda: (r(4, seed=1), np.sign(r(4, seed=2, lo=0.3, hi=1))), None),
+    C("nn.functional.multi_label_soft_margin_loss",
+      lambda: (r(4, 3, seed=1), ri(4, 3, seed=2, hi=2).astype(np.float32)),
+      None),
+    C("nn.functional.log_loss",
+      lambda: (r(4, 1, seed=1, lo=0.2, hi=0.8),
+               ri(4, 1, seed=2, hi=2).astype(np.float32)), None),
+    C("nn.functional.sigmoid_focal_loss",
+      lambda: (r(4, 3, seed=1), ri(4, 3, seed=2, hi=2).astype(np.float32)),
+      None),
+    C("nn.functional.dice_loss",
+      lambda: (np.abs(r(4, 3, seed=1)) / 3 + 0.1, ri(4, 1, seed=2, hi=3)),
+      None, grad=False, bf16=False),
+    C("nn.functional.gaussian_nll_loss",
+      lambda: (r(4, 3, seed=1), r(4, 3, seed=2), rp(4, 3, seed=3)), None),
+    C("nn.functional.poisson_nll_loss",
+      lambda: (r(4, 3, seed=1), rp(4, 3, seed=2)), None),
+    C("nn.functional.label_smooth",
+      lambda: (ri(4, 5, seed=1, hi=2).astype(np.float32),), None),
+    # ---- nn functional (misc) --------------------------------------------
+    C("nn.functional.linear",
+      lambda: (r(4, 3, seed=1), r(3, 2, seed=2), r(2, seed=3)),
+      lambda x, w, b: x @ w + b, atol=1e-4),
+    C("nn.functional.bilinear",
+      lambda: (r(4, 3, seed=1), r(4, 5, seed=2), r(2, 3, 5, seed=3)),
+      None, atol=1e-4),
+    C("nn.functional.embedding",
+      lambda: (ri(4, seed=1, hi=6), r(6, 3, seed=2)), None,
+      grad=False, bf16=False, name="embedding"),
+    C("nn.functional.one_hot", lambda: (ri(4, seed=1, hi=5), 5), None,
+      grad=False, bf16=False),
+    C("nn.functional.cosine_similarity",
+      lambda: (r(4, 3, seed=1), r(4, 3, seed=2)), None),
+    C("nn.functional.normalize", lambda: (r(4, 3, seed=1),),
+      lambda x: x / np.linalg.norm(x, axis=1, keepdims=True)),
+    C("nn.functional.pixel_shuffle", lambda: (r(1, 4, 2, 2, seed=1), 2),
+      None),
+    C("nn.functional.pixel_unshuffle", lambda: (r(1, 1, 4, 4, seed=1), 2),
+      None),
+    C("nn.functional.pairwise_distance",
+      lambda: (r(4, 3, seed=1), r(4, 3, seed=2)), None),
+    C("nn.functional.interpolate", lambda: (r(1, 1, 4, 4, seed=1),),
+      None, kwargs={"scale_factor": 2}, grad=False),
+    # ---- conv / pool / norm ----------------------------------------------
+    C("nn.functional.conv2d",
+      lambda: (r(1, 2, 6, 6, seed=1), r(3, 2, 3, 3, seed=2)), None,
+      atol=1e-4, gtol=1e-1, name="conv2d"),
+    C("nn.functional.conv1d",
+      lambda: (r(1, 2, 8, seed=1), r(3, 2, 3, seed=2)), None,
+      atol=1e-4, gtol=1e-1, name="conv1d"),
+    C("nn.functional.conv2d_transpose",
+      lambda: (r(1, 2, 4, 4, seed=1), r(2, 3, 3, 3, seed=2)), None,
+      atol=1e-4, grad=False, name="conv2d_transpose"),
+    C("nn.functional.max_pool2d", lambda: (r(1, 1, 4, 4, seed=1), 2),
+      None, gtol=1e-1),
+    C("nn.functional.avg_pool2d", lambda: (r(1, 1, 4, 4, seed=1), 2),
+      None),
+    C("nn.functional.adaptive_avg_pool2d",
+      lambda: (r(1, 1, 4, 4, seed=1), 2), None),
+    C("nn.functional.adaptive_max_pool2d",
+      lambda: (r(1, 1, 4, 4, seed=1), 2), None, gtol=1e-1),
+    C("nn.functional.layer_norm",
+      lambda: (r(3, 4, seed=1), 4, r(4, seed=2), r(4, seed=3)), None,
+      kwargs={}, gtol=1e-1, name="layer_norm"),
+    C("nn.functional.rms_norm", lambda: (r(3, 4, seed=1), r(4, seed=2)),
+      None, name="rms_norm"),
+    C("nn.functional.local_response_norm",
+      lambda: (r(1, 4, 3, 3, seed=1), 2), None),
+    C("nn.functional.dropout", lambda: (r(3, 4, seed=1),), None,
+      kwargs={"p": 0.0}, grad=False, name="dropout"),
+    # ---- indexing / scatter ----------------------------------------------
+    C("index_add",
+      lambda: (r(5, 3, seed=1), ri(2, seed=2, hi=5), 0, r(2, 3, seed=3)),
+      None, grad=False, bf16=False),
+    C("index_fill",
+      lambda: (r(5, 3, seed=1), ri(2, seed=2, hi=5), 0, 1.5), None,
+      grad=False, bf16=False),
+    C("put_along_axis",
+      lambda: (r(3, 5, seed=1), ri(3, 1, seed=2, hi=5),
+               r(3, 1, seed=3), 1), None, grad=False, bf16=False),
+    C("gather_nd", lambda: (r(4, 3, seed=1), ri(2, 1, seed=2, hi=4)),
+      None, grad=False, bf16=False),
+    C("scatter_nd_add",
+      lambda: (r(5, seed=1), ri(3, 1, seed=2, hi=5), r(3, seed=3)),
+      None, grad=False, bf16=False),
+    # ---- complex / fft ----------------------------------------------------
+    C("real", lambda: (r(3, seed=1) + 1j * r(3, seed=2),), np.real,
+      grad=False, bf16=False),
+    C("imag", lambda: (r(3, seed=1) + 1j * r(3, seed=2),), np.imag,
+      grad=False, bf16=False),
+    C("conj", lambda: (r(3, seed=1) + 1j * r(3, seed=2),), np.conj,
+      grad=False, bf16=False),
+    C("angle", lambda: (r(3, seed=1) + 1j * r(3, seed=2),), np.angle,
+      grad=False, bf16=False),
+    C("complex", lambda: (r(3, seed=1), r(3, seed=2)),
+      lambda a, b: a + 1j * b, grad=False, bf16=False),
+    C("polar", lambda: (rp(3, seed=1), r(3, seed=2)),
+      lambda m, a: m * np.exp(1j * a), grad=False, bf16=False),
+    C("fft.fft", lambda: (r(8, seed=1),), np.fft.fft, grad=False,
+      bf16=False, name="fft"),
+    C("fft.ifft", lambda: (r(8, seed=1) + 1j * r(8, seed=2),), np.fft.ifft,
+      grad=False, bf16=False, name="ifft"),
+    C("fft.rfft", lambda: (r(8, seed=1),), np.fft.rfft, grad=False,
+      bf16=False, name="rfft"),
+    C("fft.irfft", lambda: (r(5, seed=1) + 1j * r(5, seed=2),),
+      np.fft.irfft, grad=False, bf16=False, name="irfft"),
+    C("fft.fft2", lambda: (r(4, 4, seed=1),), np.fft.fft2, grad=False,
+      bf16=False, name="fft2"),
+    C("fft.fftshift", lambda: (r(8, seed=1),), np.fft.fftshift,
+      grad=False, bf16=False, name="fftshift"),
+    C("fft.ifftshift", lambda: (r(8, seed=1),), np.fft.ifftshift,
+      grad=False, bf16=False, name="ifftshift"),
+    # ---- misc -------------------------------------------------------------
+    C("cast", lambda: (r(3, seed=1), "float64"),
+      lambda x, d: x.astype(np.float64), grad=False, bf16=False),
+    C("clone", lambda: (r(3, seed=1),), lambda x: x.copy()),
+    C("add_n", lambda: ([r(2, 3, seed=1), r(2, 3, seed=2)],),
+      lambda ts: ts[0] + ts[1], grad=False),
+    C("trapezoid", lambda: (r(6, seed=1),),
+      lambda y: np.trapezoid(y) if hasattr(np, "trapezoid") else
+      np.trapz(y)),
+    C("cumulative_trapezoid", lambda: (r(6, seed=1),), None, grad=False),
+    C("shard_index", lambda: (ri(4, 1, seed=1, hi=20), 20, 2, 0), None,
+      grad=False, bf16=False),
+]
+
+
+_IDS = [c.name + f"#{i}" for i, c in enumerate(CASES)]
+
+
+@pytest.mark.parametrize("case", CASES, ids=_IDS)
+def test_forward(case):
+    arrays = case.inputs()
+    out = _call(case, arrays)
+    outs = _outs(out)
+    for o in outs:
+        assert np.all(np.isfinite(np.asarray(o._value))) or \
+            not jnp.issubdtype(o._value.dtype, jnp.floating), case
+    if case.npref is None:
+        return
+    np_in = [a for a in arrays]
+    ref = case.npref(*np_in, **({} if case.kwargs else {}))
+    refs = ref if isinstance(ref, (tuple, list)) else (ref,)
+    for o, rf in zip(outs, refs):
+        np.testing.assert_allclose(
+            np.asarray(o._value, dtype=np.asarray(rf).dtype), rf,
+            rtol=1e-4, atol=case.atol, err_msg=str(case))
+
+
+@pytest.mark.parametrize(
+    "case", [c for c in CASES if c.grad], ids=[i for i, c in
+                                               zip(_IDS, CASES) if c.grad])
+def test_grad_finite_difference(case):
+    """Analytic vjp grads vs central finite differences (reference
+    op_test.py get_numeric_gradient:135)."""
+    arrays = case.inputs()
+    f_idx = [i for i, a in enumerate(arrays)
+             if isinstance(a, np.ndarray) and a.dtype == np.float32]
+    assert f_idx, f"grad case {case} has no float inputs"
+    rng = _rng(99)
+
+    def scalar_loss(arrs):
+        out = _call(case, arrs)
+        fouts = _float_outs(out)
+        if case.out_sel is not None:
+            fouts = [fouts[case.out_sel]]
+        total = None
+        for k, o in enumerate(fouts):
+            w = paddle.to_tensor(
+                rng.uniform(0.5, 1.0, o.shape).astype(np.float32))
+            rng.seed(100 + k)
+            term = (o * w).sum()
+            total = term if total is None else total + term
+        return total
+
+    # analytic
+    tensors = {}
+
+    def build_args():
+        args = []
+        for i, a in enumerate(arrays):
+            if i in f_idx:
+                t = paddle.to_tensor(a)
+                t.stop_gradient = False
+                tensors[i] = t
+                args.append(t)
+            else:
+                args.append(a)
+        return args
+
+    fn = case.resolve()
+
+    def call_with(args):
+        return fn(*args, **case.kwargs)
+
+    out = call_with(build_args())
+    fouts = _float_outs(out)
+    if case.out_sel is not None:
+        fouts = [fouts[case.out_sel]]
+    rng2 = _rng(99)
+    total = None
+    ws = []
+    for k, o in enumerate(fouts):
+        w = rng2.uniform(0.5, 1.0, o.shape).astype(np.float32)
+        rng2.seed(100 + k)
+        ws.append(w)
+        term = (o * paddle.to_tensor(w)).sum()
+        total = term if total is None else total + term
+    total.backward()
+
+    def numeric_loss(arrs):
+        out = _call(case, arrs)
+        fouts = _outs(out)
+        fouts = [o for o in fouts
+                 if jnp.issubdtype(o._value.dtype, jnp.floating)]
+        if case.out_sel is not None:
+            fouts = [fouts[case.out_sel]]
+        tot = 0.0
+        for w, o in zip(ws, fouts):
+            tot += float((np.asarray(o._value, np.float64) * w).sum())
+        return tot
+
+    eps = 1e-2
+    for i in f_idx:
+        g = tensors[i].grad
+        assert g is not None, f"no grad for input {i} of {case}"
+        g = np.asarray(g._value, np.float64)
+        a = arrays[i]
+        flat = a.reshape(-1)
+        n_check = min(flat.size, 24)
+        idxs = _rng(7).choice(flat.size, n_check, replace=False)
+        for j in idxs:
+            pert = list(arrays)
+            up = a.copy().reshape(-1)
+            up[j] += eps
+            pert[i] = up.reshape(a.shape)
+            lp = numeric_loss(pert)
+            dn = a.copy().reshape(-1)
+            dn[j] -= eps
+            pert[i] = dn.reshape(a.shape)
+            lm = numeric_loss(pert)
+            fd = (lp - lm) / (2 * eps)
+            an = g.reshape(-1)[j]
+            denom = max(abs(fd), abs(an), 1.0)
+            assert abs(fd - an) / denom < case.gtol, (
+                f"{case}: input {i} elem {j}: fd={fd:.5f} analytic={an:.5f}")
+
+
+@pytest.mark.parametrize(
+    "case", [c for c in CASES if c.bf16], ids=[i for i, c in
+                                               zip(_IDS, CASES) if c.bf16])
+def test_bf16(case):
+    """bf16 sweep: op must run in bf16 and stay close to fp32 (TPU-native
+    storage dtype; reference OpTest dtype sweeps)."""
+    arrays = case.inputs()
+    ref = _outs(_call(case, arrays))
+    out = _outs(_call(case, arrays, cast=jnp.bfloat16))
+    for o, rf in zip(out, ref):
+        ov = np.asarray(o._value, np.float32)
+        rv = np.asarray(rf._value, np.float32)
+        assert np.all(np.isfinite(ov)), case
+        scale = max(1.0, float(np.abs(rv).max()))
+        assert np.allclose(ov, rv, atol=0.1 * scale, rtol=0.1), (
+            f"{case}: bf16 deviates: max {np.abs(ov - rv).max()} "
+            f"(scale {scale})")
+
+
+# ops outside this harness's reach, each with a reason (reference
+# test/white_list analogues)
+EXEMPT = {
+    # stateful / random (seeded tests in test_ops.py / test_nn.py)
+    "dropout_apply", "bernoulli", "uniform", "gaussian", "randint",
+    "randperm", "multinomial", "poisson", "standard_gamma", "exponential_",
+    # distributed / collective (tested on the 8-device mesh in
+    # test_distributed.py)
+    "c_allreduce_sum", "c_allreduce_mean", "c_allreduce_max",
+    "c_allreduce_min", "c_allgather", "c_reducescatter", "alltoall",
+    "ppermute", "shard_hint", "c_identity", "c_concat", "c_split",
+    "mp_allreduce", "c_softmax_with_cross_entropy",
+    # model-level fused ops (test_models.py / test_kernels.py)
+    "llama_forward", "scaled_dot_product_attention", "flash_attention",
+    "fused_rope", "fused_rms_norm",
+    # nn ops exercised via their Layer tests (test_nn.py)
+    "batch_norm_train", "batch_norm_infer", "instance_norm", "group_norm",
+    "conv", "conv_transpose", "max_pool", "avg_pool", "adaptive_avg_pool",
+    "adaptive_max_pool", "interpolate_op", "embedding_lookup",
+    "cross_entropy", "rnn_step", "lstm_step", "gru_step",
+    # jit/io plumbing (test_jit.py / test_training.py)
+    "cast", "clone", "assign", "fill", "full_like", "numel",
+    "strided_slice", "slice", "eye", "arange", "linspace", "tril_indices",
+    "triu_indices", "meshgrid", "unique", "unique_consecutive", "nonzero",
+    "masked_select", "index_put", "dist", "accuracy_op",
+}
+
+
+def test_registry_coverage():
+    """>80% of OP_REGISTRY must be exercised by this harness or explicitly
+    exempted with a reason above (VERDICT #8 'done' criterion)."""
+    all_ops = set(OP_REGISTRY)
+
+    def frac_of(cov):
+        return len((cov | {e for e in EXEMPT if e in all_ops}) & all_ops) \
+            / len(all_ops)
+
+    if frac_of(_COVERED) < 0.8:
+        # module filtered with -k: replay cases to record coverage
+        for c in CASES:
+            try:
+                _call(c, c.inputs())
+            except Exception:  # noqa: BLE001 — its own test reports this
+                pass
+    covered = _COVERED | {e for e in EXEMPT if e in all_ops}
+    frac = frac_of(_COVERED)
+    missing = sorted(all_ops - covered)
+    assert frac >= 0.8, (
+        f"op coverage {frac:.0%} < 80%; uncovered: {missing}")
+
+
+class TestDftMatmulPath:
+    """The TPU FFT lowering (DFT as real matmuls on the MXU — the XLA TPU
+    backend has no FFT kernel) must match numpy's FFT. Tested directly on
+    CPU so CI covers the TPU code path."""
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    @pytest.mark.parametrize("n", [None, 6, 10])
+    def test_fft_ifft(self, norm, n):
+        from paddle_tpu.fft import _dft1d
+        x = r(3, 8, seed=1) + 1j * r(3, 8, seed=2)
+        out = _dft1d(jnp.asarray(x), n, -1, norm, inverse=False)
+        np.testing.assert_allclose(
+            np.asarray(out), np.fft.fft(x, n=n, axis=-1, norm=norm),
+            rtol=1e-4, atol=1e-4)
+        inv = _dft1d(jnp.asarray(x), n, -1, norm, inverse=True)
+        np.testing.assert_allclose(
+            np.asarray(inv), np.fft.ifft(x, n=n, axis=-1, norm=norm),
+            rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    @pytest.mark.parametrize("n", [None, 6, 9])
+    def test_rfft_irfft(self, norm, n):
+        from paddle_tpu.fft import _dft_rfft, _dft_irfft
+        x = r(3, 8, seed=1)
+        out = _dft_rfft(jnp.asarray(x), n, -1, norm)
+        np.testing.assert_allclose(
+            np.asarray(out), np.fft.rfft(x, n=n, axis=-1, norm=norm),
+            rtol=1e-4, atol=1e-4)
+        h = np.fft.rfft(x).astype(np.complex64)
+        inv = _dft_irfft(jnp.asarray(h), n, -1, norm)
+        np.testing.assert_allclose(
+            np.asarray(inv), np.fft.irfft(h, n=n, axis=-1, norm=norm),
+            rtol=1e-4, atol=1e-4)
+
+    def test_hfft_identity_via_dft(self):
+        """hfft(x, n) == irfft(conj(x), n) * n — the composition the TPU
+        audio path would use."""
+        from paddle_tpu.fft import _dft_irfft
+        x = (r(5, seed=1) + 1j * r(5, seed=2)).astype(np.complex64)
+        out = _dft_irfft(jnp.conj(jnp.asarray(x)), None, -1, "backward") * 8
+        np.testing.assert_allclose(np.asarray(out), np.fft.hfft(x),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_fftn_rfftn(self):
+        from paddle_tpu.fft import _fftn_raw
+        x = r(4, 6, seed=1)
+        out = _fftn_raw(jnp.asarray(x), None, None, "backward", False, None)
+        np.testing.assert_allclose(np.asarray(out), np.fft.fftn(x),
+                                   rtol=1e-4, atol=1e-4)
+        out = _fftn_raw(jnp.asarray(x), None, None, "backward", False,
+                        "rfft")
+        np.testing.assert_allclose(np.asarray(out), np.fft.rfftn(x),
+                                   rtol=1e-4, atol=1e-4)
+        h = np.fft.rfftn(x).astype(np.complex64)
+        out = _fftn_raw(jnp.asarray(h), [4, 6], None, "backward", True,
+                        "irfft")
+        np.testing.assert_allclose(np.asarray(out), np.fft.irfftn(h, [4, 6]),
+                                   rtol=1e-4, atol=1e-4)
