@@ -50,6 +50,7 @@ from . import metric  # noqa: F401
 from . import device  # noqa: F401
 from . import jit  # noqa: F401
 from . import linalg  # noqa: F401
+from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import framework  # noqa: F401
 from .framework.io import save, load  # noqa: F401

@@ -286,12 +286,9 @@ def _kv_client():
 def send(tensor, dst=0, group=None, sync_op=True):
     if _single_process(group):
         return _Task(None)
-    import base64
-    client = _kv_client()
     seq = _P2P_SEQ.get((get_rank(), dst), 0)
     _P2P_SEQ[(get_rank(), dst)] = seq + 1
-    payload = base64.b64encode(np.asarray(tensor._value).tobytes()).decode()
-    client.key_value_set(f"ptpu_p2p/{get_rank()}/{dst}/{seq}", payload)
+    _send_at(tensor, dst, seq)
     return _Task(None)
 
 
@@ -326,27 +323,48 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 class _AsyncTask(_Task):
     """Task backed by a worker thread (irecv must not block the caller —
-    the canonical irecv-then-send exchange would deadlock otherwise)."""
+    the canonical irecv-then-send exchange would deadlock otherwise).
+    Worker exceptions re-raise in wait(), matching the sync API."""
 
-    def __init__(self, thread):
+    def __init__(self, target, args):
         super().__init__(None)
-        self._thread = thread
+        import threading
+        self._exc = None
+
+        def run():
+            try:
+                target(*args)
+            except BaseException as e:  # noqa: BLE001 — re-raised in wait
+                self._exc = e
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
 
     def wait(self):
         self._thread.join()
+        if self._exc is not None:
+            raise self._exc
 
     def is_completed(self):
         return not self._thread.is_alive()
 
 
+def _send_at(tensor, dst, seq):
+    import base64
+    client = _kv_client()
+    payload = base64.b64encode(np.asarray(tensor._value).tobytes()).decode()
+    client.key_value_set(f"ptpu_p2p/{get_rank()}/{dst}/{seq}", payload)
+
+
 def isend(tensor, dst=0, group=None, sync_op=True):
-    """Async send (reference communication/isend). key_value_set is quick,
-    but keep the contract uniform with irecv."""
-    import threading
-    th = threading.Thread(target=send, args=(tensor, dst, group),
-                          daemon=True)
-    th.start()
-    return _AsyncTask(th)
+    """Async send (reference communication/isend). The sequence slot is
+    reserved synchronously so concurrent isends to one peer publish to
+    successive keys."""
+    if _single_process(group):
+        return _Task(None)
+    _kv_client()  # fail fast without a distributed runtime
+    seq = _P2P_SEQ.get((get_rank(), dst), 0)
+    _P2P_SEQ[(get_rank(), dst)] = seq + 1
+    return _AsyncTask(_send_at, (tensor, dst, seq))
 
 
 def irecv(tensor, src=0, group=None, sync_op=True):
@@ -354,16 +372,12 @@ def irecv(tensor, src=0, group=None, sync_op=True):
     worker thread, so irecv-before-send exchange patterns can't deadlock.
     The sequence slot is reserved synchronously (concurrent irecvs from
     one peer target successive messages); a timed-out slot is burned."""
-    import threading
     if _single_process(group):
         return _Task(None)
     _kv_client()  # fail fast without a distributed runtime
     seq = _P2P_SEQ.get((src, get_rank()), 0)
     _P2P_SEQ[(src, get_rank())] = seq + 1
-    th = threading.Thread(target=_recv_at, args=(tensor, src, seq),
-                          daemon=True)
-    th.start()
-    return _AsyncTask(th)
+    return _AsyncTask(_recv_at, (tensor, src, seq))
 
 
 class P2POp:

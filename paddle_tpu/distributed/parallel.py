@@ -24,6 +24,7 @@ class DataParallel(nn.Layer):
         self._grads_synced = False
         self._in_no_sync = False
         self._unsynced: set[int] = set()  # params with no_sync'd grads
+        self._hooked: set[int] = set()    # params with allreduce hooks
         if get_world_size() > 1:
             from .fleet.utils import broadcast_dp_parameters
             broadcast_dp_parameters(layers, None)
@@ -59,6 +60,7 @@ class DataParallel(nn.Layer):
                         all_reduce(t, op=ReduceOp.SUM)
                         return t._value / _n
                     p.register_hook(_hook)
+                    self._hooked.add(id(p))
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -72,12 +74,15 @@ class DataParallel(nn.Layer):
         from ..core.tensor import Tensor
         from .communication import ReduceOp, all_reduce
         n = get_world_size()
+        hooked = getattr(self, "_hooked", set())
         for p in self._layers.parameters():
-            # sync every trainable param (zero-filled when this rank saw no
-            # grad) so every rank enters every collective in the same
-            # order — idempotent for hook-synced grads (identical values
-            # average to themselves) and covers params unfrozen after
-            # wrapping, which never got a hook
+            # skip grads the per-grad hooks already averaged (avoids 2x
+            # grad traffic); hooked-param membership is deterministic and
+            # rank-identical, so the collective order stays consistent.
+            # Zero-fill missing grads for the rest so every rank enters
+            # every collective.
+            if id(p) in hooked and id(p) not in self._unsynced:
+                continue
             if p.stop_gradient and p.grad is None:
                 continue
             if p.grad is None:

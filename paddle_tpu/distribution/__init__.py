@@ -1,0 +1,35 @@
+"""paddle_tpu.distribution — probability distributions (reference:
+python/paddle/distribution/ — Distribution ABC distribution.py, 18 public
+distributions, kl.py registry, transform.py flows).
+
+TPU-native: densities/entropies are pure jnp (XLA-fused, differentiable);
+sampling draws keys from the global counter-based PRNG
+(ops.random.default_generator), so sampling is reproducible under
+paddle.seed and reparameterized (rsample) wherever the reference's is."""
+
+from .distribution import Distribution, ExponentialFamily  # noqa: F401
+from .distributions import (  # noqa: F401
+    Bernoulli, Beta, Categorical, Cauchy, Dirichlet, Exponential, Gamma,
+    Geometric, Gumbel, Laplace, LogNormal, Multinomial, Normal, Poisson,
+    StudentT, Uniform, Binomial, ContinuousBernoulli, Chi2,
+)
+from .independent import Independent  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .transform import (  # noqa: F401
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+)
+from .transformed_distribution import TransformedDistribution  # noqa: F401
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Bernoulli", "Beta", "Categorical",
+    "Cauchy", "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel",
+    "Laplace", "LogNormal", "Multinomial", "Normal", "Poisson", "StudentT",
+    "Uniform", "Binomial", "ContinuousBernoulli", "Chi2", "Independent",
+    "TransformedDistribution", "kl_divergence", "register_kl", "Transform",
+    "AbsTransform", "AffineTransform", "ChainTransform", "ExpTransform",
+    "IndependentTransform", "PowerTransform", "ReshapeTransform",
+    "SigmoidTransform", "SoftmaxTransform", "StackTransform",
+    "StickBreakingTransform", "TanhTransform",
+]

@@ -1,0 +1,115 @@
+"""Distribution base classes (reference: distribution/distribution.py
+Distribution ABC; exponential_family.py ExponentialFamily)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "ExponentialFamily"]
+
+
+def _v(x):
+    """Tensor/array-like -> jnp array."""
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, dtype=jnp.float32) if not hasattr(x, "dtype") \
+        else jnp.asarray(x)
+
+
+def _broadcast_all(*xs):
+    arrs = [_v(x) for x in xs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [jnp.broadcast_to(a, shape) for a in arrs]
+
+
+class Distribution:
+    """reference distribution.py Distribution: batch_shape/event_shape,
+    sample/rsample, log_prob/prob, entropy, kl_divergence."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.sqrt(_v(self.variance)))
+
+    def sample(self, shape=()):
+        """Non-differentiable draw."""
+        t = self.rsample(shape)
+        t.stop_gradient = True
+        return t
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..ops.math import exp
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self._batch_shape})"
+
+
+class ExponentialFamily(Distribution):
+    """reference exponential_family.py: Bregman-divergence entropy via the
+    log-normalizer; subclasses expose natural parameters."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    def entropy(self):
+        """Generic entropy: A(θ) - <θ, ∇A(θ)> + E[-log h(x)] via autodiff
+        of the log-normalizer (reference _entropy same mechanism)."""
+        import jax
+        nats = [jnp.asarray(_v(p)) for p in self._natural_parameters]
+        lg_normal, grads = jax.value_and_grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)), argnums=0)(
+                tuple(nats))
+        ent = lg_normal - sum(jnp.sum(n * g) for n, g in zip(nats, grads))
+        return Tensor(ent + self._mean_carrier_measure)
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0

@@ -1,0 +1,870 @@
+"""Concrete distributions (reference: python/paddle/distribution/
+normal.py, uniform.py, categorical.py, bernoulli.py, beta.py,
+dirichlet.py, gumbel.py, laplace.py, lognormal.py, multinomial.py,
+geometric.py, cauchy.py, + torch-parity extras the reference ships in
+newer snapshots: Exponential, Gamma, Poisson, StudentT, Binomial,
+ContinuousBernoulli, Chi2).
+
+Autograd: every differentiable method (rsample/log_prob/entropy/moments)
+routes through the op dispatcher (``_dop`` → apply_op → jax.vjp), so
+gradients flow to parameter Tensors — VAE/RL objectives train. Samples
+use the global counter PRNG (reproducible under paddle.seed); rsample is
+reparameterized where the underlying sampler is."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..ops import random as R
+from .distribution import Distribution, ExponentialFamily, _broadcast_all, _v
+
+__all__ = ["Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
+           "Dirichlet", "Gumbel", "Laplace", "LogNormal", "Multinomial",
+           "Geometric", "Cauchy", "Exponential", "Gamma", "Poisson",
+           "StudentT", "Binomial", "ContinuousBernoulli", "Chi2"]
+
+_LOG2PI = math.log(2.0 * math.pi)
+
+
+def _key():
+    return R.default_generator.split()
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x
+    a = jnp.asarray(x)
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        a = a.astype(jnp.float32)
+    return Tensor(a)
+
+
+def _dop(name, fn, tensors, **kwargs):
+    """Dispatch raw jnp math as a differentiable op over param Tensors."""
+    return apply_op(name, fn, tuple(_t(x) for x in tensors), kwargs)
+
+
+class Normal(Distribution):
+    """reference normal.py Normal(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self._pt = (_t(loc), _t(scale))
+        self.loc, self.scale = _broadcast_all(loc, scale)
+        super().__init__(self.loc.shape)
+
+    @property
+    def mean(self):
+        shp = self.batch_shape
+        return _dop("normal_mean", lambda l, s: jnp.broadcast_to(l, shp),
+                    self._pt)
+
+    @property
+    def variance(self):
+        shp = self.batch_shape
+        return _dop("normal_var",
+                    lambda l, s: jnp.broadcast_to(jnp.square(s), shp),
+                    self._pt)
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_key(), self._extend_shape(shape),
+                                self.loc.dtype)
+        return _dop("normal_rsample", lambda l, s: l + s * eps, self._pt)
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            return (-jnp.square(v - l) / (2 * jnp.square(s))
+                    - jnp.log(s) - 0.5 * _LOG2PI)
+        return _dop("normal_log_prob", f, self._pt + (_t(value),))
+
+    def entropy(self):
+        shp = self.batch_shape
+        return _dop("normal_entropy",
+                    lambda l, s: jnp.broadcast_to(
+                        0.5 + 0.5 * _LOG2PI + jnp.log(s), shp), self._pt)
+
+    def cdf(self, value):
+        def f(l, s, v):
+            return 0.5 * (1 + jax.scipy.special.erf(
+                (v - l) / (s * math.sqrt(2.0))))
+        return _dop("normal_cdf", f, self._pt + (_t(value),))
+
+    def icdf(self, value):
+        def f(l, s, v):
+            return l + s * math.sqrt(2.0) * jax.scipy.special.erfinv(
+                2 * v - 1)
+        return _dop("normal_icdf", f, self._pt + (_t(value),))
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    """reference uniform.py Uniform(low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self._pt = (_t(low), _t(high))
+        self.low, self.high = _broadcast_all(low, high)
+        super().__init__(self.low.shape)
+
+    @property
+    def mean(self):
+        return _dop("uniform_mean", lambda a, b: (a + b) / 2, self._pt)
+
+    @property
+    def variance(self):
+        return _dop("uniform_var",
+                    lambda a, b: jnp.square(b - a) / 12, self._pt)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend_shape(shape),
+                               self.low.dtype)
+        return _dop("uniform_rsample", lambda a, b: a + (b - a) * u,
+                    self._pt)
+
+    def log_prob(self, value):
+        def f(a, b, v):
+            inside = (v >= a) & (v <= b)
+            return jnp.where(inside, -jnp.log(b - a), -jnp.inf)
+        return _dop("uniform_log_prob", f, self._pt + (_t(value),))
+
+    def entropy(self):
+        return _dop("uniform_entropy", lambda a, b: jnp.log(b - a),
+                    self._pt)
+
+    def cdf(self, value):
+        def f(a, b, v):
+            return jnp.clip((v - a) / (b - a), 0.0, 1.0)
+        return _dop("uniform_cdf", f, self._pt + (_t(value),))
+
+
+class Categorical(Distribution):
+    """reference categorical.py Categorical(logits); ``probs(value)`` is a
+    method (per-index probabilities), ``probs_`` the full table."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None:
+            self._lt = _dop("categorical_from_probs",
+                            lambda p: jnp.log(jnp.clip(p, 1e-38)),
+                            (probs,))
+        else:
+            self._lt = _t(logits)
+        self.logits = (_v(self._lt)
+                       - jax.scipy.special.logsumexp(
+                           _v(self._lt), axis=-1, keepdims=True))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs_(self):
+        return _dop("categorical_probs",
+                    lambda lg: jax.nn.softmax(lg, axis=-1), (self._lt,))
+
+    @property
+    def mean(self):
+        raise NotImplementedError("Categorical has no mean")
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        out = jax.random.categorical(_key(), self.logits, shape=shp)
+        return Tensor(out, stop_gradient=True)
+
+    def log_prob(self, value):
+        idx = _v(value).astype(jnp.int32)
+
+        def f(lg):
+            lg = lg - jax.scipy.special.logsumexp(lg, axis=-1,
+                                                  keepdims=True)
+            lg = jnp.broadcast_to(lg, idx.shape + lg.shape[-1:])
+            return jnp.take_along_axis(lg, idx[..., None], axis=-1)[..., 0]
+        return _dop("categorical_log_prob", f, (self._lt,))
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        def f(lg):
+            lg = lg - jax.scipy.special.logsumexp(lg, axis=-1,
+                                                  keepdims=True)
+            return -jnp.sum(jnp.exp(lg) * lg, axis=-1)
+        return _dop("categorical_entropy", f, (self._lt,))
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class Bernoulli(ExponentialFamily):
+    """reference bernoulli.py Bernoulli(probs)."""
+
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self._pp = _t(probs)
+            self._lt = _dop("bernoulli_logits",
+                            lambda p: jnp.log(p) - jnp.log1p(-p),
+                            (self._pp,))
+        else:
+            self._lt = _t(logits)
+            self._pp = _dop("bernoulli_probs", jax.nn.sigmoid, (self._lt,))
+        self.probs_ = _v(self._pp)
+        self.logits_ = _v(self._lt)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return self._pp
+
+    @property
+    def variance(self):
+        return _dop("bernoulli_var", lambda p: p * (1 - p), (self._pp,))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend_shape(shape))
+        return Tensor((u < self.probs_).astype(jnp.float32),
+                      stop_gradient=True)
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax style relaxed sample (reference bernoulli.py
+        rsample with temperature)."""
+        u = jax.random.uniform(_key(), self._extend_shape(shape),
+                               minval=1e-6, maxval=1 - 1e-6)
+        logistic = jnp.log(u) - jnp.log1p(-u)
+        return _dop("bernoulli_rsample",
+                    lambda lg: jax.nn.sigmoid((lg + logistic) / temperature),
+                    (self._lt,))
+
+    def log_prob(self, value):
+        def f(lg, v):
+            return (v * jax.nn.log_sigmoid(lg)
+                    + (1 - v) * jax.nn.log_sigmoid(-lg))
+        return _dop("bernoulli_log_prob", f, (self._lt, _t(value)))
+
+    def entropy(self):
+        def f(p):
+            eps = 1e-12
+            return -(p * jnp.log(p + eps) + (1 - p) * jnp.log(1 - p + eps))
+        return _dop("bernoulli_entropy", f, (self._pp,))
+
+
+class Beta(ExponentialFamily):
+    """reference beta.py Beta(alpha, beta)."""
+
+    def __init__(self, alpha, beta, name=None):
+        self._pt = (_t(alpha), _t(beta))
+        self.alpha, self.beta = _broadcast_all(alpha, beta)
+        super().__init__(self.alpha.shape)
+
+    @property
+    def mean(self):
+        return _dop("beta_mean", lambda a, b: a / (a + b), self._pt)
+
+    @property
+    def variance(self):
+        def f(a, b):
+            t = a + b
+            return a * b / (t * t * (t + 1))
+        return _dop("beta_var", f, self._pt)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        k1, k2 = _key(), _key()
+
+        def f(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, shp))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, shp))
+            return ga / (ga + gb)
+        return _dop("beta_rsample", f, self._pt)
+
+    def log_prob(self, value):
+        def f(a, b, v):
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
+        return _dop("beta_log_prob", f, self._pt + (_t(value),))
+
+    def entropy(self):
+        def f(a, b):
+            dg = jax.scipy.special.digamma
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+        return _dop("beta_entropy", f, self._pt)
+
+
+class Dirichlet(ExponentialFamily):
+    """reference dirichlet.py Dirichlet(concentration)."""
+
+    def __init__(self, concentration, name=None):
+        self._ct = _t(concentration)
+        self.concentration = _v(self._ct)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return _dop("dirichlet_mean",
+                    lambda a: a / a.sum(-1, keepdims=True), (self._ct,))
+
+    @property
+    def variance(self):
+        def f(a):
+            a0 = a.sum(-1, keepdims=True)
+            return a * (a0 - a) / (a0 * a0 * (a0 + 1))
+        return _dop("dirichlet_var", f, (self._ct,))
+
+    def rsample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape + self.event_shape
+        k = _key()
+
+        def f(a):
+            g = jax.random.gamma(k, jnp.broadcast_to(a, shp))
+            return g / g.sum(-1, keepdims=True)
+        return _dop("dirichlet_rsample", f, (self._ct,))
+
+    def log_prob(self, value):
+        def f(a, v):
+            return (jnp.sum((a - 1) * jnp.log(v), -1)
+                    + jax.scipy.special.gammaln(a.sum(-1))
+                    - jnp.sum(jax.scipy.special.gammaln(a), -1))
+        return _dop("dirichlet_log_prob", f, (self._ct, _t(value)))
+
+    def entropy(self):
+        def f(a):
+            a0 = a.sum(-1)
+            k = a.shape[-1]
+            dg = jax.scipy.special.digamma
+            lnB = (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                   - jax.scipy.special.gammaln(a0))
+            return lnB + (a0 - k) * dg(a0) - jnp.sum((a - 1) * dg(a), -1)
+        return _dop("dirichlet_entropy", f, (self._ct,))
+
+
+class Gumbel(Distribution):
+    """reference gumbel.py Gumbel(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self._pt = (_t(loc), _t(scale))
+        self.loc, self.scale = _broadcast_all(loc, scale)
+        super().__init__(self.loc.shape)
+
+    @property
+    def mean(self):
+        return _dop("gumbel_mean",
+                    lambda l, s: l + s * 0.57721566490153286, self._pt)
+
+    @property
+    def variance(self):
+        return _dop("gumbel_var",
+                    lambda l, s: (math.pi ** 2 / 6) * jnp.square(s),
+                    self._pt)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend_shape(shape),
+                               minval=1e-6, maxval=1 - 1e-6)
+        return _dop("gumbel_rsample",
+                    lambda l, s: l - s * jnp.log(-jnp.log(u)), self._pt)
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _dop("gumbel_log_prob", f, self._pt + (_t(value),))
+
+    def entropy(self):
+        return _dop("gumbel_entropy",
+                    lambda l, s: jnp.log(s) + 1.57721566490153286
+                    + 0 * l, self._pt)
+
+    def cdf(self, value):
+        def f(l, s, v):
+            return jnp.exp(-jnp.exp(-(v - l) / s))
+        return _dop("gumbel_cdf", f, self._pt + (_t(value),))
+
+
+class Laplace(Distribution):
+    """reference laplace.py Laplace(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self._pt = (_t(loc), _t(scale))
+        self.loc, self.scale = _broadcast_all(loc, scale)
+        super().__init__(self.loc.shape)
+
+    @property
+    def mean(self):
+        shp = self.batch_shape
+        return _dop("laplace_mean", lambda l, s: jnp.broadcast_to(l, shp),
+                    self._pt)
+
+    @property
+    def variance(self):
+        return _dop("laplace_var", lambda l, s: 2 * jnp.square(s),
+                    self._pt)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend_shape(shape),
+                               minval=-0.5 + 1e-6, maxval=0.5 - 1e-6)
+        return _dop("laplace_rsample",
+                    lambda l, s: l - s * jnp.sign(u)
+                    * jnp.log1p(-2 * jnp.abs(u)), self._pt)
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            return -jnp.abs(v - l) / s - jnp.log(2 * s)
+        return _dop("laplace_log_prob", f, self._pt + (_t(value),))
+
+    def entropy(self):
+        return _dop("laplace_entropy",
+                    lambda l, s: 1 + jnp.log(2 * s) + 0 * l, self._pt)
+
+    def cdf(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+        return _dop("laplace_cdf", f, self._pt + (_t(value),))
+
+
+class LogNormal(Distribution):
+    """reference lognormal.py LogNormal(loc, scale) = exp(Normal)."""
+
+    def __init__(self, loc, scale, name=None):
+        self._base = Normal(loc, scale)
+        self.loc, self.scale = self._base.loc, self._base.scale
+        super().__init__(self.loc.shape)
+
+    @property
+    def mean(self):
+        return _dop("lognormal_mean",
+                    lambda l, s: jnp.exp(l + jnp.square(s) / 2),
+                    self._base._pt)
+
+    @property
+    def variance(self):
+        def f(l, s):
+            s2 = jnp.square(s)
+            return jnp.expm1(s2) * jnp.exp(2 * l + s2)
+        return _dop("lognormal_var", f, self._base._pt)
+
+    def rsample(self, shape=()):
+        from ..ops.math import exp
+        return exp(self._base.rsample(shape))
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            lv = jnp.log(v)
+            return (-jnp.square(lv - l) / (2 * jnp.square(s))
+                    - jnp.log(s) - 0.5 * _LOG2PI - lv)
+        return _dop("lognormal_log_prob", f, self._base._pt + (_t(value),))
+
+    def entropy(self):
+        def f(l, s):
+            return 0.5 + 0.5 * _LOG2PI + jnp.log(s) + l
+        return _dop("lognormal_entropy", f, self._base._pt)
+
+
+class Multinomial(Distribution):
+    """reference multinomial.py Multinomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self._pp = _t(probs)
+        self.probs_ = _v(self._pp)
+        self.probs_ = self.probs_ / self.probs_.sum(-1, keepdims=True)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    @property
+    def mean(self):
+        n = self.total_count
+        return _dop("multinomial_mean",
+                    lambda p: n * p / p.sum(-1, keepdims=True), (self._pp,))
+
+    @property
+    def variance(self):
+        n = self.total_count
+
+        def f(p):
+            p = p / p.sum(-1, keepdims=True)
+            return n * p * (1 - p)
+        return _dop("multinomial_var", f, (self._pp,))
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.clip(self.probs_, 1e-38))
+        shp = tuple(shape) + self.batch_shape
+        draws = jax.random.categorical(
+            _key(), logits, shape=(self.total_count,) + shp)
+        k = self.probs_.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(0)
+        return Tensor(counts, stop_gradient=True)
+
+    def log_prob(self, value):
+        n = float(self.total_count)
+
+        def f(p, v):
+            p = p / p.sum(-1, keepdims=True)
+            logits = jnp.log(jnp.clip(p, 1e-38))
+            gl = jax.scipy.special.gammaln
+            return (gl(jnp.asarray(n + 1.0)) - jnp.sum(gl(v + 1.0), -1)
+                    + jnp.sum(v * logits, -1))
+        return _dop("multinomial_log_prob", f, (self._pp, _t(value)))
+
+    def entropy(self):
+        # no closed form; Monte-Carlo estimate matching reference behavior
+        s = self.sample((64,))
+        from ..ops.reduction import mean as tmean
+        return -tmean(self.log_prob(s), axis=0)
+
+
+class Geometric(Distribution):
+    """reference geometric.py Geometric(probs): failures before success,
+    support {0, 1, ...}."""
+
+    def __init__(self, probs, name=None):
+        self._pp = _t(probs)
+        self.probs_, = _broadcast_all(probs)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        return _dop("geometric_mean", lambda p: (1 - p) / p, (self._pp,))
+
+    @property
+    def variance(self):
+        return _dop("geometric_var",
+                    lambda p: (1 - p) / jnp.square(p), (self._pp,))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend_shape(shape),
+                               minval=1e-7, maxval=1 - 1e-7)
+        out = jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_))
+        return Tensor(out, stop_gradient=True)
+
+    def log_prob(self, value):
+        def f(p, v):
+            return v * jnp.log1p(-p) + jnp.log(p)
+        return _dop("geometric_log_prob", f, (self._pp, _t(value)))
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+        return _dop("geometric_entropy", f, (self._pp,))
+
+    def cdf(self, value):
+        def f(p, v):
+            return 1 - jnp.power(1 - p, jnp.floor(v) + 1)
+        return _dop("geometric_cdf", f, (self._pp, _t(value)))
+
+
+class Cauchy(Distribution):
+    """reference cauchy.py Cauchy(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self._pt = (_t(loc), _t(scale))
+        self.loc, self.scale = _broadcast_all(loc, scale)
+        super().__init__(self.loc.shape)
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy has no variance")
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend_shape(shape),
+                               minval=1e-6, maxval=1 - 1e-6)
+        return _dop("cauchy_rsample",
+                    lambda l, s: l + s * jnp.tan(math.pi * (u - 0.5)),
+                    self._pt)
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return -jnp.log(math.pi * s * (1 + z * z))
+        return _dop("cauchy_log_prob", f, self._pt + (_t(value),))
+
+    def entropy(self):
+        return _dop("cauchy_entropy",
+                    lambda l, s: jnp.log(4 * math.pi * s) + 0 * l, self._pt)
+
+    def cdf(self, value):
+        def f(l, s, v):
+            return jnp.arctan((v - l) / s) / math.pi + 0.5
+        return _dop("cauchy_cdf", f, self._pt + (_t(value),))
+
+
+class Exponential(ExponentialFamily):
+    """reference exponential.py Exponential(rate)."""
+
+    def __init__(self, rate, name=None):
+        self._rt = _t(rate)
+        self.rate, = _broadcast_all(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _dop("exponential_mean", lambda r: 1.0 / r, (self._rt,))
+
+    @property
+    def variance(self):
+        return _dop("exponential_var", lambda r: 1.0 / jnp.square(r),
+                    (self._rt,))
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend_shape(shape),
+                               minval=1e-7, maxval=1.0)
+        return _dop("exponential_rsample", lambda r: -jnp.log(u) / r,
+                    (self._rt,))
+
+    def log_prob(self, value):
+        def f(r, v):
+            return jnp.log(r) - r * v
+        return _dop("exponential_log_prob", f, (self._rt, _t(value)))
+
+    def entropy(self):
+        return _dop("exponential_entropy", lambda r: 1 - jnp.log(r),
+                    (self._rt,))
+
+    def cdf(self, value):
+        def f(r, v):
+            return -jnp.expm1(-r * v)
+        return _dop("exponential_cdf", f, (self._rt, _t(value)))
+
+
+class Gamma(ExponentialFamily):
+    """reference gamma.py Gamma(concentration, rate)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self._pt = (_t(concentration), _t(rate))
+        self.concentration, self.rate = _broadcast_all(concentration, rate)
+        super().__init__(self.concentration.shape)
+
+    @property
+    def mean(self):
+        return _dop("gamma_mean", lambda a, b: a / b, self._pt)
+
+    @property
+    def variance(self):
+        return _dop("gamma_var", lambda a, b: a / jnp.square(b), self._pt)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        k = _key()
+
+        def f(a, b):
+            # jax.random.gamma is reparameterized (implicit grads)
+            return jax.random.gamma(k, jnp.broadcast_to(a, shp)) / b
+        return _dop("gamma_rsample", f, self._pt)
+
+    def log_prob(self, value):
+        def f(a, b, v):
+            return (a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                    - jax.scipy.special.gammaln(a))
+        return _dop("gamma_log_prob", f, self._pt + (_t(value),))
+
+    def entropy(self):
+        def f(a, b):
+            dg = jax.scipy.special.digamma
+            return (a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                    + (1 - a) * dg(a))
+        return _dop("gamma_entropy", f, self._pt)
+
+
+class Poisson(Distribution):
+    """reference poisson.py Poisson(rate)."""
+
+    def __init__(self, rate, name=None):
+        self._rt = _t(rate)
+        self.rate, = _broadcast_all(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        shp = self.batch_shape
+        return _dop("poisson_mean", lambda r: jnp.broadcast_to(r, shp),
+                    (self._rt,))
+
+    @property
+    def variance(self):
+        shp = self.batch_shape
+        return _dop("poisson_var", lambda r: jnp.broadcast_to(r, shp),
+                    (self._rt,))
+
+    def sample(self, shape=()):
+        out = jax.random.poisson(_key(), self.rate,
+                                 self._extend_shape(shape))
+        return Tensor(out.astype(jnp.float32), stop_gradient=True)
+
+    def log_prob(self, value):
+        def f(r, v):
+            return (v * jnp.log(r) - r
+                    - jax.scipy.special.gammaln(v + 1))
+        return _dop("poisson_log_prob", f, (self._rt, _t(value)))
+
+    def entropy(self):
+        def f(r):
+            # Stirling-series approximation (reference uses the same tail)
+            return (0.5 * jnp.log(2 * math.pi * math.e * r)
+                    - 1 / (12 * r) - 1 / (24 * r * r))
+        return _dop("poisson_entropy", f, (self._rt,))
+
+
+class StudentT(Distribution):
+    """reference student_t.py StudentT(df, loc, scale)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self._pt = (_t(df), _t(loc), _t(scale))
+        self.df, self.loc, self.scale = _broadcast_all(df, loc, scale)
+        super().__init__(self.df.shape)
+
+    @property
+    def mean(self):
+        def f(df, l, s):
+            return jnp.where(df > 1, l, jnp.nan)
+        return _dop("studentt_mean", f, self._pt)
+
+    @property
+    def variance(self):
+        def f(df, l, s):
+            v = jnp.where(df > 2, jnp.square(s) * df / (df - 2), jnp.inf)
+            return jnp.where(df > 1, v, jnp.nan)
+        return _dop("studentt_var", f, self._pt)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        k = _key()
+
+        def f(df, l, s):
+            t = jax.random.t(k, df, shape=shp)
+            return l + s * t
+        return _dop("studentt_rsample", f, self._pt)
+
+    def log_prob(self, value):
+        def f(df, l, s, v):
+            z = (v - l) / s
+            gl = jax.scipy.special.gammaln
+            return (gl((df + 1) / 2) - gl(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - ((df + 1) / 2) * jnp.log1p(z * z / df))
+        return _dop("studentt_log_prob", f, self._pt + (_t(value),))
+
+    def entropy(self):
+        def f(df, l, s):
+            dg = jax.scipy.special.digamma
+            gl = jax.scipy.special.gammaln
+            return ((df + 1) / 2 * (dg((df + 1) / 2) - dg(df / 2))
+                    + 0.5 * jnp.log(df) + jnp.log(s)
+                    + gl(df / 2) + gl(0.5) - gl((df + 1) / 2))
+        return _dop("studentt_entropy", f, self._pt)
+
+
+class Binomial(Distribution):
+    """reference binomial.py Binomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self._pp = _t(probs)
+        self.probs_, = _broadcast_all(probs)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self):
+        n = self.total_count
+        return _dop("binomial_mean", lambda p: n * p, (self._pp,))
+
+    @property
+    def variance(self):
+        n = self.total_count
+        return _dop("binomial_var", lambda p: n * p * (1 - p), (self._pp,))
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(_key(), (self.total_count,) + shp)
+        out = (u < self.probs_).astype(jnp.float32).sum(0)
+        return Tensor(out, stop_gradient=True)
+
+    def log_prob(self, value):
+        n = float(self.total_count)
+
+        def f(p, v):
+            gl = jax.scipy.special.gammaln
+            return (gl(n + 1) - gl(v + 1) - gl(n - v + 1)
+                    + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+        return _dop("binomial_log_prob", f, (self._pp, _t(value)))
+
+    def entropy(self):
+        s = self.sample((64,))
+        from ..ops.reduction import mean as tmean
+        return -tmean(self.log_prob(s), axis=0)
+
+
+class ContinuousBernoulli(Distribution):
+    """reference continuous_bernoulli.py CB(probs)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self._pp = _t(probs)
+        self.probs_, = _broadcast_all(probs)
+        self._lims = lims
+        super().__init__(self.probs_.shape)
+
+    def _log_norm_raw(self, p):
+        near_half = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near_half, 0.25, p)
+        c = jnp.log((2 * jnp.arctanh(1 - 2 * safe)) / (1 - 2 * safe))
+        # Taylor at p = 1/2: log 2 + 4/3 x², x = p - 1/2
+        x = p - 0.5
+        taylor = math.log(2.0) + 4.0 / 3.0 * x * x
+        return jnp.where(near_half, taylor, c)
+
+    @property
+    def mean(self):
+        lims = self._lims
+
+        def f(p):
+            near_half = (p > lims[0]) & (p < lims[1])
+            safe = jnp.where(near_half, 0.25, p)
+            m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+            taylor = 0.5 + (p - 0.5) / 3.0
+            return jnp.where(near_half, taylor, m)
+        return _dop("cb_mean", f, (self._pp,))
+
+    @property
+    def variance(self):
+        s = _v(self.rsample((256,)))
+        return Tensor(jnp.var(s, axis=0))
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend_shape(shape),
+                               minval=1e-6, maxval=1 - 1e-6)
+        lims = self._lims
+
+        def f(p):
+            near_half = (p > lims[0]) & (p < lims[1])
+            safe = jnp.where(near_half, 0.25, p)
+            s = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                 / (jnp.log(safe) - jnp.log1p(-safe)))
+            return jnp.where(near_half, u, s)
+        return _dop("cb_rsample", f, (self._pp,))
+
+    def log_prob(self, value):
+        def f(p, v):
+            pc = jnp.clip(p, 1e-6, 1 - 1e-6)
+            return (v * jnp.log(pc) + (1 - v) * jnp.log1p(-pc)
+                    + self._log_norm_raw(pc))
+        return _dop("cb_log_prob", f, (self._pp, _t(value)))
+
+
+class Chi2(Gamma):
+    """reference chi2.py Chi2(df) = Gamma(df/2, 1/2)."""
+
+    def __init__(self, df, name=None):
+        df_t = _t(df)
+        half = apply_op("chi2_half", lambda d: d / 2.0, (df_t,), {})
+        super().__init__(half, 0.5)
+        self.df = _v(df_t)

@@ -1,0 +1,47 @@
+"""Independent (reference: distribution/independent.py — reinterpret
+batch dims as event dims)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _v
+
+__all__ = ["Independent"]
+
+
+class Independent(Distribution):
+    def __init__(self, base: Distribution, reinterpreted_batch_ndims: int):
+        self.base = base
+        self.reinterpreted_batch_ndims = k = int(reinterpreted_batch_ndims)
+        bs = base.batch_shape
+        if k > len(bs):
+            raise ValueError(
+                f"reinterpreted_batch_ndims {k} exceeds batch rank {len(bs)}")
+        super().__init__(bs[:len(bs) - k], bs[len(bs) - k:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def _sum_rightmost(self, x):
+        for _ in range(self.reinterpreted_batch_ndims):
+            x = x.sum(-1)
+        return x
+
+    def log_prob(self, value):
+        return Tensor(self._sum_rightmost(_v(self.base.log_prob(value))))
+
+    def entropy(self):
+        return Tensor(self._sum_rightmost(_v(self.base.entropy())))

@@ -1,0 +1,184 @@
+"""KL divergence registry (reference: distribution/kl.py — kl_divergence
+dispatch over (type(p), type(q)) with register_kl decorator and an
+ExponentialFamily Bregman fallback)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, ExponentialFamily, _v
+from . import distributions as D
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(cls_p, cls_q):
+    """reference kl.py register_kl decorator."""
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def _dispatch(p, q):
+    matches = [(pc, qc) for (pc, qc) in _KL_REGISTRY
+               if isinstance(p, pc) and isinstance(q, qc)]
+    if not matches:
+        return None
+    # most-derived match (reference _dispatch total order heuristic)
+    def score(m):
+        pc, qc = m
+        return (len(type(p).__mro__) - type(p).__mro__.index(pc),
+                len(type(q).__mro__) - type(q).__mro__.index(qc))
+    return _KL_REGISTRY[max(matches, key=score)]
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """reference kl.py kl_divergence."""
+    fn = _dispatch(p, q)
+    if fn is not None:
+        return fn(p, q)
+    if isinstance(p, ExponentialFamily) and type(p) is type(q):
+        return _kl_expfamily(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+def _kl_expfamily(p, q):
+    """Bregman divergence of the log-normalizer (reference
+    _kl_expfamily_expfamily)."""
+    p_nat = [jnp.asarray(_v(x)) for x in p._natural_parameters]
+    q_nat = [jnp.asarray(_v(x)) for x in q._natural_parameters]
+    lg_p, grads = jax.value_and_grad(
+        lambda ps: jnp.sum(p._log_normalizer(*ps)))(tuple(p_nat))
+    lg_q = jnp.sum(q._log_normalizer(*q_nat))
+    term = sum(jnp.sum((pn - qn) * g)
+               for pn, qn, g in zip(p_nat, q_nat, grads))
+    return Tensor(lg_q - lg_p + term)
+
+
+@register_kl(D.Normal, D.Normal)
+def _kl_normal_normal(p, q):
+    def f(pl, ps, ql, qs):
+        var_ratio = jnp.square(ps / qs)
+        t1 = jnp.square((pl - ql) / qs)
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return D._dop("kl_normal", f, p._pt + q._pt)
+
+
+@register_kl(D.Uniform, D.Uniform)
+def _kl_uniform_uniform(p, q):
+    def f(pa, pb, qa, qb):
+        inside = (qa <= pa) & (pb <= qb)
+        return jnp.where(inside, jnp.log((qb - qa) / (pb - pa)), jnp.inf)
+    return D._dop("kl_uniform", f, p._pt + q._pt)
+
+
+@register_kl(D.Categorical, D.Categorical)
+def _kl_categorical_categorical(p, q):
+    def f(pl, ql):
+        lse = jax.scipy.special.logsumexp
+        pl = pl - lse(pl, axis=-1, keepdims=True)
+        ql = ql - lse(ql, axis=-1, keepdims=True)
+        return jnp.sum(jnp.exp(pl) * (pl - ql), axis=-1)
+    return D._dop("kl_categorical", f, (p._lt, q._lt))
+
+
+@register_kl(D.Bernoulli, D.Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    def f(a, b):
+        eps = 1e-12
+        return (a * (jnp.log(a + eps) - jnp.log(b + eps))
+                + (1 - a) * (jnp.log(1 - a + eps) - jnp.log(1 - b + eps)))
+    return D._dop("kl_bernoulli", f, (p._pp, q._pp))
+
+
+@register_kl(D.Beta, D.Beta)
+def _kl_beta_beta(p, q):
+    def f(pa, pb, qa, qb):
+        gl = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        t = (gl(qa) + gl(qb) - gl(qa + qb)
+             - gl(pa) - gl(pb) + gl(pa + pb))
+        return (t + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
+                + (qa - pa + qb - pb) * dg(pa + pb))
+    return D._dop("kl_beta", f, p._pt + q._pt)
+
+
+@register_kl(D.Dirichlet, D.Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def f(pa, qa):
+        gl = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        p0 = pa.sum(-1)
+        return (gl(p0) - jnp.sum(gl(pa), -1)
+                - gl(qa.sum(-1)) + jnp.sum(gl(qa), -1)
+                + jnp.sum((pa - qa) * (dg(pa) - dg(p0)[..., None]), -1))
+    return D._dop("kl_dirichlet", f, (p._ct, q._ct))
+
+
+@register_kl(D.Exponential, D.Exponential)
+def _kl_exponential_exponential(p, q):
+    def f(pr, qr):
+        return jnp.log(pr / qr) + qr / pr - 1
+    return D._dop("kl_exponential", f, (p._rt, q._rt))
+
+
+@register_kl(D.Gamma, D.Gamma)
+def _kl_gamma_gamma(p, q):
+    def f(pa, pb, qa, qb):
+        gl = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        return ((pa - qa) * dg(pa) - gl(pa) + gl(qa)
+                + qa * (jnp.log(pb) - jnp.log(qb))
+                + pa * (qb - pb) / pb)
+    return D._dop("kl_gamma", f, p._pt + q._pt)
+
+
+@register_kl(D.Laplace, D.Laplace)
+def _kl_laplace_laplace(p, q):
+    def f(pl, ps, ql, qs):
+        scale_ratio = ps / qs
+        loc_diff = jnp.abs(pl - ql) / qs
+        return (-jnp.log(scale_ratio) + scale_ratio - 1
+                + scale_ratio * jnp.expm1(-loc_diff / scale_ratio)
+                + loc_diff)
+    return D._dop("kl_laplace", f, p._pt + q._pt)
+
+
+@register_kl(D.Gumbel, D.Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    # log(β2/β1) + γ(β1/β2 - 1) + e^{(μ2-μ1)/β2}·Γ(1+β1/β2) - 1
+    #   + (μ1-μ2)/β2
+    def f(pl, ps, ql, qs):
+        euler = 0.57721566490153286
+        ratio = ps / qs
+        gamma_term = jnp.exp((ql - pl) / qs
+                             + jax.scipy.special.gammaln(1 + ratio))
+        return (jnp.log(qs / ps) + euler * (ratio - 1)
+                + gamma_term - 1 + (pl - ql) / qs)
+    return D._dop("kl_gumbel", f, p._pt + q._pt)
+
+
+@register_kl(D.Geometric, D.Geometric)
+def _kl_geometric_geometric(p, q):
+    def f(pp, qp):
+        ent = -((1 - pp) * jnp.log(1 - pp) + pp * jnp.log(pp)) / pp
+        return (-ent - jnp.log1p(-qp) / pp - jnp.log(qp) + jnp.log1p(-qp))
+    return D._dop("kl_geometric", f, (p._pp, q._pp))
+
+
+@register_kl(D.LogNormal, D.LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p._base, q._base)
+
+
+@register_kl(D.Poisson, D.Poisson)
+def _kl_poisson_poisson(p, q):
+    def f(pr, qr):
+        return pr * (jnp.log(pr) - jnp.log(qr)) - pr + qr
+    return D._dop("kl_poisson", f, (p._rt, q._rt))

@@ -1,0 +1,246 @@
+"""Bijective transforms (reference: distribution/transform.py — Transform
+ABC with forward/inverse/log_det_jacobian, 13 concrete transforms)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distribution import _v
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+           "ExpTransform", "IndependentTransform", "PowerTransform",
+           "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform"]
+
+
+class Transform:
+    """reference transform.py Transform."""
+
+    _event_dim = 0
+
+    @property
+    def event_dim(self):
+        return self._event_dim
+
+    def _dop(self, suffix, fn, x):
+        """Route through the dispatcher so gradients flow through the
+        transform (VAE flows differentiate through forward/fldj)."""
+        from ..core.dispatch import apply_op
+        t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        return apply_op(f"{type(self).__name__}_{suffix}", fn, (t,), {})
+
+    def forward(self, x):
+        return self._dop("fwd", self._forward, x)
+
+    def inverse(self, y):
+        return self._dop("inv", self._inverse, y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._dop("fldj", self._fldj, x)
+
+    def inverse_log_det_jacobian(self, y):
+        return self._dop("ildj",
+                         lambda v: -self._fldj(self._inverse(v)), y)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # subclass hooks on raw arrays
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _v(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh²) = 2(log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} → simplex^K (reference StickBreakingTransform)."""
+
+    _event_dim = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zp = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(zp[..., :1]), zp[..., :-1]], -1)
+        first = z * lead
+        return jnp.concatenate([first, zp[..., -1:]], -1)
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], axis=-1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros_like(ycum[..., :1]), ycum[..., :-1]], -1)
+        z = y[..., :-1] / rest
+        k = y.shape[-1] - 1
+        offset = k - jnp.arange(k, dtype=y.dtype)
+        return jnp.log(z / (1 - z)) + jnp.log(offset)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._event_dim = len(self.in_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._event_dim = max((t.event_dim for t in self.transforms),
+                              default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.reinterpreted_batch_ndims = reinterpreted_batch_ndims
+        self._event_dim = base.event_dim + reinterpreted_batch_ndims
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ldj = self.base._fldj(x)
+        for _ in range(self.reinterpreted_batch_ndims):
+            ldj = ldj.sum(-1)
+        return ldj
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, fn_name, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(p.squeeze(self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _fldj(self, x):
+        return self._map("_fldj", x)

@@ -1,0 +1,49 @@
+"""TransformedDistribution (reference:
+distribution/transformed_distribution.py — base distribution pushed
+through a chain of bijective transforms)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distribution import Distribution, _v
+from .transform import ChainTransform
+
+__all__ = ["TransformedDistribution"]
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base: Distribution, transforms):
+        self.base = base
+        if not isinstance(transforms, (list, tuple)):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        out = self._chain.forward(x)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        return self._chain.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        """log p(y) = log p_base(f⁻¹(y)) - log|det J_f(f⁻¹(y))|, event
+        dims of each transform summed out (reference same accounting).
+        Computed through the dispatcher so params keep gradients."""
+        y = _v(value)
+        ldj_total = 0.0
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            ldj = t._fldj(x)
+            for _ in range(t.event_dim):
+                ldj = ldj.sum(-1)
+            ldj_total = ldj_total + ldj
+            y = x
+        base_lp = self.base.log_prob(Tensor(y))
+        from ..ops.math import subtract
+        return subtract(base_lp, Tensor(jnp.asarray(ldj_total)))
