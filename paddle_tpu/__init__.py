@@ -53,6 +53,9 @@ from . import linalg  # noqa: F401
 from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import framework  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
+from . import callbacks  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .framework.param_attr import ParamAttr  # noqa: F401
 from .device import set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu  # noqa: F401
@@ -64,6 +67,7 @@ __all__ = (
     ["Tensor", "Parameter", "to_tensor", "no_grad", "enable_grad", "grad",
      "seed", "save", "load", "set_default_dtype", "get_default_dtype",
      "set_flags", "get_flags", "set_device", "get_device", "ParamAttr",
+     "Model", "summary",
      "accuracy"]
     + list(_ops_all)
 )

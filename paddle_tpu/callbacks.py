@@ -1,0 +1,9 @@
+"""paddle.callbacks namespace (reference: python/paddle/callbacks.py
+re-exporting hapi callbacks)."""
+
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ReduceLROnPlateau)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "ReduceLROnPlateau"]

@@ -1,0 +1,16 @@
+"""paddle_tpu.hapi — Keras-like high-level API (reference:
+python/paddle/hapi/ — model.py, callbacks.py, model_summary.py)."""
+
+from .callbacks import (Callback, EarlyStopping, LRScheduler,  # noqa: F401
+                        ModelCheckpoint, ProgBarLogger, ReduceLROnPlateau)
+from .model import Model  # noqa: F401
+
+
+def summary(net, input_size=None, dtypes=None):
+    """reference hapi/model_summary.py summary(net, input_size)."""
+    return Model(net).summary(input_size)
+
+
+__all__ = ["Model", "summary", "Callback", "ProgBarLogger",
+           "ModelCheckpoint", "LRScheduler", "EarlyStopping",
+           "ReduceLROnPlateau"]

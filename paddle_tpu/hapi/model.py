@@ -1,0 +1,307 @@
+"""hapi Model — Keras-like train/eval/predict loop (reference:
+python/paddle/hapi/model.py — Model:?, fit:1754, evaluate:2000,
+predict:2111, train_batch:1052, save/load, summary)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import optimizer as optim
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from ..metric import Metric
+from .callbacks import (Callback, CallbackList, ModelCheckpoint,
+                        ProgBarLogger)
+
+__all__ = ["Model"]
+
+
+def _to_tensor_list(data):
+    if isinstance(data, (list, tuple)):
+        return [d if isinstance(d, Tensor) else Tensor(np.asarray(d))
+                for d in data]
+    return [data if isinstance(data, Tensor) else Tensor(np.asarray(data))]
+
+
+def _as_loader(data, batch_size, shuffle):
+    if data is None or isinstance(data, DataLoader):
+        return data
+    return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+
+class Model:
+    """reference hapi/model.py Model(network, inputs=None, labels=None)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # -- configuration ------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        """reference model.py prepare."""
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} is not a paddle Metric")
+        return self
+
+    # -- single-batch ops (reference :1052-1200) ----------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        losses, _ = self._train_one(inputs, labels, update)
+        return losses
+
+    def _train_one(self, inputs, labels, update=True):
+        self.network.train()
+        ins = _to_tensor_list(inputs)
+        outs = self.network(*ins)
+        losses = self._compute_loss(outs, labels)
+        total = losses[0]
+        for extra in losses[1:]:
+            total = total + extra
+        total.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(lo) for lo in losses], outs
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core import autograd
+        self.network.eval()
+        with autograd.no_grad():
+            ins = _to_tensor_list(inputs)
+            outs = self.network(*ins)
+            losses = self._compute_loss(outs, labels)
+        return [float(lo) for lo in losses], outs
+
+    def predict_batch(self, inputs):
+        from ..core import autograd
+        self.network.eval()
+        with autograd.no_grad():
+            outs = self.network(*_to_tensor_list(inputs))
+        return outs if isinstance(outs, (list, tuple)) else [outs]
+
+    def _compute_loss(self, outs, labels):
+        if self._loss is None:
+            raise RuntimeError("call prepare(loss=...) before training")
+        outs_l = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        labels_l = _to_tensor_list(labels) if labels is not None else []
+        loss = self._loss(*(outs_l + labels_l))
+        return list(loss) if isinstance(loss, (list, tuple)) else [loss]
+
+    # -- loops --------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        """reference model.py fit:1754."""
+        loader = _as_loader(train_data, batch_size, shuffle)
+        eval_loader = _as_loader(eval_data, batch_size, False)
+        cbks = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbks):
+            cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+        if save_dir and not any(isinstance(c, ModelCheckpoint)
+                                for c in cbks):
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cbk = CallbackList(cbks)
+        cbk.set_model(self)
+        cbk.set_params({"epochs": epochs, "steps": len(loader),
+                        "verbose": verbose, "metrics":
+                        ["loss"] + [m.name() for m in self._metrics]})
+        self.stop_training = False
+        cbk.on_train_begin()
+        history = {"loss": []}
+        step_count = 0
+        for epoch in range(epochs):
+            cbk.on_epoch_begin(epoch)
+            self._reset_metrics()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbk.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                losses, outs = self._train_one(
+                    inputs, labels,
+                    update=(step + 1) % accumulate_grad_batches == 0)
+                logs = {"loss": losses[0]}
+                logs.update(self._update_metrics(outs, labels))
+                cbk.on_train_batch_end(step, logs)
+                step_count += 1
+                if num_iters is not None and step_count >= num_iters:
+                    break
+            history["loss"].append(logs.get("loss"))
+            cbk.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbk)
+                for k, v in eval_logs.items():
+                    history.setdefault("eval_" + k, []).append(v)
+            if self.stop_training:
+                break
+        cbk.on_train_end(logs)
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        """reference model.py evaluate:2000 → {metric_name: value}."""
+        loader = _as_loader(eval_data, batch_size, False)
+        cbk = CallbackList(list(callbacks or []))
+        cbk.set_model(self)
+        cbk.set_params({"steps": len(loader)})
+        return self._run_eval(loader, cbk)
+
+    def _run_eval(self, loader, cbk):
+        cbk.on_eval_begin()
+        self._reset_metrics()
+        total_loss, n = 0.0, 0
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbk.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            losses, outs = self.eval_batch(inputs, labels)
+            total_loss += losses[0]
+            n += 1
+            logs = {"loss": total_loss / max(n, 1)}
+            logs.update(self._update_metrics(outs, labels))
+            cbk.on_eval_batch_end(step, logs)
+        cbk.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        """reference model.py predict:2111 → list per output."""
+        loader = _as_loader(test_data, batch_size, False)
+        cbk = CallbackList(list(callbacks or []))
+        cbk.set_model(self)
+        cbk.on_predict_begin()
+        outputs = None
+        for step, batch in enumerate(loader):
+            cbk.on_predict_batch_begin(step)
+            inputs, _ = self._split_batch(batch, has_labels=False)
+            outs = self.predict_batch(inputs)
+            arrays = [np.asarray(o._value) for o in outs]
+            if outputs is None:
+                outputs = [[a] for a in arrays]
+            else:
+                for lst, a in zip(outputs, arrays):
+                    lst.append(a)
+            cbk.on_predict_batch_end(step)
+        cbk.on_predict_end()
+        if outputs is None:
+            return []
+        if stack_outputs:
+            return [np.concatenate(lst, axis=0) for lst in outputs]
+        return outputs
+
+    # -- helpers ------------------------------------------------------------
+    def _net_arity(self):
+        """Number of forward inputs (reference uses the `inputs` spec; we
+        also fall back to the network.forward signature)."""
+        if self._inputs is not None:
+            return len(self._inputs) if isinstance(
+                self._inputs, (list, tuple)) else 1
+        import inspect
+        try:
+            sig = inspect.signature(self.network.forward)
+            n = 0
+            for prm in sig.parameters.values():
+                if prm.kind == prm.VAR_POSITIONAL:
+                    return None  # *args: can't infer
+                if prm.default is prm.empty and prm.kind in (
+                        prm.POSITIONAL_ONLY, prm.POSITIONAL_OR_KEYWORD):
+                    n += 1
+            return n or None
+        except (TypeError, ValueError):
+            return None
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            n_in = self._net_arity()
+            if n_in is not None and 0 < n_in < len(batch):
+                return batch[:n_in], (batch[n_in:] if has_labels else None)
+            if has_labels and len(batch) >= 2:
+                return batch[:-1], batch[-1:]
+            return batch, None
+        return [batch], None
+
+    def _reset_metrics(self):
+        for m in self._metrics:
+            m.reset()
+
+    def _update_metrics(self, outs, labels):
+        logs = {}
+        out0 = outs[0] if isinstance(outs, (list, tuple)) else outs
+        for m in self._metrics:
+            if labels is not None:
+                pre = m.compute(out0, *_to_tensor_list(labels))
+                if isinstance(pre, (list, tuple)):
+                    m.update(*[np.asarray(p._value) if isinstance(p, Tensor)
+                               else p for p in pre])
+                else:
+                    m.update(np.asarray(pre._value)
+                             if isinstance(pre, Tensor) else pre)
+            res = m.accumulate()
+            name = m.name()
+            if isinstance(name, (list, tuple)):
+                for nm, v in zip(name, res if isinstance(
+                        res, (list, tuple)) else [res]):
+                    logs[nm] = v
+            else:
+                logs[name] = res
+        return logs
+
+    # -- persistence / info (reference model.py save:?, summary:?) ----------
+    def save(self, path, training=True):
+        from ..framework.io import save
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load
+        state = load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        """reference hapi/model_summary.py summary — layer/param table."""
+        rows = []
+        total = 0
+        trainable = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            if not p.stop_gradient:
+                trainable += n
+            rows.append((name, tuple(p.shape), n))
+        width = max([len(r[0]) for r in rows], default=20) + 2
+        lines = [f"{'Param':<{width}}{'Shape':<20}{'Count':>12}",
+                 "-" * (width + 32)]
+        for name, shape, n in rows:
+            lines.append(f"{name:<{width}}{str(shape):<20}{n:>12,}")
+        lines.append("-" * (width + 32))
+        lines.append(f"Total params: {total:,}")
+        lines.append(f"Trainable params: {trainable:,}")
+        lines.append(f"Non-trainable params: {total - trainable:,}")
+        print("\n".join(lines))
+        return {"total_params": total, "trainable_params": trainable}

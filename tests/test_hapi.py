@@ -1,0 +1,165 @@
+"""hapi Model tests (reference: test/legacy_test/test_model.py — fit/
+evaluate/predict on LeNet + callbacks; hapi/model.py:1052,1754)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import Dataset
+
+
+class SyntheticMnist(Dataset):
+    """Linearly separable 'MNIST': images whose mean brightness by
+    quadrant encodes the class — learnable by LeNet in a few steps."""
+
+    def __init__(self, n=128, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = np.zeros((n, 1, 28, 28), np.float32)
+        self.y = rng.randint(0, 4, (n,)).astype(np.int64)
+        for i, c in enumerate(self.y):
+            img = rng.rand(28, 28).astype(np.float32) * 0.1
+            r, cq = divmod(int(c), 2)
+            img[r * 14:(r + 1) * 14, cq * 14:(cq + 1) * 14] += 0.9
+            self.x[i, 0] = img
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.flatten = nn.Flatten()
+        self.fc1 = nn.Linear(784, 32)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(self.flatten(x))))
+
+
+def _prepared_model():
+    paddle.seed(7)
+    net = SmallNet()
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy(topk=(1,)))
+    return model
+
+
+class TestModelFit:
+    def test_fit_converges_and_callbacks_fire(self, tmp_path, capsys):
+        model = _prepared_model()
+        ds = SyntheticMnist(96)
+        fired = []
+
+        class Spy(paddle.callbacks.Callback):
+            def on_epoch_begin(self, epoch, logs=None):
+                fired.append(("epoch_begin", epoch))
+
+            def on_train_batch_end(self, step, logs=None):
+                fired.append(("batch", step))
+
+        hist = model.fit(ds, ds, batch_size=32, epochs=3, verbose=2,
+                         save_dir=str(tmp_path / "ckpt"),
+                         callbacks=[Spy()])
+        out = capsys.readouterr().out
+        assert "Epoch 1/3" in out            # ProgBarLogger
+        assert ("epoch_begin", 0) in fired and ("batch", 0) in fired
+        assert hist["loss"][-1] < hist["loss"][0]
+        # checkpoint written (ModelCheckpoint via save_dir)
+        assert (tmp_path / "ckpt" / "final.pdparams").exists()
+        # converged enough to beat chance by a wide margin
+        metrics = model.evaluate(ds, batch_size=32)
+        assert metrics["acc"] > 0.8, metrics
+
+    def test_evaluate_and_predict(self):
+        model = _prepared_model()
+        ds = SyntheticMnist(64)
+        model.fit(ds, batch_size=32, epochs=2, verbose=0)
+        metrics = model.evaluate(ds, batch_size=32, verbose=0)
+        assert set(metrics) >= {"loss", "acc"}
+        preds = model.predict(ds, batch_size=32, stack_outputs=True)
+        assert preds[0].shape == (64, 4)
+        acc = (preds[0].argmax(-1) == ds.y).mean()
+        assert acc > 0.8
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = _prepared_model()
+        ds = SyntheticMnist(32)
+        model.fit(ds, batch_size=16, epochs=1, verbose=0)
+        model.save(str(tmp_path / "m"))
+        model2 = _prepared_model()
+        model2.load(str(tmp_path / "m"))
+        p1 = model.predict(ds, batch_size=16, stack_outputs=True)[0]
+        p2 = model2.predict(ds, batch_size=16, stack_outputs=True)[0]
+        np.testing.assert_allclose(p1, p2, atol=1e-6)
+
+    def test_train_eval_predict_batch(self):
+        model = _prepared_model()
+        x = np.random.rand(8, 1, 28, 28).astype(np.float32)
+        y = np.random.randint(0, 4, (8,)).astype(np.int64)
+        losses = model.train_batch([x], [y])
+        assert len(losses) == 1 and np.isfinite(losses[0])
+        losses2, outs = model.eval_batch([x], [y])
+        assert np.isfinite(losses2[0]) and outs._value.shape == (8, 4)
+        preds = model.predict_batch([x])
+        assert preds[0]._value.shape == (8, 4)
+
+    def test_summary(self, capsys):
+        model = _prepared_model()
+        info = model.summary()
+        out = capsys.readouterr().out
+        assert "Total params" in out
+        assert info["total_params"] == 784 * 32 + 32 + 32 * 4 + 4
+        info2 = paddle.summary(SmallNet())
+        assert info2["total_params"] == info["total_params"]
+
+
+class TestCallbacks:
+    def test_early_stopping(self):
+        model = _prepared_model()
+        ds = SyntheticMnist(64)
+        es = paddle.callbacks.EarlyStopping(monitor="loss", patience=0,
+                                            mode="min", verbose=0)
+        # with patience 0 and a tiny lr the eval loss plateaus fast
+        model._optimizer.set_lr(0.0)
+        model.fit(ds, ds, batch_size=32, epochs=6, verbose=0,
+                  callbacks=[es])
+        assert model.stop_training
+
+    def test_reduce_lr_on_plateau(self):
+        model = _prepared_model()
+        ds = SyntheticMnist(32)
+        model._optimizer.set_lr(0.1)
+        cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                                patience=1, verbose=0,
+                                                mode="min")
+        model._optimizer.set_lr(0.1)
+        # freeze learning so loss can't improve -> lr halves
+        for p in model.network.parameters():
+            p.stop_gradient = True
+        model.fit(ds, ds, batch_size=32, epochs=4, verbose=0,
+                  callbacks=[cb])
+        assert float(model._optimizer.get_lr()) < 0.1
+
+    def test_lr_scheduler_callback(self):
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=1, gamma=0.5)
+        paddle.seed(1)
+        net = SmallNet()
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        ds = SyntheticMnist(16)
+        model.fit(ds, batch_size=16, epochs=2, verbose=0,
+                  callbacks=[paddle.callbacks.LRScheduler(by_step=False,
+                                                          by_epoch=True)])
+        assert float(opt.get_lr()) < 0.1
