@@ -54,6 +54,8 @@ from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import framework  # noqa: F401
 from . import hapi  # noqa: F401
+from . import profiler  # noqa: F401
+from . import inference  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from . import callbacks  # noqa: F401
 from .framework.io import save, load  # noqa: F401

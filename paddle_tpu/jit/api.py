@@ -104,7 +104,26 @@ class StaticFunction:
                     t._out_index = i
                 R.default_generator._key = old_key
 
+        self._pure = pure
         self._jitted = jax.jit(pure)
+
+    def export(self, example_args):
+        """jax.export the forward (state baked as inputs) to a serialized
+        StableHLO artifact — the jit.save deployment path (reference
+        jit/api.py save); returns the jax.export.Exported object."""
+        import jax.export
+        if self._jitted is None:
+            self._build()
+        state_vals = [t._value for _, t in self._state_items]
+        pure = self._pure
+
+        def fwd(state_vals, xs):
+            out, _ = pure(state_vals, jax.random.PRNGKey(0), tuple(xs), {})
+            return out
+
+        return jax.export.export(jax.jit(fwd))(
+            state_vals, [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                         for a in example_args])
 
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled[0]:

@@ -25,32 +25,33 @@ def save(layer, path, input_spec=None, **configs):
     meta = {"class": type(layer).__name__}
     payload = {"state": state, "meta": meta}
     stablehlo = None
+    input_meta = None
     if input_spec:
+        import warnings
+
+        import jax.numpy as jnp
+
+        from .api import StaticFunction
+        from ..core.dtype import convert_dtype
         try:
-            import jax
-            from .api import StaticFunction
-            sf = layer._static_function if hasattr(layer, "_static_function") \
+            sf = layer._static_function \
+                if hasattr(layer, "_static_function") \
                 else StaticFunction(layer)
-            import jax.numpy as jnp
-            from ..core.dtype import convert_dtype
-            examples = [Tensor(jnp.zeros([d if d is not None and d > 0 else 1
-                                          for d in spec.shape],
-                                         convert_dtype(spec.dtype)))
-                        for spec in input_spec]
-            sf._build()
-            state_objs = [t for _, t in sf._state_items]
-            state_vals = [t._value for t in state_objs]
-            import jax.export
-            def fwd(state_vals, xs):
-                out, _ = sf._jitted.__wrapped__(
-                    state_vals, jax.random.PRNGKey(0), tuple(xs), {})
-                return out
-            exported = jax.export.export(jax.jit(fwd))(
-                state_vals, [e._value for e in examples])
+            examples = [Tensor(jnp.zeros(
+                [d if d is not None and d > 0 else 1 for d in spec.shape],
+                convert_dtype(spec.dtype))) for spec in input_spec]
+            exported = sf.export(examples)
             stablehlo = exported.serialize()
-        except Exception:  # noqa: BLE001 - export best-effort
-            stablehlo = None
+            input_meta = [{"shape": list(spec.shape),
+                           "dtype": str(spec.dtype),
+                           "name": spec.name or f"x{i}"}
+                          for i, spec in enumerate(input_spec)]
+        except Exception as e:  # noqa: BLE001 — params still saved
+            warnings.warn(
+                f"StableHLO export failed ({type(e).__name__}: {e}); "
+                f"artifact carries params only", RuntimeWarning)
     payload["stablehlo"] = stablehlo
+    payload["input_meta"] = input_meta
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(payload, f, protocol=4)
 
@@ -61,6 +62,7 @@ class TranslatedLayer:
     def __init__(self, payload):
         self._state = payload["state"]
         self._stablehlo = payload.get("stablehlo")
+        self.input_meta = payload.get("input_meta")
         self._rebuilt = None
         if self._stablehlo is not None:
             import jax.export

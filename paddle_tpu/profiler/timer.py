@@ -1,0 +1,77 @@
+"""Throughput benchmark timer (reference: python/paddle/profiler/timer.py
+— Benchmark with reader/batch cost and ips, `benchmark()` singleton)."""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["Benchmark", "benchmark"]
+
+
+class _Stat:
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+        self.samples = 0
+
+    def add(self, dt, samples=None):
+        self.total += dt
+        self.count += 1
+        if samples:
+            self.samples += samples
+
+    @property
+    def avg(self):
+        return self.total / self.count if self.count else 0.0
+
+
+class Benchmark:
+    """reference timer.py Benchmark — step timing + ips.
+
+    b = profiler.benchmark(); b.begin()
+    for batch in loader: train(); b.step(len(batch))
+    print(b.report())
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._step_start = None
+        self._begin_time = None
+        self.batch_cost = _Stat()
+        self.speed_unit = "samples/s"
+
+    def begin(self):
+        self._begin_time = time.perf_counter()
+        self._step_start = self._begin_time
+
+    def step(self, num_samples: int | None = None):
+        now = time.perf_counter()
+        if self._step_start is not None:
+            self.batch_cost.add(now - self._step_start, num_samples)
+        self._step_start = now
+
+    def end(self):
+        self._step_start = None
+
+    def step_info(self, unit=None):
+        c = self.batch_cost
+        ips = (c.samples / c.total) if (c.total and c.samples) else 0.0
+        return (f"batch_cost: {c.avg:.5f} s, ips: {ips:.2f} "
+                f"{unit or self.speed_unit}")
+
+    def report(self):
+        c = self.batch_cost
+        return {"batch_cost_avg": c.avg,
+                "steps": c.count,
+                "ips": (c.samples / c.total)
+                if (c.total and c.samples) else 0.0}
+
+
+_BENCH = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    """reference timer.py benchmark() — global singleton."""
+    return _BENCH

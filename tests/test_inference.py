@@ -1,0 +1,65 @@
+"""Inference predictor tests (reference: test/legacy_test inference api
+tests — save with jit.save, load via Config/create_predictor, run)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference
+from paddle_tpu.jit import InputSpec
+
+
+def _net():
+    paddle.seed(5)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+class TestJitSaveLoad:
+    def test_save_load_compiled_artifact(self, tmp_path):
+        net = _net()
+        x = paddle.randn([2, 8])
+        want = np.asarray(net(x)._value)
+        path = str(tmp_path / "m")
+        paddle.jit.save(net, path, input_spec=[InputSpec([2, 8])])
+        loaded = paddle.jit.load(path)
+        got = np.asarray(loaded(x)._value)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_save_without_spec_keeps_params(self, tmp_path):
+        net = _net()
+        path = str(tmp_path / "m")
+        paddle.jit.save(net, path)
+        loaded = paddle.jit.load(path)
+        sd = loaded.state_dict()
+        assert set(sd) == set(net.state_dict())
+
+
+class TestPredictor:
+    def test_config_create_run(self, tmp_path):
+        net = _net()
+        x = np.random.RandomState(0).rand(2, 8).astype(np.float32)
+        want = np.asarray(net(paddle.to_tensor(x))._value)
+        path = str(tmp_path / "m")
+        paddle.jit.save(net, path, input_spec=[InputSpec([2, 8])])
+
+        config = inference.Config(path)
+        predictor = inference.create_predictor(config)
+        names = predictor.get_input_names()
+        assert names == ["x0"]
+        h = predictor.get_input_handle("x0")
+        h.copy_from_cpu(x)
+        outs = predictor.run()
+        np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-6)
+        # output handles
+        out_h = predictor.get_output_handle(predictor.get_output_names()[0])
+        np.testing.assert_allclose(out_h.copy_to_cpu(), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_run_direct_arrays(self, tmp_path):
+        net = _net()
+        path = str(tmp_path / "m")
+        paddle.jit.save(net, path, input_spec=[InputSpec([2, 8])])
+        predictor = inference.create_predictor(inference.Config(path))
+        x = np.random.rand(2, 8).astype(np.float32)
+        outs = predictor.run([x])
+        assert outs[0].shape == (2, 4)
