@@ -1,0 +1,17 @@
+"""paddle_tpu.audio — audio features (reference: python/paddle/audio/ —
+functional/functional.py hz_to_mel:22/compute_fbank_matrix:186/
+power_to_db:259/create_dct:303, features/layers.py Spectrogram:24,
+MelSpectrogram:106, LogMelSpectrogram:206, MFCC:309).
+
+TPU-native: the power spectrogram is framed windows × the real/imag DFT
+matrices (fft._dft_mats) — two MXU matmuls and a square-add, no complex
+dtype needed (the XLA TPU backend has neither FFT nor complex support)."""
+
+from . import functional  # noqa: F401
+from .features import (LogMelSpectrogram, MFCC, MelSpectrogram,  # noqa: F401
+                       Spectrogram)
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]
+
+from . import features  # noqa: F401,E402

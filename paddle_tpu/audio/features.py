@@ -1,0 +1,141 @@
+"""audio.features (reference: python/paddle/audio/features/layers.py —
+Spectrogram:24, MelSpectrogram:106, LogMelSpectrogram:206, MFCC:309).
+
+TPU-native spectrogram: frame → window → |DFT|² as two real matmuls
+(fft._dft_mats on the MXU) — the complex dtype never materializes."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..fft import _dft_mats
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frame(x, frame_length, hop_length, center=True, pad_mode="reflect"):
+    """[..., T] -> [..., n_frames, frame_length]."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(frame_length // 2,
+                                          frame_length // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    t = x.shape[-1]
+    n_frames = 1 + (t - frame_length) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    return jnp.take(x, idx, axis=-1)
+
+
+def _power_spectrogram(x, n_fft, hop_length, window, power, center,
+                       pad_mode="reflect"):
+    """Raw-array power spectrogram via DFT matmuls → [..., freq, time]."""
+    frames = _frame(x, n_fft, hop_length, center, pad_mode)  # [..., T', N]
+    frames = frames * window
+    wr, wi = _dft_mats(n_fft, inverse=False, dtype=frames.dtype)
+    m = n_fft // 2 + 1
+    re = frames @ wr[:, :m]
+    im = frames @ wi[:, :m]
+    mag2 = re * re + im * im                           # [..., T', m]
+    spec = jnp.swapaxes(mag2, -1, -2)                  # [..., m, T']
+    if power == 2.0:
+        return spec
+    return jnp.power(jnp.sqrt(jnp.maximum(spec, 1e-30)), power)
+
+
+class Spectrogram(nn.Layer):
+    """reference features/layers.py Spectrogram:24."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = {"reflect": "reflect", "constant": "constant",
+                         "replicate": "edge"}.get(pad_mode, pad_mode)
+        w = get_window(window, self.win_length, dtype=dtype)._value
+        if self.win_length < n_fft:  # zero-pad window to n_fft
+            pad = n_fft - self.win_length
+            w = jnp.pad(w, (pad // 2, pad - pad // 2))
+        self.window = w
+
+    def forward(self, x):
+        t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        return apply_op(
+            "spectrogram",
+            lambda xv: _power_spectrogram(xv, self.n_fft, self.hop_length,
+                                          self.window, self.power,
+                                          self.center, self.pad_mode),
+            (t,), {})
+
+
+class MelSpectrogram(nn.Layer):
+    """reference features/layers.py MelSpectrogram:106."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, n_mels=64,
+                 f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, dtype=dtype)
+        self.fbank = compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)._value
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+        fb = self.fbank
+        return apply_op("mel_spectrogram",
+                        lambda s: jnp.einsum("mf,...ft->...mt", fb, s),
+                        (spec,), {})
+
+
+class LogMelSpectrogram(nn.Layer):
+    """reference features/layers.py LogMelSpectrogram:206."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, n_mels=64,
+                 f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                   power, center, n_mels, f_min, f_max, htk,
+                                   norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return power_to_db(self._mel(x), self.ref_value, self.amin,
+                           self.top_db)
+
+
+class MFCC(nn.Layer):
+    """reference features/layers.py MFCC:309."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
+                 dtype="float32"):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                          window, power, center, n_mels,
+                                          f_min, f_max, htk, norm, ref_value,
+                                          amin, top_db, dtype)
+        self.dct = create_dct(n_mfcc, n_mels, dtype=dtype)._value
+
+    def forward(self, x):
+        mel = self._log_mel(x)
+        dct = self.dct
+        return apply_op("mfcc",
+                        lambda m: jnp.einsum("nk,...nt->...kt", dct, m),
+                        (mel,), {})

@@ -1,0 +1,128 @@
+"""audio.functional (reference: python/paddle/audio/functional/
+functional.py + window.py get_window)."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def hz_to_mel(freq, htk=False):
+    """reference functional.py hz_to_mel:22 (Slaney by default)."""
+    scalar = not hasattr(freq, "shape") and not isinstance(freq, Tensor)
+    f = _v(jnp.asarray(freq, jnp.float32))
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                              / min_log_hz) / logstep,
+                        mels)
+    return float(out) if scalar else Tensor(out)
+
+
+def mel_to_hz(mel, htk=False):
+    """reference functional.py mel_to_hz:78."""
+    scalar = not hasattr(mel, "shape") and not isinstance(mel, Tensor)
+    m = _v(jnp.asarray(mel, jnp.float32))
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                        freqs)
+    return float(out) if scalar else Tensor(out)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """reference functional.py mel_frequencies:123."""
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return Tensor(_v(mel_to_hz(Tensor(mels), htk)).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """reference functional.py fft_frequencies:163."""
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """reference functional.py compute_fbank_matrix:186 →
+    [n_mels, 1 + n_fft//2]."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = _v(fft_frequencies(sr, n_fft))
+    melfreqs = _v(mel_frequencies(n_mels + 2, f_min, f_max, htk))
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """reference functional.py power_to_db:259."""
+    s = _v(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """reference functional.py create_dct:303 → [n_mels, n_mfcc] DCT-II."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :]) * 2
+    if norm == "ortho":
+        dct = dct.at[:, 0].multiply(math.sqrt(1.0 / (4 * n_mels)))
+        dct = dct.at[:, 1:].multiply(math.sqrt(1.0 / (2 * n_mels)))
+    return Tensor(dct.astype(dtype))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """reference functional/window.py get_window — hann/hamming/blackman/
+    ones."""
+    n = win_length
+    x = jnp.arange(n, dtype=jnp.float32)
+    denom = n if fftbins else n - 1
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * x / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * x / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * x / denom)
+             + 0.08 * jnp.cos(4 * math.pi * x / denom))
+    elif window in ("ones", "rect", "boxcar"):
+        w = jnp.ones(n)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype(dtype))

@@ -1,0 +1,208 @@
+"""paddle_tpu.geometric — graph-NN primitives (reference:
+python/paddle/geometric/ — math.py segment_*, message_passing/send_recv.py
+send_u_recv:?, send_ue_recv, send_uv, reindex.py, sampling/neighbors.py).
+
+TPU-native: message passing is gather + jax segment reduction — XLA lowers
+segment_sum to one-hot matmuls / scatters that fuse, replacing the
+reference's hand-written graph_send_recv CUDA kernels."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import defop
+from ..core.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_min", "segment_max", "reindex_graph",
+           "sample_neighbors"]
+
+# module-global sampler RNG: stochastic ACROSS calls (a per-call fixed
+# seed would return the same neighbors every batch)
+_SAMPLE_RNG = np.random.default_rng()
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _num_segments(seg_val, num_segments, op_name):
+    if num_segments is not None:
+        return int(num_segments)
+    if isinstance(seg_val, jax.core.Tracer):
+        raise ValueError(
+            f"{op_name} under jit needs num_segments= (segment ids are "
+            f"traced, so the output size can't be derived from their max)")
+    return int(jnp.max(seg_val)) + 1 if seg_val.size else 0
+
+
+def _seg(name, jfn, fill=0.0):
+    @defop(name)
+    def _op(data, segment_ids, num_segments):
+        return jfn(data, segment_ids, num_segments=num_segments)
+
+    def api(data, segment_ids, num_segments=None, name=None):
+        data = _t(data)
+        seg = _t(segment_ids)
+        n = _num_segments(seg._value, num_segments, name)
+        return _op(data, seg._value.astype(jnp.int32), num_segments=n)
+    return api
+
+
+segment_sum = _seg("segment_sum", jax.ops.segment_sum)
+segment_min = _seg("segment_min", jax.ops.segment_min)
+segment_max = _seg("segment_max", jax.ops.segment_max)
+segment_sum.__doc__ = "reference geometric/math.py segment_sum:23."
+segment_min.__doc__ = "reference geometric/math.py segment_min:139."
+segment_max.__doc__ = "reference geometric/math.py segment_max:197."
+
+
+@defop("segment_mean")
+def _segment_mean(data, segment_ids, num_segments):
+    s = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                              segment_ids, num_segments=num_segments)
+    return s / jnp.maximum(cnt, 1.0).reshape(
+        (-1,) + (1,) * (data.ndim - 1))
+
+
+def segment_mean(data, segment_ids, num_segments=None, name=None):
+    """reference geometric/math.py segment_mean:80."""
+    data = _t(data)
+    seg = _t(segment_ids)
+    n = _num_segments(seg._value, num_segments, "segment_mean")
+    return _segment_mean(data, seg._value.astype(jnp.int32),
+                         num_segments=n)
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # handled via sum/count
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _reduce(msg, dst, n, pool):
+    if pool == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype),
+                                  dst, num_segments=n)
+        return s / jnp.maximum(cnt, 1.0).reshape(
+            (-1,) + (1,) * (msg.ndim - 1))
+    out = _REDUCERS[pool](msg, dst, num_segments=n)
+    if pool in ("max", "min"):
+        # untouched segments come back as the dtype's identity (±inf for
+        # floats, iinfo min/max for ints); reference zeroes them
+        if jnp.issubdtype(out.dtype, jnp.floating):
+            bad = ~jnp.isfinite(out)
+        else:
+            info = jnp.iinfo(out.dtype)
+            bad = out == (info.min if pool == "max" else info.max)
+        out = jnp.where(bad, jnp.zeros_like(out), out)
+    return out
+
+
+@defop("send_u_recv")
+def _send_u_recv(x, src, dst, pool_type, out_size):
+    msg = jnp.take(x, src, axis=0)
+    return _reduce(msg, dst, out_size, pool_type)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """reference message_passing/send_recv.py send_u_recv — gather source
+    features along edges, reduce at destinations."""
+    x = _t(x)
+    src = jnp.asarray(_t(src_index)._value, jnp.int32)
+    dst = jnp.asarray(_t(dst_index)._value, jnp.int32)
+    n = int(out_size) if out_size is not None else x.shape[0]
+    return _send_u_recv(x, src=src, dst=dst, pool_type=reduce_op.lower(),
+                        out_size=n)
+
+
+_MSG_OPS = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+@defop("send_ue_recv")
+def _send_ue_recv(x, e, src, dst, message_op, pool_type, out_size):
+    msg = _MSG_OPS[message_op](jnp.take(x, src, axis=0), e)
+    return _reduce(msg, dst, out_size, pool_type)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """reference send_ue_recv — combine source features with edge
+    features, reduce at destinations."""
+    x, y = _t(x), _t(y)
+    src = jnp.asarray(_t(src_index)._value, jnp.int32)
+    dst = jnp.asarray(_t(dst_index)._value, jnp.int32)
+    n = int(out_size) if out_size is not None else x.shape[0]
+    return _send_ue_recv(x, y, src=src, dst=dst,
+                         message_op=message_op.lower(),
+                         pool_type=reduce_op.lower(), out_size=n)
+
+
+@defop("send_uv")
+def _send_uv(x, y, src, dst, message_op):
+    return _MSG_OPS[message_op](jnp.take(x, src, axis=0),
+                                jnp.take(y, dst, axis=0))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """reference send_uv — per-edge message from (source, destination)."""
+    x, y = _t(x), _t(y)
+    src = jnp.asarray(_t(src_index)._value, jnp.int32)
+    dst = jnp.asarray(_t(dst_index)._value, jnp.int32)
+    return _send_uv(x, y, src=src, dst=dst, message_op=message_op.lower())
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """reference reindex.py reindex_graph — compact global ids to local:
+    returns (reindexed_src, reindexed_dst, out_nodes)."""
+    xs = np.asarray(_t(x)._value)
+    nbr = np.asarray(_t(neighbors)._value)
+    cnt = np.asarray(_t(count)._value)
+    uniq, inverse = np.unique(np.concatenate([xs, nbr]),
+                              return_inverse=True)
+    # out_nodes keep input-x order first, then new neighbor nodes
+    order = {int(v): i for i, v in enumerate(xs)}
+    extra = [int(v) for v in uniq if int(v) not in order]
+    for v in extra:
+        order[v] = len(order)
+    out_nodes = np.array(sorted(order, key=order.get), dtype=xs.dtype)
+    remap = {int(v): i for i, v in enumerate(out_nodes)}
+    src = np.array([remap[int(v)] for v in nbr], dtype=np.int64)
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)), \
+        Tensor(jnp.asarray(out_nodes))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """reference sampling/neighbors.py sample_neighbors — CSC neighbor
+    sampling on host (graph sampling is control-flow heavy; the reference
+    also runs it on CPU for GPU training via UVA)."""
+    row_np = np.asarray(_t(row)._value)
+    colptr_np = np.asarray(_t(colptr)._value)
+    nodes = np.asarray(_t(input_nodes)._value)
+    rng = _SAMPLE_RNG
+    out_nbr, out_cnt = [], []
+    for v in nodes:
+        lo, hi = int(colptr_np[int(v)]), int(colptr_np[int(v) + 1])
+        nbrs = row_np[lo:hi]
+        if 0 <= sample_size < len(nbrs):
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
+        out_nbr.append(nbrs)
+        out_cnt.append(len(nbrs))
+    neighbors = np.concatenate(out_nbr) if out_nbr else np.array([],
+                                                                 row_np.dtype)
+    counts = np.array(out_cnt, np.int32)
+    return Tensor(jnp.asarray(neighbors)), Tensor(jnp.asarray(counts))

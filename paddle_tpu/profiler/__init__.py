@@ -137,8 +137,10 @@ def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
     def handler(prof):
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"host_{os.getpid()}"
+        handler._serial = getattr(handler, "_serial", 0) + 1
         path = os.path.join(
-            dir_name, f"{name}_time_{int(time.time())}.paddle_trace.json")
+            dir_name, f"{name}_time_{int(time.time())}_"
+            f"{handler._serial}.paddle_trace.json")
         prof._export_chrome(path)
         return path
 
@@ -167,8 +169,12 @@ class Profiler:
             self._scheduler = lambda step: ProfilerState.RECORD
         elif isinstance(scheduler, (tuple, list)):
             start, end = scheduler
+            # the window's last step must be RECORD_AND_RETURN so
+            # on_trace_ready fires when the window closes (reference maps
+            # the tuple form the same way)
             self._scheduler = lambda step: (
-                ProfilerState.RECORD if start <= step < end
+                ProfilerState.RECORD_AND_RETURN if step == end - 1
+                else ProfilerState.RECORD if start <= step < end
                 else ProfilerState.CLOSED)
         else:
             self._scheduler = scheduler
@@ -217,6 +223,9 @@ class Profiler:
         if prev == ProfilerState.RECORD_AND_RETURN \
                 and self._on_trace_ready is not None:
             self._on_trace_ready(self)
+            # each window exports its own events only
+            self._spans = []
+            self._op_counts = {}
         if self.state in recording and not _BUFFER.enabled:
             self._enable()
         elif self.state not in recording and _BUFFER.enabled:

@@ -1,0 +1,230 @@
+"""paddle_tpu.quantization — QAT / PTQ (reference:
+python/paddle/quantization/ — config.py QuantConfig:60, qat.py QAT,
+ptq.py PTQ, observers/ (AbsmaxObserver), quanters/
+(FakeQuanterWithAbsMaxObserver), wrapper.py quanted layer wrapping).
+
+TPU-native: fake-quantization is a pure jnp round-trip with a
+straight-through-estimator custom vjp — one fused XLA kernel per site —
+and bf16/int8 simulation stays on the MXU-friendly dense path."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
+           "FakeQuanterWithAbsMaxObserver", "quant", "dequant",
+           "QuantedLinear"]
+
+
+# ---------------------------------------------------------------------------
+# fake-quant core: STE (reference quanters/abs_max.py forward/backward)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fake_quant(x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+
+
+def _fq_fwd(x, scale, bits):
+    return _fake_quant(x, scale, bits), (x, scale)
+
+
+def _fq_bwd(bits, res, g):
+    x, scale = res
+    # straight-through inside the clip range (reference fake_quant bwd)
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    inside = jnp.abs(x / s * qmax) <= qmax
+    return (jnp.where(inside, g, 0.0), jnp.zeros_like(scale))
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quant(x, scale, bits=8):
+    """Simulated quantize-dequantize with STE gradients."""
+    t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    sc = scale if isinstance(scale, Tensor) else Tensor(jnp.asarray(scale))
+    return apply_op("fake_quant",
+                    lambda xv, sv: _fake_quant(xv, sv, bits), (t, sc), {})
+
+
+def dequant(x, scale, bits=8):
+    qmax = float(2 ** (bits - 1) - 1)
+    t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    return Tensor(t._value * jnp.asarray(scale) / qmax)
+
+
+# ---------------------------------------------------------------------------
+# observers / quanters (reference observers/abs_max.py, quanters/abs_max.py)
+# ---------------------------------------------------------------------------
+class AbsmaxObserver:
+    """reference observers/abs_max.py AbsmaxObserver — running abs-max."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._max = 0.0
+
+    def observe(self, x):
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        if isinstance(v, jax.core.Tracer):
+            raise RuntimeError(
+                "AbsmaxObserver.observe needs concrete values — run "
+                "calibration eagerly, then jit the converted model")
+        self._max = max(self._max, float(jnp.max(jnp.abs(v))))
+        return self._max
+
+    def scale(self):
+        return self._max
+
+    def _instance(self, layer):
+        return AbsmaxObserver(self.quant_bits)
+
+
+class FakeQuanterWithAbsMaxObserver:
+    """reference quanters/abs_max.py — moving-average abs-max fake
+    quantizer applied during QAT."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8):
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self._scale = None
+
+    def _instance(self, layer):
+        return FakeQuanterWithAbsMaxObserver(self.moving_rate,
+                                             self.bit_length)
+
+    def __call__(self, x):
+        t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        if isinstance(t._value, jax.core.Tracer):
+            # under jit/to_static tracing: compute the scale in-graph
+            # (dynamic abs-max) — float() on a tracer would crash, and the
+            # moving average is an eager-mode statistic
+            bits = self.bit_length
+            return apply_op(
+                "fake_quant_dyn",
+                lambda xv: _fake_quant(
+                    xv, jnp.max(jnp.abs(xv)), bits), (t,), {})
+        cur = float(jnp.max(jnp.abs(t._value)))
+        if self._scale is None:
+            self._scale = cur
+        else:
+            r = self.moving_rate
+            self._scale = r * self._scale + (1 - r) * cur
+        return quant(t, self._scale or 1e-8, self.bit_length)
+
+
+# ---------------------------------------------------------------------------
+# config (reference config.py QuantConfig:60)
+# ---------------------------------------------------------------------------
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        # per-type quanter config (reference SingleLayerConfig map);
+        # only nn.Linear has a quanted wrapper so far
+        self._type_configs = {nn.Linear: (activation, weight)}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            if t is not nn.Linear:
+                raise NotImplementedError(
+                    f"quantization wrapper for {t.__name__} not "
+                    f"implemented (Linear only)")
+            self._type_configs[t] = (activation or self.activation,
+                                     weight or self.weight)
+
+
+class QuantedLinear(nn.Layer):
+    """reference wrapper.py quanted layer: fake-quant weight (+activation)
+    around the float matmul."""
+
+    def __init__(self, layer: nn.Linear, config: QuantConfig):
+        super().__init__()
+        self._inner = layer
+        self.weight = layer.weight
+        self.bias = layer.bias
+        act, wt = config._type_configs.get(
+            type(layer), (config.activation, config.weight))
+        self.activation_quanter = act._instance(layer) if act else None
+        self.weight_quanter = wt._instance(layer) if wt else None
+
+    def forward(self, x):
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        from ..nn import functional as F
+        return F.linear(x, w, self.bias)
+
+
+def _wrap_layers(model, config):
+    for name, child in list(model._sub_layers.items()):
+        if type(child) in config._type_configs:
+            model._sub_layers[name] = QuantedLinear(child, config)
+        else:
+            _wrap_layers(child, config)
+    return model
+
+
+class QAT:
+    """reference qat.py QAT — quantize() wraps target layers with fake
+    quanters; train as usual; convert() re-materializes float weights from
+    their quantized form."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        return _wrap_layers(model, self.config)
+
+    def convert(self, model, inplace=False):
+        """Bake fake-quant into the weights (deploy-form float sim)."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, QuantedLinear) \
+                    and layer.weight_quanter is not None:
+                q = layer.weight_quanter(layer.weight)
+                layer.weight._in_place_update(q._value)
+                layer.weight_quanter = None
+        return model
+
+
+class PTQ:
+    """reference ptq.py PTQ — observe activations on calibration data,
+    then convert with fixed scales."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        model = _wrap_layers(model, self.config)
+        # PTQ: weight scales fixed immediately; activation quanters observe
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, QuantedLinear):
+                if layer.weight_quanter is not None:
+                    layer.weight_quanter(layer.weight)  # set scale now
+        return model
+
+    def convert(self, model, inplace=False):
+        return QAT(self.config).convert(model, inplace)

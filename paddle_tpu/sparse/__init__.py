@@ -1,0 +1,278 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors (reference:
+python/paddle/sparse/ — creation.py sparse_coo_tensor/sparse_csr_tensor,
+unary.py, binary.py, nn/; C++ phi/core/sparse_coo_tensor.h).
+
+TPU-native: backed by jax.experimental.sparse BCOO/BCSR — XLA lowers
+sparse matmuls to gather/segment-sum programs. SparseTensor mirrors the
+dense Tensor surface where the reference does (indices/values/to_dense,
+elementwise ops, matmul)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from . import nn  # noqa: F401
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "is_same_shape", "add", "subtract",
+           "multiply", "divide", "matmul", "masked_matmul", "relu", "tanh",
+           "sqrt", "sin", "abs", "pow", "neg", "coalesce", "transpose",
+           "nn"]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference sparse_coo_tensor.h / Python surface:
+    Tensor.is_sparse_coo, .indices(), .values(), .to_dense())."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._m = bcoo
+
+    # -- reference API ------------------------------------------------------
+    def indices(self):
+        return Tensor(self._m.indices.T)            # [sparse_dim, nnz]
+
+    def values(self):
+        return Tensor(self._m.data)
+
+    def to_dense(self):
+        return Tensor(self._m.todense())
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._m))
+
+    def coalesce(self):
+        return SparseCooTensor(self._m.sum_duplicates())
+
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def dtype(self):
+        return self._m.dtype
+
+    @property
+    def nnz(self):
+        return int(self._m.nse)
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def transpose(self, perm):
+        return SparseCooTensor(self._m.transpose(tuple(perm)))
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __add__(self, other):
+        return add(self, other)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (reference sparse_csr_tensor.h)."""
+
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._m = bcsr
+
+    def crows(self):
+        return Tensor(self._m.indptr)
+
+    def cols(self):
+        return Tensor(self._m.indices)
+
+    def values(self):
+        return Tensor(self._m.data)
+
+    def to_dense(self):
+        return Tensor(self._m.todense())
+
+    def to_sparse_coo(self, sparse_dim=2):
+        return SparseCooTensor(self._m.to_bcoo())
+
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def dtype(self):
+        return self._m.dtype
+
+    @property
+    def nnz(self):
+        return int(self._m.nse)
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+# ---------------------------------------------------------------------------
+# creation (reference sparse/creation.py)
+# ---------------------------------------------------------------------------
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """reference creation.py sparse_coo_tensor(indices[sparse_dim, nnz])."""
+    idx = jnp.asarray(_v(indices)).T                # -> [nnz, sparse_dim]
+    val = jnp.asarray(_v(values))
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        val = val.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=0)) + val.shape[1:]
+    return SparseCooTensor(jsparse.BCOO((val, idx.astype(jnp.int32)),
+                                        shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """reference creation.py sparse_csr_tensor."""
+    val = jnp.asarray(_v(values))
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        val = val.astype(convert_dtype(dtype))
+    return SparseCsrTensor(jsparse.BCSR(
+        (val, jnp.asarray(_v(cols), jnp.int32),
+         jnp.asarray(_v(crows), jnp.int32)), shape=tuple(shape)))
+
+
+def _dense_to_coo(x, sparse_dim=None):
+    a = _v(x)
+    n_batch = 0
+    n_dense = 0 if sparse_dim is None else a.ndim - sparse_dim
+    return SparseCooTensor(jsparse.BCOO.fromdense(a, n_dense=n_dense))
+
+
+def _dense_to_csr(x):
+    return SparseCsrTensor(jsparse.BCSR.fromdense(_v(x)))
+
+
+# Tensor conversion methods (reference Tensor.to_sparse_coo/_csr)
+Tensor.to_sparse_coo = lambda self, sparse_dim=None: _dense_to_coo(
+    self, sparse_dim)
+Tensor.to_sparse_csr = lambda self: _dense_to_csr(self)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+# ---------------------------------------------------------------------------
+# unary (reference sparse/unary.py — applied to stored values only)
+# ---------------------------------------------------------------------------
+def _unary(name, fn):
+    def api(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            m = x._m
+            return SparseCooTensor(
+                jsparse.BCOO((fn(m.data), m.indices), shape=m.shape))
+        if isinstance(x, SparseCsrTensor):
+            m = x._m
+            return SparseCsrTensor(
+                jsparse.BCSR((fn(m.data), m.indices, m.indptr),
+                             shape=m.shape))
+        return Tensor(fn(_v(x)))
+    api.__name__ = name
+    api.__doc__ = f"reference sparse/unary.py {name} (values-only)."
+    return api
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+sin = _unary("sin", jnp.sin)
+abs = _unary("abs", jnp.abs)  # noqa: A001 — paddle name
+neg = _unary("neg", jnp.negative)
+
+
+def pow(x, factor, name=None):  # noqa: A001 — paddle name
+    """reference sparse/unary.py pow."""
+    return _unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def coalesce(x, name=None):
+    """reference sparse/unary.py coalesce — merge duplicate indices."""
+    return x.coalesce()
+
+
+def transpose(x, perm, name=None):
+    """reference sparse/unary.py transpose."""
+    return x.transpose(perm)
+
+
+# ---------------------------------------------------------------------------
+# binary (reference sparse/binary.py)
+# ---------------------------------------------------------------------------
+def _coo_elementwise(name, fn):
+    def api(x, y, name=None):
+        xd = x.to_dense()._value if isinstance(
+            x, (SparseCooTensor, SparseCsrTensor)) else _v(x)
+        yd = y.to_dense()._value if isinstance(
+            y, (SparseCooTensor, SparseCsrTensor)) else _v(y)
+        out = fn(xd, yd)
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(jsparse.BCOO.fromdense(out))
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(jsparse.BCSR.fromdense(out))
+        return Tensor(out)
+    api.__name__ = name
+    api.__doc__ = (f"reference sparse/binary.py {name} (densify-compute-"
+                   f"resparsify; XLA fuses the round trip)")
+    return api
+
+
+add = _coo_elementwise("add", jnp.add)
+subtract = _coo_elementwise("subtract", jnp.subtract)
+multiply = _coo_elementwise("multiply", jnp.multiply)
+divide = _coo_elementwise("divide", jnp.divide)
+
+
+def matmul(x, y, name=None):
+    """reference sparse/binary.py matmul — sparse @ dense → dense (the
+    BCOO/BCSR matmul XLA lowers to gather+segment-sum)."""
+    ym = y._m if isinstance(y, (SparseCooTensor, SparseCsrTensor)) \
+        else _v(y)
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        out = x._m @ ym
+    else:
+        out = _v(x) @ ym
+    if isinstance(out, (jsparse.BCOO, jsparse.BCSR)):
+        return (SparseCooTensor(out) if isinstance(out, jsparse.BCOO)
+                else SparseCsrTensor(out))
+    return Tensor(out)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """reference sparse/binary.py masked_matmul — dense@dense evaluated
+    only at mask's nonzero positions (SDDMM)."""
+    xd, yd = _v(x), _v(y)
+    m = mask._m if isinstance(mask, SparseCooTensor) else mask
+    idx = m.indices                                  # [nnz, 2]
+    rows = jnp.take(xd, idx[:, 0], axis=0)          # [nnz, k]
+    cols = jnp.take(yd.T, idx[:, 1], axis=0)        # [nnz, k]
+    vals = jnp.sum(rows * cols, axis=-1)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=m.shape))

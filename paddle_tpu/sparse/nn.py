@@ -1,0 +1,37 @@
+"""paddle_tpu.sparse.nn (reference: python/paddle/sparse/nn/ — activation
+layers + sparse conv; the layer surface over sparse.unary ops)."""
+
+from __future__ import annotations
+
+__all__ = ["ReLU", "Softmax"]
+
+
+class ReLU:
+    """reference sparse/nn/layer/activation.py ReLU."""
+
+    def __call__(self, x):
+        from . import relu
+        return relu(x)
+
+
+class Softmax:
+    """reference sparse/nn/layer/activation.py Softmax — softmax over the
+    stored values per row (CSR semantics)."""
+
+    def __init__(self, axis=-1):
+        self.axis = axis
+
+    def __call__(self, x):
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+        from . import SparseCsrTensor
+        if isinstance(x, SparseCsrTensor):
+            m = x._m
+            dense = m.todense()
+            mask = dense != 0
+            shifted = jnp.where(mask, dense, -jnp.inf)
+            sm = jnp.exp(shifted - shifted.max(-1, keepdims=True))
+            sm = jnp.where(mask, sm, 0.0)
+            sm = sm / jnp.maximum(sm.sum(-1, keepdims=True), 1e-38)
+            return SparseCsrTensor(jsparse.BCSR.fromdense(sm))
+        raise TypeError("sparse.nn.Softmax expects a SparseCsrTensor")

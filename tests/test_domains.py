@@ -1,0 +1,239 @@
+"""sparse / geometric / quantization tests (reference: test/legacy_test
+sparse+geometric op tests; test/quantization/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import geometric, quantization, sparse
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestSparse:
+    def _coo(self):
+        dense = np.array([[0, 1.5, 0], [2.0, 0, 0], [0, 0, -3.0]],
+                         np.float32)
+        idx = np.array([[0, 1, 2], [1, 0, 2]])
+        val = np.array([1.5, 2.0, -3.0], np.float32)
+        return dense, sparse.sparse_coo_tensor(idx, val, [3, 3])
+
+    def test_coo_roundtrip(self):
+        dense, s = self._coo()
+        assert s.is_sparse_coo() and s.nnz == 3
+        np.testing.assert_allclose(_np(s.to_dense()), dense)
+        np.testing.assert_allclose(_np(s.values()), [1.5, 2.0, -3.0])
+        assert _np(s.indices()).shape == (2, 3)
+
+    def test_dense_to_sparse_methods(self):
+        dense, _ = self._coo()
+        t = paddle.to_tensor(dense)
+        coo = t.to_sparse_coo(2)
+        assert coo.nnz == 3
+        csr = t.to_sparse_csr()
+        assert csr.is_sparse_csr()
+        np.testing.assert_allclose(_np(csr.to_dense()), dense)
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(_np(back.to_dense()), dense)
+
+    def test_csr_accessors(self):
+        dense = np.array([[1, 0, 2], [0, 0, 3]], np.float32)
+        csr = paddle.to_tensor(dense).to_sparse_csr()
+        np.testing.assert_array_equal(_np(csr.crows()), [0, 2, 3])
+        np.testing.assert_array_equal(_np(csr.cols()), [0, 2, 2])
+        np.testing.assert_allclose(_np(csr.values()), [1, 2, 3])
+
+    def test_unary_binary(self):
+        dense, s = self._coo()
+        out = sparse.relu(s)
+        np.testing.assert_allclose(_np(out.to_dense()),
+                                   np.maximum(dense, 0))
+        total = sparse.add(s, s)
+        np.testing.assert_allclose(_np(total.to_dense()), 2 * dense)
+
+    def test_matmul(self):
+        dense, s = self._coo()
+        y = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        out = sparse.matmul(s, paddle.to_tensor(y))
+        np.testing.assert_allclose(_np(out), dense @ y, rtol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(3, 5).astype(np.float32)
+        y = rng.rand(5, 3).astype(np.float32)
+        _, mask = self._coo()
+        out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   mask)
+        full = x @ y
+        want = np.where(_np(mask.to_dense()) != 0, full, 0)
+        np.testing.assert_allclose(_np(out.to_dense()), want, rtol=1e-5)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                         np.float32))
+        seg = np.array([0, 0, 1])
+        np.testing.assert_allclose(_np(geometric.segment_sum(data, seg)),
+                                   [[4, 6], [5, 6]])
+        np.testing.assert_allclose(_np(geometric.segment_mean(data, seg)),
+                                   [[2, 3], [5, 6]])
+        np.testing.assert_allclose(_np(geometric.segment_max(data, seg)),
+                                   [[3, 4], [5, 6]])
+        np.testing.assert_allclose(_np(geometric.segment_min(data, seg)),
+                                   [[1, 2], [5, 6]])
+
+    def test_send_u_recv(self):
+        x = paddle.to_tensor(np.array([[1.], [2.], [4.]], np.float32))
+        src = np.array([0, 1, 2, 0])
+        dst = np.array([1, 2, 1, 0])
+        out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        # dst0 <- x[0]; dst1 <- x[0]+x[2]; dst2 <- x[1]
+        np.testing.assert_allclose(_np(out), [[1.], [5.], [2.]])
+        out = geometric.send_u_recv(x, src, dst, reduce_op="max")
+        np.testing.assert_allclose(_np(out), [[1.], [4.], [2.]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = paddle.to_tensor(np.array([[1.], [2.]], np.float32))
+        e = paddle.to_tensor(np.array([[10.], [20.]], np.float32))
+        src = np.array([0, 1])
+        dst = np.array([1, 0])
+        out = geometric.send_ue_recv(x, e, src, dst, "add", "sum")
+        np.testing.assert_allclose(_np(out), [[22.], [11.]])
+        uv = geometric.send_uv(x, x, src, dst, "mul")
+        np.testing.assert_allclose(_np(uv), [[2.], [2.]])
+
+    def test_send_u_recv_grad(self):
+        x = paddle.to_tensor(np.array([[1.], [2.], [4.]], np.float32))
+        x.stop_gradient = False
+        out = geometric.send_u_recv(x, np.array([0, 0, 1]),
+                                    np.array([1, 2, 0]), "sum")
+        out.sum().backward()
+        np.testing.assert_allclose(_np(x.grad), [[2.], [1.], [0.]])
+
+    def test_reindex_graph(self):
+        x = np.array([5, 9])
+        neighbors = np.array([9, 7, 5, 7])
+        count = np.array([2, 2])
+        src, dst, nodes = geometric.reindex_graph(x, neighbors, count)
+        np.testing.assert_array_equal(_np(nodes), [5, 9, 7])
+        np.testing.assert_array_equal(_np(src), [1, 2, 0, 2])
+        np.testing.assert_array_equal(_np(dst), [0, 0, 1, 1])
+
+    def test_sample_neighbors(self):
+        # CSC: node0 -> {1,2}, node1 -> {2}, node2 -> {}
+        row = np.array([1, 2, 2])
+        colptr = np.array([0, 2, 3, 3])
+        nbr, cnt = geometric.sample_neighbors(row, colptr, np.array([0, 1]))
+        np.testing.assert_array_equal(_np(cnt), [2, 1])
+        assert set(_np(nbr)[:2]) == {1, 2}
+
+
+class TestQuantization:
+    def test_fake_quant_roundtrip_and_ste(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 9).astype(np.float32))
+        x.stop_gradient = False
+        q = quantization.quant(x, 1.0, bits=8)
+        err = np.abs(_np(q) - _np(x)).max()
+        assert err <= 1.0 / 127 + 1e-6
+        q.sum().backward()
+        np.testing.assert_allclose(_np(x.grad), np.ones(9))  # STE
+
+    def test_qat_wraps_and_trains(self):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+        cfg = quantization.QuantConfig(
+            activation=quantization.FakeQuanterWithAbsMaxObserver(),
+            weight=quantization.FakeQuanterWithAbsMaxObserver())
+        qat = quantization.QAT(cfg)
+        qnet = qat.quantize(net, inplace=False)
+        assert isinstance(qnet[0], quantization.QuantedLinear)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=qnet.parameters())
+        x = paddle.randn([16, 8])
+        y = paddle.to_tensor(np.random.randint(0, 2, (16,)))
+        l0 = None
+        for _ in range(5):
+            loss = nn.functional.cross_entropy(qnet(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            l0 = l0 or float(loss)
+        assert float(loss) < l0
+        converted = qat.convert(qnet, inplace=False)
+        out = converted(x)
+        assert np.all(np.isfinite(_np(out)))
+
+    def test_ptq_observes(self):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(4, 2))
+        cfg = quantization.QuantConfig(
+            activation=quantization.FakeQuanterWithAbsMaxObserver(),
+            weight=quantization.FakeQuanterWithAbsMaxObserver())
+        ptq = quantization.PTQ(cfg)
+        qnet = ptq.quantize(net, inplace=False)
+        for _ in range(3):
+            qnet(paddle.randn([8, 4]))  # calibration
+        final = ptq.convert(qnet, inplace=False)
+        out = final(paddle.randn([8, 4]))
+        assert np.all(np.isfinite(_np(out)))
+
+    def test_observer(self):
+        obs = quantization.AbsmaxObserver()
+        obs.observe(paddle.to_tensor([1.0, -3.0]))
+        obs.observe(paddle.to_tensor([2.0]))
+        assert obs.scale() == 3.0
+
+
+class TestAudio:
+    def test_spectrogram_matches_numpy_stft(self):
+        from paddle_tpu import audio
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 1024).astype(np.float32)
+        spec = audio.Spectrogram(n_fft=256, hop_length=128)(
+            paddle.to_tensor(x))
+        got = _np(spec)[0]
+        # numpy reference STFT (hann, centered, power 2)
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(256) / 256)
+        xp = np.pad(x[0], 128, mode="reflect")
+        frames = np.stack([xp[i * 128:i * 128 + 256] * w
+                           for i in range(1 + (len(xp) - 256) // 128)])
+        want = np.abs(np.fft.rfft(frames, axis=-1)) ** 2
+        np.testing.assert_allclose(got, want.T, rtol=1e-3, atol=1e-3)
+
+    def test_mel_and_mfcc_shapes(self):
+        from paddle_tpu import audio
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 2048).astype(np.float32))
+        mel = audio.MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert _np(mel).shape[:2] == (2, 40)
+        logmel = audio.LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert np.all(np.isfinite(_np(logmel)))
+        mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+        assert _np(mfcc).shape[:2] == (2, 13)
+
+    def test_functional_parity(self):
+        from paddle_tpu.audio import functional as AF
+        # librosa-documented fixed points of the slaney scale
+        assert abs(AF.hz_to_mel(1000.0) - 15.0) < 1e-4
+        assert abs(AF.mel_to_hz(15.0) - 1000.0) < 1e-2
+        assert abs(AF.hz_to_mel(AF.mel_to_hz(27.3)) - 27.3) < 1e-3
+        fb = _np(AF.compute_fbank_matrix(16000, 512, n_mels=40))
+        assert fb.shape == (40, 257) and fb.min() >= 0
+        dct = _np(AF.create_dct(13, 40))
+        assert dct.shape == (40, 13)
+        # DCT-II ortho columns are orthonormal
+        np.testing.assert_allclose(dct.T @ dct, np.eye(13), atol=1e-4)
+
+    def test_spectrogram_grad(self):
+        from paddle_tpu import audio
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(1, 512).astype(np.float32))
+        x.stop_gradient = False
+        spec = audio.Spectrogram(n_fft=128, hop_length=64)(x)
+        spec.sum().backward()
+        assert x.grad is not None
+        assert np.all(np.isfinite(_np(x.grad)))
