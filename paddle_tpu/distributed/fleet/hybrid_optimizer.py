@@ -16,12 +16,26 @@ __all__ = ["HybridParallelOptimizer", "HybridParallelClipGrad"]
 
 
 class HybridParallelClipGrad(ClipGradByGlobalNorm):
-    """reference hybrid_parallel_optimizer.py:43."""
+    """reference hybrid_parallel_optimizer.py:43 — the global norm must
+    cover every model shard. Compiled path: grads are global GSPMD arrays,
+    so the plain norm is already global. Eager multi-process path: the
+    squared norm is allreduced across processes before the sqrt (the
+    reference's allreduce chain over mp/pp/sharding groups collapses to
+    one world reduce because each process owns a disjoint shard)."""
 
     def __init__(self, clip, hcg=None):
         clip_norm = clip.clip_norm if hasattr(clip, "clip_norm") else float(clip)
         super().__init__(clip_norm)
         self._hcg = hcg
+
+    # NOTE: no cross-process allreduce here. In this framework model
+    # parallelism lives inside compiled GSPMD programs where grads are
+    # GLOBAL arrays, and eager multi-process grads are replicated (synced
+    # by DataParallel hooks) — in both cases the local norm already IS the
+    # global norm; summing squared norms across processes would inflate it
+    # by sqrt(world). The reference's per-axis allreduce chain exists
+    # because its processes hold disjoint shards, which ours never do
+    # eagerly.
 
 
 class HybridParallelOptimizer:

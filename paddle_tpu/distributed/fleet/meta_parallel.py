@@ -47,12 +47,27 @@ class MetaParallelBase(nn.Layer):
 class DataParallelModel(MetaParallelBase):
     """DP: params replicated; grads averaged by GSPMD when the batch is
     'dp'-sharded (reference EagerReducer bucketing — deleted, XLA fuses the
-    reduction)."""
+    reduction). Eager multi-process: params broadcast from rank 0 at wrap
+    (reference broadcast_dp_parameters) and per-grad allreduce hooks sync
+    backward."""
+
+    def _prepare_for_model(self):
+        from ..env import get_world_size
+        if get_world_size() > 1:
+            from ..parallel import DataParallel
+            # DataParallel broadcasts params from rank 0 and registers the
+            # per-grad allreduce hooks (EagerReducer analogue)
+            self._ddp = DataParallel(self._layers)
 
 
 class TensorParallel(MetaParallelBase):
-    """reference meta_parallel/tensor_parallel.py — params already
-    annotated by mp_layers."""
+    """reference meta_parallel/tensor_parallel.py — params already carry
+    'mp' dist specs from mp_layers; wrap-time work is the same broadcast
+    the reference does (identical replicated init on every rank)."""
+
+    def _prepare_for_model(self):
+        from .utils import broadcast_mp_parameters
+        broadcast_mp_parameters(self._layers, self._hcg)
 
 
 class ShardingParallel(MetaParallelBase):

@@ -51,44 +51,113 @@ class SwitchGate(NaiveGate):
         super().__init__(d_model, num_expert, world_size, topk)
 
 
-@defop("moe_dispatch")
-def _dispatch(x, logits, num_experts, capacity, top_k):
-    """tokens [N, d], logits [N, E] -> (expert_inputs [E, C, d],
-    combine_weights [N, E, C], aux_loss). Dense Switch/GShard-style dispatch."""
-    N, d = x.shape
+def moe_slots(logits, num_experts, capacity, top_k):
+    """Slot metadata only — top_k on RAW logits (softmax is monotonic, so
+    indices match) to keep the eager pre-pass cheap. Returns slot [N, k]
+    int: flat position in the [E*C] buffer, E*C meaning 'dropped'."""
+    _, topi = jax.lax.top_k(logits, top_k)
+    n = logits.shape[0]
+    flat_e = topi.reshape(-1)
+    onehot = jax.nn.one_hot(flat_e, num_experts, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) - onehot
+    pos_in_expert = jnp.take_along_axis(
+        pos, flat_e[:, None], axis=1)[:, 0].reshape(n, top_k)
+    keep = pos_in_expert < capacity
+    return jnp.where(keep, topi * capacity + pos_in_expert,
+                     num_experts * capacity)
+
+
+def moe_route(logits, num_experts, capacity, top_k):
+    """Routing decisions on raw arrays: top-k + capacity, sort-free
+    metadata. Returns (topi [N,k] int, gates [N,k] f32 normalized over
+    kept slots, slot [N,k] int flat position in the [E*C] buffer with C
+    meaning 'dropped', aux_loss scalar)."""
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    topv, topi = jax.lax.top_k(probs, top_k)            # [N, k]
-    # position of each token within its expert's buffer, per k-choice
-    onehot = jax.nn.one_hot(topi, num_experts, dtype=jnp.float32)  # [N,k,E]
-    # priority: earlier tokens first; cumsum over tokens per expert
-    pos_in_expert = (jnp.cumsum(onehot.sum(1), axis=0) - onehot.sum(1))  # [N,E]
-    keep = pos_in_expert < capacity                                     # [N,E]
-    disp = onehot * keep[:, None, :]                    # [N,k,E]
-    gates = topv[..., None] * disp                      # [N,k,E]
-    denom = gates.sum(axis=(1, 2), keepdims=True)
-    gates = gates / jnp.maximum(denom, 1e-9)
-    pos = jnp.einsum("nke,ne->nke", disp, pos_in_expert)  # clipped positions
-    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                            dtype=jnp.float32) * disp[..., None]  # [N,k,E,C]
-    combine = jnp.einsum("nke,nkec->nec", gates, pos_oh)  # [N,E,C]
-    dispatch_mask = (combine > 0).astype(x.dtype)
-    expert_inputs = jnp.einsum("nec,nd->ecd", dispatch_mask, x)
+    topv, topi = jax.lax.top_k(probs, top_k)                  # [N, k]
+    n = probs.shape[0]
+    # arrival-order position of each (token, choice) within its expert:
+    # for the flattened [N*k] routing stream (token-major so earlier
+    # tokens win capacity, matching the reference's priority), count
+    # prior assignments to the same expert with a cumsum over one-hots
+    flat_e = topi.reshape(-1)                                  # [N*k]
+    onehot = jax.nn.one_hot(flat_e, num_experts,
+                           dtype=jnp.int32)                  # [N*k, E]
+    pos = jnp.cumsum(onehot, axis=0) - onehot                  # prior count
+    pos_in_expert = jnp.take_along_axis(
+        pos, flat_e[:, None], axis=1)[:, 0].reshape(n, top_k)  # [N, k]
+    keep = pos_in_expert < capacity
+    gates = jnp.where(keep, topv, 0.0)
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    slot = jnp.where(keep, topi * capacity + pos_in_expert,
+                     num_experts * capacity)                   # drop slot
     # GShard aux loss: mean_prob * mean_assignment per expert
     me = probs.mean(axis=0)
-    ce = onehot.sum(1).mean(axis=0)
+    ce = jax.nn.one_hot(topi, num_experts, dtype=jnp.float32).sum(1).mean(0)
     aux = (me * ce).sum() * num_experts
-    return expert_inputs, combine.astype(x.dtype), aux.astype(x.dtype)
+    return topi, gates, slot, aux
+
+
+def moe_permute(x, slot, num_experts, capacity):
+    """Scatter tokens into the [E*C(+1 drop row), d] expert buffer —
+    O(N·k·d) scatter instead of the dense [N, E, C] one-hot matmul
+    (VERDICT weak #7: the dense combine is a 0.5G-element intermediate at
+    Mixtral scale)."""
+    n, d = x.shape
+    k = slot.shape[1]
+    buf = jnp.zeros((num_experts * capacity + 1, d), x.dtype)
+    flat_slot = slot.reshape(-1)
+    tokens = jnp.repeat(x, k, axis=0) if k > 1 else x
+    buf = buf.at[flat_slot].add(tokens)                 # dup sends add once
+    return buf[:num_experts * capacity].reshape(num_experts, capacity, d)
+
+
+def moe_unpermute(expert_out, slot, gates, n_tokens):
+    """Gather each (token, choice)'s expert output and gate-combine:
+    [E, C, d] -> [N, d]."""
+    e, c, d = expert_out.shape
+    flat = jnp.concatenate(
+        [expert_out.reshape(e * c, d),
+         jnp.zeros((1, d), expert_out.dtype)])           # drop row reads 0
+    picked = jnp.take(flat, slot.reshape(-1), axis=0)    # [N*k, d]
+    k = slot.shape[1]
+    picked = picked.reshape(n_tokens, k, d)
+    return jnp.sum(picked * gates[..., None].astype(picked.dtype), axis=1)
+
+
+@defop("moe_dispatch")
+def _dispatch(x, logits, slot, num_experts, capacity, top_k):
+    """tokens [N, d], logits [N, E], slot metadata -> (expert_inputs
+    [E, C, d], gates [N, k], aux loss). Sort/scatter dispatch (no
+    [N, E, C] dense intermediate). ``slot`` is int routing metadata passed
+    as a closed-over raw array — integer outputs/primals would poison the
+    vjp with float0 cotangents."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, top_k)
+    keep = slot < num_experts * capacity
+    gates = jnp.where(keep, topv, 0.0)
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    expert_inputs = moe_permute(x, slot, num_experts, capacity)
+    me = probs.mean(axis=0)
+    ce = jax.nn.one_hot(topi, num_experts, dtype=jnp.float32).sum(1).mean(0)
+    aux = (me * ce).sum() * num_experts
+    return expert_inputs, gates.astype(x.dtype), aux.astype(x.dtype)
 
 
 @defop("moe_combine")
-def _combine(expert_outputs, combine_weights):
-    # expert_outputs [E, C, d], combine [N, E, C] -> [N, d]
-    return jnp.einsum("ecd,nec->nd", expert_outputs, combine_weights)
+def _combine(expert_outputs, gates, slot):
+    n = slot.shape[0]
+    return moe_unpermute(expert_outputs, slot, gates, n)
 
 
 def moe_dispatch_combine(x, logits, num_experts, capacity, top_k):
-    return _dispatch(x, logits, num_experts=num_experts, capacity=capacity,
-                     top_k=top_k)
+    """Returns (expert_in, gates, slot_raw, aux). slot is a raw int array
+    (routing metadata, not a differentiable Tensor)."""
+    lv = logits._value if isinstance(logits, Tensor) else jnp.asarray(logits)
+    slot = moe_slots(lv, num_experts, capacity, top_k)
+    expert_in, gates, aux = _dispatch(
+        x, logits, slot=slot, num_experts=num_experts, capacity=capacity,
+        top_k=top_k)
+    return expert_in, gates, slot, aux
 
 
 class MoELayer(nn.Layer):
@@ -123,7 +192,7 @@ class MoELayer(nn.Layer):
         capacity = max(1, int(self.capacity_factor * n_tokens
                               * self.top_k / self.num_experts))
         logits = self.gate(x2)
-        expert_in, combine, aux = moe_dispatch_combine(
+        expert_in, gates, slot, aux = moe_dispatch_combine(
             x2, logits, self.num_experts, capacity, self.top_k)
         # shard expert dim over 'ep' (all-to-all inserted by GSPMD)
         expert_in = shard_hint(expert_in, "ep", None, None)
@@ -133,6 +202,6 @@ class MoELayer(nn.Layer):
         from ...ops.manipulation import stack
         expert_out = stack(outs, axis=0)
         expert_out = shard_hint(expert_out, "ep", None, None)
-        y = _combine(expert_out, combine)
+        y = _combine(expert_out, gates, slot=slot)
         self.l_aux = aux
         return reshape(y, orig_shape)

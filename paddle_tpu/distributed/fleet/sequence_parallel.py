@@ -55,8 +55,12 @@ def is_sequence_parallel_parameter(parameter):
 
 def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
                                                fuse_sequence_parallel_allreduce=False):
-    """reference :190 — no-op under GSPMD (grad reduction compiled in);
-    kept for recipe compatibility."""
+    """reference :190 — in the reference, SP-region LN/bias params hold
+    disjoint per-rank grads that need an mp-group allreduce. Here model
+    parallelism lives inside compiled GSPMD programs (grads are global
+    arrays) and eager multi-process params are replicated with DP-hook
+    syncing — there is no process-level mp shard to reduce over, so this
+    is a true no-op kept for recipe compatibility."""
     return model
 
 

@@ -25,15 +25,25 @@ def broadcast_dp_parameters(model, hcg):
 
 
 def broadcast_mp_parameters(model, hcg):
-    return None
-
-
-def broadcast_sharding_parameters(model, hcg):
-    return None
+    """reference hybrid_parallel_util.py — identical init on every rank of
+    the mp group (params here are full global arrays per process)."""
+    if get_world_size() > 1:
+        for p in model.parameters():
+            broadcast(p, src=0)
 
 
 def broadcast_sep_parameters(model, hcg):
-    return None
+    """reference hybrid_parallel_util.py:275."""
+    if get_world_size() > 1:
+        for p in model.parameters():
+            broadcast(p, src=0)
+
+
+def broadcast_sharding_parameters(model, hcg):
+    """reference hybrid_parallel_util.py:265."""
+    if get_world_size() > 1:
+        for p in model.parameters():
+            broadcast(p, src=0)
 
 
 def fused_allreduce_gradients(parameter_list, hcg):

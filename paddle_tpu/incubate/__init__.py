@@ -1,0 +1,6 @@
+"""paddle_tpu.incubate (reference: python/paddle/incubate/ — fused LLM
+ops under nn/functional, MoE models, extra optimizers)."""
+
+from . import nn  # noqa: F401
+
+__all__ = ["nn"]

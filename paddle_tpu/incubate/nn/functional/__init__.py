@@ -1,0 +1,252 @@
+"""paddle_tpu.incubate.nn.functional — fused LLM ops (reference:
+python/paddle/incubate/nn/functional/ — fused_rms_norm, fused_layer_norm,
+fused_rotary_position_embedding, swiglu, fused_linear,
+masked_multihead_attention; CUDA kernels in phi/kernels/fusion/gpu/).
+
+TPU-native: each "fused op" is one pure-jnp function — XLA fuses it into
+a single kernel (the hand-fused CUDA kernels' job); the same raw
+functions power the flagship llama path, so the public surface and the
+model share numerics."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply_op
+from ....core.tensor import Tensor
+
+__all__ = ["fused_rms_norm", "fused_layer_norm",
+           "fused_rotary_position_embedding", "swiglu", "fused_linear",
+           "fused_bias_act", "masked_multihead_attention",
+           "memory_efficient_attention"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+# -- raw kernels (shared with models.llama) ---------------------------------
+def rms_norm_raw(x, w, eps):
+    """reference fused_rms_norm_kernel: fp32 accumulation, native-dtype
+    output (llama _rms uses this)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def rope_raw(x, cos, sin, neox=True):
+    """Rope on [..., d] given broadcastable cos/sin[..., d/2] (reference
+    fused_rotary_position_embedding kernel). neox=True rotates halves
+    (llama); neox=False rotates interleaved even/odd pairs (GPT-J)."""
+    xf = x.astype(jnp.float32)
+    if neox:
+        x1, x2 = jnp.split(xf, 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                              axis=-1)
+    else:
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        out = jnp.stack([r1, r2], axis=-1).reshape(xf.shape)
+    return out.astype(x.dtype)
+
+
+# -- public surface ---------------------------------------------------------
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-5,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kwargs):
+    """reference incubate/nn/functional/fused_rms_norm.py — returns
+    (out, residual_out) when residual is given, else out."""
+    xt = _t(x)
+    args = [xt, _t(norm_weight)]
+    has_nbias = norm_bias is not None
+    has_bias = bias is not None
+    has_res = residual is not None
+    if has_nbias:
+        args.append(_t(norm_bias))
+    if has_bias:
+        args.append(_t(bias))
+    if has_res:
+        args.append(_t(residual))
+
+    def f(xv, w, *rest):
+        i = 0
+        nb = rest[i] if has_nbias else None
+        i += int(has_nbias)
+        b = rest[i] if has_bias else None
+        i += int(has_bias)
+        res = rest[i] if has_res else None
+        if b is not None:          # pre-norm linear-bias add (reference)
+            xv = xv + b
+        if res is not None:
+            xv = xv + res
+        out = rms_norm_raw(xv, w, epsilon)
+        if nb is not None:
+            out = out + nb
+        if res is not None:
+            return out, xv
+        return out
+
+    return apply_op("fused_rms_norm", f, tuple(args), {})
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None,
+                     **kwargs):
+    """reference incubate fused_layer_norm.py."""
+    xt = _t(x)
+    args = [xt, _t(norm_weight), _t(norm_bias)]
+    has_bias = bias is not None
+    has_res = residual is not None
+    if has_bias:
+        args.append(_t(bias))
+    if has_res:
+        args.append(_t(residual))
+
+    def f(xv, w, b, *rest):
+        i = 0
+        lb = rest[i] if has_bias else None
+        i += int(has_bias)
+        res = rest[i] if has_res else None
+        if lb is not None:         # pre-norm linear-bias add (reference)
+            xv = xv + lb
+        if res is not None:
+            xv = xv + res
+        xf = xv.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        out = ((xf - mu) * jax.lax.rsqrt(var + epsilon)).astype(
+            xv.dtype) * w + b
+        if res is not None:
+            return out, xv
+        return out
+
+    return apply_op("fused_layer_norm", f, tuple(args), {})
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """reference incubate fused_rotary_position_embedding.py — applies
+    rope to q (and k; v passes through untouched per kernel semantics).
+    q/k: [b, s, h, d]; sin/cos: [1, s, 1, d] (full-d interleaved halves)
+    or [1, s, 1, d/2]."""
+    outs = []
+    qt = _t(q)
+    s = qt.shape[1]
+    d = qt.shape[-1]
+    if cos is None or sin is None:
+        # default llama-style table over positions; position_ids may be
+        # [s] or batched [b, s]
+        half = d // 2
+        if position_ids is None:
+            pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.float32)[None],
+                                   (1, s))
+        else:
+            pos = jnp.asarray(_t(position_ids)._value, jnp.float32)
+            if pos.ndim == 1:
+                pos = pos[None, :]
+        freqs = 1.0 / (10000.0 ** (
+            jnp.arange(0, half, dtype=jnp.float32) / half))
+        ang = pos[..., None] * freqs                    # [b, s, half]
+        cos_v = jnp.cos(ang)[:, :, None, :]
+        sin_v = jnp.sin(ang)[:, :, None, :]
+    else:
+        cos_v = jnp.asarray(_t(cos)._value)
+        sin_v = jnp.asarray(_t(sin)._value)
+        if cos_v.shape[-1] == d:       # full-width tables: take the halves
+            cos_v = cos_v[..., :d // 2]
+            sin_v = sin_v[..., :d // 2]
+
+    def f(xv):
+        return rope_raw(xv, cos_v, sin_v, neox=use_neox_rotary_style)
+
+    for x in (q, k):
+        if x is None:
+            outs.append(None)
+        else:
+            outs.append(apply_op("fused_rope", f, (_t(x),), {}))
+    outs.append(_t(v) if v is not None else None)
+    return tuple(outs)
+
+
+def swiglu(x, y=None, name=None):
+    """reference incubate swiglu: silu(x) * y (y defaults to the second
+    half of x)."""
+    if y is None:
+        xt = _t(x)
+        return apply_op(
+            "swiglu",
+            lambda xv: jax.nn.silu(jnp.split(xv, 2, -1)[0])
+            * jnp.split(xv, 2, -1)[1], (xt,), {})
+    return apply_op("swiglu",
+                    lambda xv, yv: jax.nn.silu(xv) * yv,
+                    (_t(x), _t(y)), {})
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """reference incubate fused_linear (gemm+bias epilogue — XLA fuses)."""
+    from ....nn import functional as F
+    w = _t(weight)
+    if transpose_weight:
+        from ....ops.manipulation import transpose
+        w = transpose(w, [1, 0])
+    return F.linear(_t(x), w, _t(bias) if bias is not None else None)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kwargs):
+    """reference incubate fused_bias_act.py."""
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+            "silu": jax.nn.silu, "swiglu": None}
+    if act_method == "swiglu":
+        def f(xv, *rest):
+            if rest:
+                xv = xv + rest[0]
+            a, b = jnp.split(xv, 2, -1)
+            return jax.nn.silu(a) * b
+    else:
+        act = acts[act_method]
+
+        def f(xv, *rest):
+            if rest:
+                xv = xv + rest[0]
+            return act(xv)
+    args = (_t(x),) + ((_t(bias),) if bias is not None else ())
+    return apply_op("fused_bias_act", f, args, {})
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               seq_len=1, rotary_emb_dims=0, **kwargs):
+    """reference incubate masked_multihead_attention.py — single-token
+    decode attention against a [2, b, h, cache_len, d] KV cache; returns
+    (out, updated_cache)."""
+    xt = _t(x)
+    cache = _t(cache_kv)
+
+    def f(xv, ck):
+        b = xv.shape[0]
+        h = ck.shape[2]
+        d = ck.shape[-1]
+        qkv = xv.reshape(b, 3, h, d)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [b, h, d]
+        ks = jnp.concatenate([ck[0], k[:, :, None, :]], axis=2)
+        vs = jnp.concatenate([ck[1], v[:, :, None, :]], axis=2)
+        s = jnp.einsum("bhd,bhtd->bht", q, ks) / jnp.sqrt(
+            jnp.asarray(d, jnp.float32))
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bht,bhtd->bhd", p, vs)
+        return out.reshape(b, h * d), jnp.stack([ks, vs])
+
+    return apply_op("masked_multihead_attention", f, (xt, cache), {})
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """reference incubate/nn/memory_efficient_attention.py — maps to the
+    flash/SDPA path."""
+    from ....nn.functional.attention import scaled_dot_product_attention
+    return scaled_dot_product_attention(_t(query), _t(key), _t(value),
+                                        dropout_p=p, training=training)

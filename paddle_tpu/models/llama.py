@@ -100,22 +100,21 @@ LLAMA_PRESETS = {
 
 
 def _rope(x, positions, theta, head_dim):
-    """Rotary embedding on [b, s, h, d] (reference
-    fused_rotary_position_embedding, incubate/nn/functional)."""
+    """Rotary embedding on [b, s, h, d] — same kernel as the public
+    incubate.nn.functional.fused_rotary_position_embedding."""
+    from ..incubate.nn.functional import rope_raw
     half = head_dim // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     angles = positions[:, :, None].astype(jnp.float32) * freqs  # [b, s, half]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype)
+    return rope_raw(x, cos, sin)
 
 
 def _rms(x, w, eps):
-    xf = x.astype(jnp.float32)
-    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+    """Same kernel as the public incubate fused_rms_norm."""
+    from ..incubate.nn.functional import rms_norm_raw
+    return rms_norm_raw(x, w, eps)
 
 
 def _attention(q, k, v, causal=True):
@@ -181,34 +180,27 @@ def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint):
 
 def _moe_mlp(cfg: LlamaConfig, lp: dict, y, mesh_hint):
     """Expert-parallel SwiGLU MoE (BASELINE config 5; reference
-    moe_layer.py:263 semantics, dense-dispatch formulation — expert dim
-    sharded over 'ep', all-to-all inserted by GSPMD)."""
+    moe_layer.py:263 semantics). Sort/scatter dispatch — tokens scatter
+    into the [E, C, d] buffer and gather back by slot, no [N, E, C] dense
+    intermediate (0.5G elements at Mixtral scale); the expert dim shards
+    over 'ep' so GSPMD inserts the all-to-all."""
+    from ..distributed.fleet.moe import (moe_permute, moe_route,
+                                         moe_unpermute)
     b, s, d = y.shape
     E = cfg.num_experts
     tokens = y.reshape(b * s, d)
     logits = tokens @ lp["router"]
     capacity = max(1, int(cfg.moe_capacity_factor * b * s
                           * cfg.num_experts_per_tok / E))
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    topv, topi = jax.lax.top_k(probs, cfg.num_experts_per_tok)
-    onehot = jax.nn.one_hot(topi, E, dtype=jnp.float32)
-    pos_in_expert = jnp.cumsum(onehot.sum(1), axis=0) - onehot.sum(1)
-    keep = pos_in_expert < capacity
-    disp = onehot * keep[:, None, :]
-    gates = topv[..., None] * disp
-    gates = gates / jnp.maximum(gates.sum(axis=(1, 2), keepdims=True), 1e-9)
-    pos = jnp.einsum("nke,ne->nke", disp, pos_in_expert)
-    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                            dtype=jnp.float32) * disp[..., None]
-    combine = jnp.einsum("nke,nkec->nec", gates, pos_oh).astype(y.dtype)
-    dispatch_mask = (combine > 0).astype(y.dtype)
-    expert_in = jnp.einsum("nec,nd->ecd", dispatch_mask, tokens)
+    _, gates, slot, aux = moe_route(logits, E, capacity,
+                                    cfg.num_experts_per_tok)
+    expert_in = moe_permute(tokens, slot, E, capacity)
     expert_in = mesh_hint(expert_in, ("ep", None, None))
     gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["we_gate"]))
     up = jnp.einsum("ecd,edf->ecf", expert_in, lp["we_up"])
     expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["we_down"])
     expert_out = mesh_hint(expert_out, ("ep", None, None))
-    out = jnp.einsum("ecd,nec->nd", expert_out, combine)
+    out = moe_unpermute(expert_out, slot, gates, b * s).astype(y.dtype)
     return out.reshape(b, s, d)
 
 

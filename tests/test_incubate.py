@@ -1,0 +1,135 @@
+"""Incubate fused-op tests (reference: test/legacy_test/
+test_fused_rotary_position_embedding.py, test_rms_norm_op.py, swiglu)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import functional as IF
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestFusedOps:
+    def test_fused_rms_norm_matches_reference_math(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 5, 8).astype(np.float32)
+        w = rng.rand(8).astype(np.float32)
+        out = IF.fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(w),
+                                epsilon=1e-5)
+        want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-5) * w
+        np.testing.assert_allclose(_np(out), want, rtol=1e-5)
+
+    def test_fused_rms_norm_residual(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 8).astype(np.float32)
+        r = rng.randn(2, 8).astype(np.float32)
+        w = np.ones(8, np.float32)
+        out, res_out = IF.fused_rms_norm(
+            paddle.to_tensor(x), paddle.to_tensor(w),
+            residual=paddle.to_tensor(r))
+        np.testing.assert_allclose(_np(res_out), x + r, rtol=1e-6)
+        s = x + r
+        want = s / np.sqrt((s ** 2).mean(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(_np(out), want, rtol=1e-5)
+
+    def test_fused_layer_norm(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 8).astype(np.float32)
+        w = rng.rand(8).astype(np.float32)
+        b = rng.rand(8).astype(np.float32)
+        out = IF.fused_layer_norm(paddle.to_tensor(x), paddle.to_tensor(w),
+                                  paddle.to_tensor(b))
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        want = (x - mu) / np.sqrt(var + 1e-5) * w + b
+        np.testing.assert_allclose(_np(out), want, rtol=1e-4, atol=1e-5)
+
+    def test_fused_rope_matches_llama_kernel(self):
+        """The public op and the flagship's private path share numerics."""
+        from paddle_tpu.models.llama import _rope
+        rng = np.random.RandomState(2)
+        b, s, h, d = 2, 6, 4, 8
+        q = rng.randn(b, s, h, d).astype(np.float32)
+        pos = np.broadcast_to(np.arange(s)[None], (b, s))
+        want = _rope(q, pos, 10000.0, d)
+        qo, ko, vo = IF.fused_rotary_position_embedding(
+            paddle.to_tensor(q), paddle.to_tensor(q))
+        np.testing.assert_allclose(_np(qo), np.asarray(want), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(_np(ko), np.asarray(want), rtol=1e-5,
+                                   atol=1e-6)
+        assert vo is None
+
+    def test_swiglu(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(4, 6).astype(np.float32)
+        y = rng.randn(4, 6).astype(np.float32)
+        out = IF.swiglu(paddle.to_tensor(x), paddle.to_tensor(y))
+        sil = x / (1 + np.exp(-x))
+        np.testing.assert_allclose(_np(out), sil * y, rtol=1e-5)
+        out2 = IF.swiglu(paddle.to_tensor(np.concatenate([x, y], -1)))
+        np.testing.assert_allclose(_np(out2), sil * y, rtol=1e-5)
+
+    def test_masked_multihead_attention_decode(self):
+        rng = np.random.RandomState(4)
+        b, h, d, t = 2, 3, 4, 5
+        x = rng.randn(b, 3 * h * d).astype(np.float32)
+        cache = rng.randn(2, b, h, t, d).astype(np.float32)
+        out, new_cache = IF.masked_multihead_attention(
+            paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache))
+        assert _np(out).shape == (b, h * d)
+        assert _np(new_cache).shape == (2, b, h, t + 1, d)
+        # reference math for one (b,h)
+        qkv = x.reshape(b, 3, h, d)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        ks = np.concatenate([cache[0], k[:, :, None]], axis=2)
+        vs = np.concatenate([cache[1], v[:, :, None]], axis=2)
+        s = np.einsum("bhd,bhtd->bht", q, ks) / np.sqrt(d)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("bht,bhtd->bhd", p, vs).reshape(b, h * d)
+        np.testing.assert_allclose(_np(out), want, rtol=1e-4, atol=1e-5)
+
+    def test_grads_flow(self):
+        x = paddle.to_tensor(np.random.RandomState(5)
+                             .randn(2, 8).astype(np.float32))
+        x.stop_gradient = False
+        w = paddle.to_tensor(np.ones(8, np.float32))
+        w.stop_gradient = False
+        out = IF.fused_rms_norm(x, w)
+        out.sum().backward()
+        assert x.grad is not None and w.grad is not None
+
+
+class TestFleetWrappers:
+    def test_hybrid_clip_applies_global_norm(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet.hybrid_optimizer import (
+            HybridParallelClipGrad, HybridParallelOptimizer)
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(
+            learning_rate=1.0, parameters=net.parameters(),
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(0.5))
+        hopt = HybridParallelOptimizer(opt)
+        assert isinstance(opt._grad_clip, HybridParallelClipGrad)
+        x = paddle.randn([8, 4])
+        loss = (net(x) ** 2).sum() * 100  # big grads
+        loss.backward()
+        hopt.step()
+        # after clip the applied update magnitude is bounded
+        hopt.clear_grad()
+
+    def test_meta_parallel_wrappers_forward(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            DataParallelModel, SegmentParallel, TensorParallel)
+        net = nn.Linear(4, 2)
+        x = paddle.randn([4, 4])
+        want = _np(net(x))
+        for cls in (DataParallelModel, TensorParallel):
+            np.testing.assert_allclose(_np(cls(net)(x)), want, rtol=1e-6)
+        sp = SegmentParallel(net)
+        np.testing.assert_allclose(_np(sp(x)), want, rtol=1e-6)
