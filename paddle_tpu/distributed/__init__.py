@@ -29,6 +29,7 @@ from .parallelize import parallelize, DistTrainStep, shard_model_state  # noqa: 
 from . import fcollectives  # noqa: F401
 from . import communication  # noqa: F401
 from . import launch  # noqa: F401
+from . import auto_tuner  # noqa: F401
 from . import fleet  # noqa: F401
 from .fleet.sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .auto_parallel import shard_layer, shard_optimizer, to_static_dist  # noqa: F401

@@ -1,0 +1,154 @@
+"""Hybrid-parallel auto-tuner (reference: python/paddle/distributed/
+auto_tuner/ — tuner.py AutoTuner:19 (search_once/add_cfg), search.py
+GridSearch, prune.py divisibility/memory pruning, recorder.py history).
+
+Searches over dp/mp/pp/sharding degrees + micro-batch for a fixed world
+size; candidates are pruned by the reference's feasibility rules
+(degrees multiply to world size, mp divides heads/hidden, pp divides
+layers, batch divisible by dp*micro-batch)."""
+
+from __future__ import annotations
+
+import itertools
+
+__all__ = ["AutoTuner", "GridSearch", "default_candidates", "prune_cfg",
+           "Recorder"]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(tuner_cfg):
+    """reference utils.py default_candidates — per-dim value lists."""
+    world = int(tuner_cfg.get("world_size", 8))
+    cand = {
+        "dp_degree": tuner_cfg.get("dp_degree") or _divisors(world),
+        "mp_degree": tuner_cfg.get("mp_degree") or _divisors(world),
+        "pp_degree": tuner_cfg.get("pp_degree") or _divisors(world),
+        "sharding_degree": tuner_cfg.get("sharding_degree")
+        or _divisors(world),
+        "sharding_stage": tuner_cfg.get("sharding_stage") or [1, 2, 3],
+        "micro_batch_size": tuner_cfg.get("micro_batch_size") or
+        [1, 2, 4, 8],
+        "use_recompute": tuner_cfg.get("use_recompute") or [True, False],
+    }
+    return cand
+
+
+def prune_cfg(cfg, tuner_cfg):
+    """reference prune.py — False if infeasible."""
+    world = int(tuner_cfg.get("world_size", 8))
+    model = tuner_cfg.get("model_cfg", {})
+    dp, mp, pp = cfg["dp_degree"], cfg["mp_degree"], cfg["pp_degree"]
+    sh = cfg["sharding_degree"]
+    if dp * mp * pp * sh != world:
+        return False
+    heads = model.get("num_attention_heads")
+    if heads and heads % mp != 0:
+        return False
+    hidden = model.get("hidden_size")
+    if hidden and hidden % mp != 0:
+        return False
+    layers = model.get("num_layers")
+    if layers and layers % pp != 0:
+        return False
+    gbs = model.get("global_batch_size")
+    if gbs:
+        mbs = cfg["micro_batch_size"]
+        if gbs % (dp * sh * mbs) != 0:
+            return False
+    if cfg["sharding_stage"] > 1 and sh == 1:
+        return False                      # stage >1 needs a sharding axis
+    return True
+
+
+class GridSearch:
+    """reference search.py GridSearch — exhaustive over the pruned
+    cartesian product."""
+
+    def __init__(self, tuner_cfg):
+        self.tuner_cfg = tuner_cfg
+        cand = tuner_cfg["candidates"]
+        keys = list(cand)
+        combos = []
+        for values in itertools.product(*[cand[k] for k in keys]):
+            cfg = dict(zip(keys, values))
+            if prune_cfg(cfg, tuner_cfg):
+                combos.append(cfg)
+        self.all_tasks = combos
+        self.idx = 0
+
+    def search_once(self, history_cfgs):
+        # self.idx advances monotonically, so previously returned configs
+        # are never revisited — no history membership scan needed
+        if self.idx < len(self.all_tasks):
+            cfg = self.all_tasks[self.idx]
+            self.idx += 1
+            return cfg
+        return None
+
+
+class Recorder:
+    """reference recorder.py — history + best lookup."""
+
+    def __init__(self, metric="time", mode="min"):
+        self.metric = metric
+        self.mode = mode
+        self.history = []
+
+    def add_cfg(self, cfg, metric_value=None, error=None):
+        self.history.append({"cfg": cfg, self.metric: metric_value,
+                             "error": error})
+
+    def get_best(self):
+        ok = [h for h in self.history
+              if h.get("error") is None and h.get(self.metric) is not None]
+        if not ok:
+            return None
+        pick = min if self.mode == "min" else max
+        return pick(ok, key=lambda h: h[self.metric])
+
+
+class AutoTuner:
+    """reference tuner.py:19 — search_once()/add_cfg() protocol, plus a
+    convenience tune(runner) loop: runner(cfg) -> metric (raise on OOM /
+    failure; the config is recorded as errored and skipped)."""
+
+    def __init__(self, tuner_cfg):
+        self.cur_task_id = 1
+        self.task_limit = tuner_cfg.get("task_limit", 100)
+        tuner_cfg.setdefault("candidates", default_candidates(tuner_cfg))
+        self.algo = GridSearch(tuner_cfg)
+        self.recorder = Recorder(
+            metric=tuner_cfg.get("metric", "time"),
+            mode=tuner_cfg.get("mode", "min"))
+
+    def search_once(self):
+        """reference :54 — next candidate config or None."""
+        if self.cur_task_id > self.task_limit:
+            return None
+        cfg = self.algo.search_once(self.history_cfgs)
+        if cfg is not None:
+            self.cur_task_id += 1
+        return cfg
+
+    def add_cfg(self, cfg, metric_value=None, error=None):
+        self.recorder.add_cfg(cfg, metric_value, error)
+
+    @property
+    def history_cfgs(self):
+        return self.recorder.history
+
+    def tune(self, runner):
+        """Run the whole search; returns the best history entry."""
+        while True:
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            try:
+                metric = runner(cfg)
+                self.add_cfg(cfg, metric_value=metric)
+            except Exception as e:  # noqa: BLE001 — infeasible trial
+                self.add_cfg(cfg, error=str(e))
+        return self.recorder.get_best()

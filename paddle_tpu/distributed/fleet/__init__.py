@@ -37,3 +37,5 @@ __all__ = [
     "get_rng_state_tracker", "HybridParallelOptimizer", "LayerDesc",
     "PipelineLayer", "recompute", "group_sharded_parallel", "MoELayer",
 ]
+
+from . import elastic  # noqa: F401,E402

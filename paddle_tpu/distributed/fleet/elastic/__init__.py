@@ -1,0 +1,207 @@
+"""Elastic training / fault tolerance (reference:
+python/paddle/distributed/fleet/elastic/manager.py — ElasticManager:126,
+ElasticStatus:48, ELASTIC_EXIT_CODE=101, etcd TTL leases ELASTIC_TTL=60,
+watch:122/598 membership, rank re-map + relaunch).
+
+TPU-native: membership is TTL heartbeats in a shared KV store — the
+jax.distributed coordinator KV when a multi-process runtime is up, else a
+file-backed store (NFS/GCS-path friendly) so single-host tests and
+launch-CLI pods work without etcd. On membership change the watcher
+reports HOLD/RESTART and the launcher relaunches workers with rewritten
+rank env (exit code 101, same contract as the reference)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+
+__all__ = ["ElasticManager", "ElasticStatus", "LauncherInterface",
+           "ELASTIC_EXIT_CODE", "ELASTIC_AUTO_PARALLEL_EXIT_CODE",
+           "FileKVStore"]
+
+ELASTIC_EXIT_CODE = 101
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+ELASTIC_TTL = int(os.environ.get("ELASTIC_TTL", 60))
+
+
+class ElasticStatus:
+    """reference manager.py:48."""
+
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class FileKVStore:
+    """TTL-lease store over a shared directory (the etcd analogue for
+    single-host pods / shared filesystems)."""
+
+    def __init__(self, root):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key):
+        return os.path.join(self.root, key.replace("/", "_") + ".json")
+
+    def put(self, key, value, ttl=None):
+        payload = {"value": value, "ts": time.time(), "ttl": ttl,
+                   "key": key}
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self._path(key))
+
+    def get(self, key):
+        try:
+            with open(self._path(key)) as f:
+                payload = json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+        ttl = payload.get("ttl")
+        if ttl and time.time() - payload["ts"] > ttl:
+            return None                       # lease expired
+        return payload["value"]
+
+    def keys(self, prefix=""):
+        """Live (non-expired) keys, returned UN-mangled — any other store
+        implementation must also return keys verbatim."""
+        out = []
+        for name in os.listdir(self.root):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    payload = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            key = payload.get("key", name[:-len(".json")])
+            if key.startswith(prefix) and self.get(key) is not None:
+                out.append(key)
+        return sorted(out)
+
+    def delete(self, key):
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+
+class LauncherInterface:
+    """reference elastic __init__.py LauncherInterface — the process group
+    the manager relaunches."""
+
+    def __init__(self, args=None):
+        self.args = args
+        self.procs = []
+
+    def _terminate_procs(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.2)
+            if p.poll() is None:
+                p.kill()
+
+    def launch(self):
+        raise NotImplementedError
+
+    def stop(self):
+        self._terminate_procs()
+
+    def watch(self):
+        """Returns an exit code when all procs finished, else None."""
+        codes = [p.poll() for p in self.procs]
+        if any(c not in (None, 0) for c in codes):
+            return next(c for c in codes if c not in (None, 0))
+        if codes and all(c == 0 for c in codes):
+            return 0
+        return None
+
+
+class ElasticManager:
+    """reference manager.py:126 — np == current node count; scale events
+    flip the job to RESTART with rewritten rank env."""
+
+    def __init__(self, args=None, store=None, host=None, np=None,
+                 heartbeat_interval=None):
+        self.args = args
+        self.store = store or FileKVStore(
+            os.environ.get("PADDLE_ELASTIC_STORE_DIR",
+                           os.path.join("/tmp", "paddle_elastic")))
+        self.host = host or os.environ.get(
+            "PADDLE_ELASTIC_HOST",
+            f"{os.environ.get('HOSTNAME', 'local')}-{os.getpid()}")
+        self.np = int(np if np is not None
+                      else os.environ.get("PADDLE_ELASTIC_NP", "1"))
+        self.job_id = os.environ.get("PADDLE_ELASTIC_JOB_ID", "default")
+        self.ttl = heartbeat_interval or ELASTIC_TTL
+        self.enable = self.np > 0
+        self._stopped = False
+        self._last_members: list[str] = []
+
+    # -- membership ---------------------------------------------------------
+    def _key(self):
+        return f"{self.job_id}/nodes/{self.host}"
+
+    def register(self):
+        """Register this node with a TTL lease (reference register :210)."""
+        self.store.put(self._key(), {"host": self.host,
+                                     "time": time.time()}, ttl=self.ttl)
+
+    def heartbeat(self):
+        self.register()
+
+    def members(self):
+        prefix = f"{self.job_id}/nodes/"
+        return [k[len(prefix):] for k in self.store.keys(prefix)]
+
+    def exact_mode(self):
+        return len(self.members()) == self.np
+
+    # -- watching -----------------------------------------------------------
+    def watch(self, launcher: LauncherInterface | None = None):
+        """One watch tick (reference watch:598): returns an ElasticStatus.
+        Membership growth/shrink → RESTART; stable full membership → HOLD
+        (keep running); launcher exit → COMPLETED/ERROR."""
+        if self._stopped:
+            return ElasticStatus.EXIT
+        self.heartbeat()
+        if launcher is not None:
+            rc = launcher.watch()
+            if rc == 0:
+                return ElasticStatus.COMPLETED
+            if rc is not None:
+                return (ElasticStatus.RESTART if rc == ELASTIC_EXIT_CODE
+                        else ElasticStatus.ERROR)
+        members = self.members()
+        if self._last_members and set(members) != set(self._last_members):
+            self._last_members = members
+            return ElasticStatus.RESTART
+        self._last_members = members
+        return ElasticStatus.HOLD
+
+    def rank_env(self):
+        """Rewritten rank environment for a (re)launch (reference
+        _update_endpoint / rank re-map)."""
+        members = sorted(self.members())
+        if self.host not in members:
+            self.register()                   # lease lapsed: renew first
+            members = sorted(self.members())
+        rank = members.index(self.host)
+        return {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(len(members)),
+            "PADDLE_ELASTIC_HOSTS": ",".join(members),
+        }
+
+    def exit(self, completed=False):
+        """reference exit:338 — drop the lease."""
+        self._stopped = True
+        self.store.delete(self._key())

@@ -1,0 +1,199 @@
+"""paddle_tpu.text (reference: python/paddle/text/ — viterbi_decode.py
+viterbi_decode/ViterbiDecoder:144, datasets/ Imdb, Imikolov, Movielens,
+UCIHousing, WMT14, WMT16, Conll05st).
+
+viterbi is a real lax.scan dynamic program; dataset classes read the
+reference's file formats from local paths (this build has no network
+egress — pass ``data_file=`` instead of relying on the downloader)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import defop
+from ..core.tensor import Tensor
+from ..io import Dataset
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb",
+           "Imikolov"]
+
+
+@defop("viterbi_decode", differentiable=False)
+def _viterbi(potentials, transitions, lengths, include_bos_eos_tag):
+    """potentials [B, T, N], transitions [N, N], lengths [B] →
+    (scores [B], paths [B, T]). lax.scan DP (reference
+    phi/kernels/viterbi_decode_kernel)."""
+    b, t, n = potentials.shape
+    if include_bos_eos_tag:
+        # reference convention: tag n-2 = BOS, n-1 = EOS
+        start = transitions[n - 2][None, :]            # [1, N]
+        stop = transitions[:, n - 1][None, :]
+    else:
+        start = jnp.zeros((1, n), potentials.dtype)
+        stop = jnp.zeros((1, n), potentials.dtype)
+
+    alpha0 = potentials[:, 0] + start                  # [B, N]
+    identity_bp = jnp.broadcast_to(jnp.arange(n)[None, :], (b, n))
+
+    def step(carry, emit_t):
+        alpha, idx_t = carry
+        # scores[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, j]
+        scores = alpha[:, :, None] + transitions[None] \
+            + emit_t[:, None, :]
+        best_prev = jnp.argmax(scores, axis=1)         # [B, N]
+        alpha_new = jnp.max(scores, axis=1)
+        # rows past their length freeze: alpha unchanged, identity
+        # backpointer so backtrace passes through padded steps
+        active = (idx_t < lengths)[:, None]            # [B, 1]
+        alpha_new = jnp.where(active, alpha_new, alpha)
+        best_prev = jnp.where(active, best_prev, identity_bp)
+        return (alpha_new, idx_t + 1), best_prev
+
+    (alpha, _), backptrs = jax.lax.scan(
+        step, (alpha0, jnp.asarray(1)),
+        jnp.moveaxis(potentials[:, 1:], 1, 0))         # [T-1, B, N]
+
+    final = alpha + stop
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1)              # [B]
+
+    def backtrace(carry, bp_t):
+        tag = carry
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first_tag, tags_rev = jax.lax.scan(
+        backtrace, last_tag, backptrs, reverse=True)
+    paths = jnp.concatenate([first_tag[None], tags_rev], axis=0)
+    return scores, jnp.moveaxis(paths, 0, 1).astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transitions, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """reference text/viterbi_decode.py viterbi_decode."""
+    pt = potentials if isinstance(potentials, Tensor) \
+        else Tensor(jnp.asarray(potentials))
+    tt = transitions if isinstance(transitions, Tensor) \
+        else Tensor(jnp.asarray(transitions))
+    lt = (lengths if isinstance(lengths, Tensor)
+          else Tensor(jnp.asarray(lengths))) if lengths is not None \
+        else Tensor(jnp.full((pt.shape[0],), pt.shape[1], jnp.int32))
+    return _viterbi(pt, tt, lt,
+                    include_bos_eos_tag=include_bos_eos_tag)
+
+
+class ViterbiDecoder:
+    """reference viterbi_decode.py:144 — layer form."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class UCIHousing(Dataset):
+    """reference text/datasets/uci_housing.py — 13 features + price.
+    Reads the standard housing.data whitespace format from data_file."""
+
+    def __init__(self, data_file=None, mode="train"):
+        if data_file is None:
+            raise ValueError(
+                "no network egress in this build: pass data_file= pointing "
+                "at a local housing.data")
+        raw = np.loadtxt(data_file)
+        split = int(len(raw) * 0.8)
+        data = raw[:split] if mode == "train" else raw[split:]
+        feats = data[:, :-1]
+        mx, mn = feats.max(0), feats.min(0)
+        self.x = ((feats - feats.mean(0)) / np.maximum(mx - mn, 1e-8)
+                  ).astype(np.float32)
+        self.y = data[:, -1:].astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Imdb(Dataset):
+    """reference text/datasets/imdb.py — sentiment pairs. Reads a local
+    TSV of ``label<TAB>text`` lines (the extracted aclImdb format is
+    assembled by the user; no downloader here)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        if data_file is None:
+            raise ValueError("pass data_file= (label<TAB>text lines)")
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode must be train|test, got {mode!r}")
+        self.docs, self.labels = [], []
+        freq: dict[str, int] = {}
+        rows = []
+        with open(data_file) as f:
+            for line in f:
+                label, _, text = line.rstrip("\n").partition("\t")
+                toks = text.lower().split()
+                rows.append((int(label), toks))
+                for w in toks:
+                    freq[w] = freq.get(w, 0) + 1
+        vocab = [w for w, c in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))]
+        self.word_idx = {w: i for i, w in enumerate(vocab[:cutoff])}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        split = int(len(rows) * 0.8)
+        rows = rows[:split] if mode == "train" else rows[split:]
+        for label, toks in rows:
+            self.docs.append(np.array(
+                [self.word_idx.get(w, unk) for w in toks], np.int64))
+            self.labels.append(label)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """reference text/datasets/imikolov.py — n-gram LM windows over a
+    local tokenized corpus file (one sentence per line)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=1):
+        if data_file is None:
+            raise ValueError("pass data_file= (one sentence per line)")
+        if data_type != "NGRAM":
+            raise NotImplementedError("data_type='SEQ' not implemented")
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode must be train|test, got {mode!r}")
+        freq: dict[str, int] = {}
+        sents = []
+        with open(data_file) as f:
+            for line in f:
+                toks = ["<s>"] + line.split() + ["<e>"]
+                sents.append(toks)
+                for w in toks:
+                    freq[w] = freq.get(w, 0) + 1
+        vocab = [w for w, c in freq.items() if c >= min_word_freq]
+        self.word_idx = {w: i for i, w in enumerate(sorted(vocab))}
+        unk = len(self.word_idx)
+        self.word_idx["<unk>"] = unk
+        self.data = []
+        split = int(len(sents) * 0.8)
+        sents = sents[:split] if mode == "train" else sents[split:]
+        for toks in sents:
+            ids = [self.word_idx.get(w, unk) for w in toks]
+            for i in range(len(ids) - window_size + 1):
+                self.data.append(np.array(ids[i:i + window_size], np.int64))
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)

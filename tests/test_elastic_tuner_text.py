@@ -1,0 +1,191 @@
+"""Elastic manager, auto-tuner, text module tests (reference:
+test/collective/fleet/test_elastic_manager.py, auto_tuner tests,
+test_viterbi_decode_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_tuner import AutoTuner, prune_cfg
+from paddle_tpu.distributed.fleet.elastic import (ELASTIC_EXIT_CODE,
+                                                  ElasticManager,
+                                                  ElasticStatus,
+                                                  FileKVStore)
+
+
+class TestElastic:
+    def test_membership_and_rank_env(self, tmp_path):
+        store = FileKVStore(str(tmp_path))
+        a = ElasticManager(store=store, host="hostA", np=2)
+        b = ElasticManager(store=store, host="hostB", np=2)
+        a.register()
+        b.register()
+        assert sorted(a.members()) == ["hostA", "hostB"]
+        assert a.exact_mode() and b.exact_mode()
+        env = b.rank_env()
+        assert env["PADDLE_TRAINER_ID"] == "1"
+        assert env["PADDLE_TRAINERS_NUM"] == "2"
+
+    def test_scale_change_triggers_restart(self, tmp_path):
+        store = FileKVStore(str(tmp_path))
+        a = ElasticManager(store=store, host="hostA", np=2)
+        b = ElasticManager(store=store, host="hostB", np=2)
+        a.register()
+        b.register()
+        assert a.watch() == ElasticStatus.HOLD   # records membership
+        b.exit()                                  # hostB leaves
+        assert a.watch() == ElasticStatus.RESTART
+        env = a.rank_env()
+        assert env["PADDLE_TRAINERS_NUM"] == "1"
+
+    def test_ttl_lease_expiry(self, tmp_path):
+        import json, os, time
+        store = FileKVStore(str(tmp_path))
+        m = ElasticManager(store=store, host="hostA", np=1,
+                           heartbeat_interval=1)
+        m.register()
+        assert m.members() == ["hostA"]
+        # backdate the lease past its ttl
+        path = store._path(m._key())
+        payload = json.load(open(path))
+        payload["ts"] -= 10
+        json.dump(payload, open(path, "w"))
+        assert m.members() == []
+
+    def test_launcher_status_mapping(self, tmp_path):
+        class FakeProc:
+            def __init__(self, code):
+                self._code = code
+
+            def poll(self):
+                return self._code
+
+        from paddle_tpu.distributed.fleet.elastic import LauncherInterface
+        store = FileKVStore(str(tmp_path))
+        m = ElasticManager(store=store, host="h", np=1)
+        m.register()
+        m.watch()  # seed membership
+        lf = LauncherInterface()
+        lf.procs = [FakeProc(0)]
+        assert m.watch(lf) == ElasticStatus.COMPLETED
+        lf.procs = [FakeProc(ELASTIC_EXIT_CODE)]
+        assert m.watch(lf) == ElasticStatus.RESTART
+        lf.procs = [FakeProc(1)]
+        assert m.watch(lf) == ElasticStatus.ERROR
+
+
+class TestAutoTuner:
+    CFG = {"world_size": 8,
+           "model_cfg": {"num_attention_heads": 16, "hidden_size": 1024,
+                         "num_layers": 8, "global_batch_size": 16},
+           "micro_batch_size": [1, 2],
+           "sharding_stage": [1],
+           "use_recompute": [False]}
+
+    def test_prune_rules(self):
+        ok = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+              "sharding_degree": 1, "sharding_stage": 1,
+              "micro_batch_size": 2, "use_recompute": False}
+        assert prune_cfg(ok, self.CFG)
+        bad = dict(ok, mp_degree=3)          # 2*3*2*1 != 8
+        assert not prune_cfg(bad, self.CFG)
+        bad = dict(ok, pp_degree=4, mp_degree=1)  # 8 % pp==0 but layers 8%4==0 ok -> make layers fail
+        cfg = dict(self.CFG, model_cfg=dict(self.CFG["model_cfg"],
+                                            num_layers=6))
+        assert not prune_cfg(bad, cfg)
+
+    def test_grid_search_finds_best(self):
+        tuner = AutoTuner(dict(self.CFG))
+
+        def runner(cfg):
+            # fake cost: prefer dp=8 pure data parallel, mbs 2
+            if cfg["dp_degree"] == 8 and cfg["micro_batch_size"] == 2:
+                return 1.0
+            if cfg["pp_degree"] > 2:
+                raise RuntimeError("OOM")    # simulated failure
+            return 10.0 / cfg["dp_degree"] + cfg["mp_degree"]
+
+        best = tuner.tune(runner)
+        assert best["cfg"]["dp_degree"] == 8
+        assert best["cfg"]["micro_batch_size"] == 2
+        assert best["time"] == 1.0
+        # errored trials recorded, not chosen
+        errs = [h for h in tuner.recorder.history if h["error"]]
+        assert errs
+
+    def test_search_once_protocol(self):
+        tuner = AutoTuner(dict(self.CFG))
+        c1 = tuner.search_once()
+        assert c1 is not None
+        tuner.add_cfg(c1, metric_value=5.0)
+        c2 = tuner.search_once()
+        assert c2 is not None and c2 != c1
+
+
+class TestText:
+    def test_viterbi_matches_bruteforce(self):
+        rng = np.random.RandomState(0)
+        b, t, n = 2, 4, 3
+        pot = rng.rand(b, t, n).astype(np.float32)
+        trans = rng.rand(n, n).astype(np.float32)
+        from paddle_tpu.text import viterbi_decode
+        scores, paths = viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            include_bos_eos_tag=False)
+        import itertools
+        for bi in range(b):
+            best, best_path = -1e9, None
+            for path in itertools.product(range(n), repeat=t):
+                s = pot[bi, 0, path[0]]
+                for i in range(1, t):
+                    s += trans[path[i - 1], path[i]] + pot[bi, i, path[i]]
+                if s > best:
+                    best, best_path = s, path
+            np.testing.assert_allclose(float(scores._value[bi]), best,
+                                       rtol=1e-5)
+            assert tuple(np.asarray(paths._value)[bi]) == best_path
+
+    def test_uci_housing(self, tmp_path):
+        rng = np.random.RandomState(0)
+        data = rng.rand(50, 14)
+        f = tmp_path / "housing.data"
+        np.savetxt(f, data)
+        from paddle_tpu.text import UCIHousing
+        ds = UCIHousing(data_file=str(f), mode="train")
+        assert len(ds) == 40
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_imdb_and_imikolov(self, tmp_path):
+        f = tmp_path / "imdb.tsv"
+        f.write_text("1\tgreat movie great fun\n0\tbad awful movie\n"
+                     "1\tloved it\n0\tterrible\n1\tsuperb acting\n")
+        from paddle_tpu.text import Imdb, Imikolov
+        ds = Imdb(data_file=str(f), mode="train")
+        test = Imdb(data_file=str(f), mode="test")
+        assert len(ds) == 4 and len(test) == 1   # 80/20 split
+        doc, label = ds[0]
+        assert label == 1 and doc.dtype == np.int64
+        f2 = tmp_path / "corpus.txt"
+        f2.write_text("a b c d e f\ng h i j k l\n")
+        ng = Imikolov(data_file=str(f2), window_size=5, mode="train")
+        assert len(ng) > 0 and ng[0].shape == (5,)
+
+    def test_viterbi_ragged_lengths(self):
+        """Padded rows must not contribute (regression: lengths ignored)."""
+        rng = np.random.RandomState(1)
+        pot = rng.rand(2, 4, 3).astype(np.float32)
+        trans = rng.rand(3, 3).astype(np.float32)
+        from paddle_tpu.text import viterbi_decode
+        # row 0 truncated to length 2: score must equal a fresh T=2 decode
+        s_full, p_full = viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            lengths=paddle.to_tensor(np.array([2, 4], np.int32)),
+            include_bos_eos_tag=False)
+        s_short, p_short = viterbi_decode(
+            paddle.to_tensor(pot[:1, :2]), paddle.to_tensor(trans),
+            include_bos_eos_tag=False)
+        np.testing.assert_allclose(float(s_full._value[0]),
+                                   float(s_short._value[0]), rtol=1e-5)
+        assert tuple(np.asarray(p_full._value)[0][:2]) == \
+            tuple(np.asarray(p_short._value)[0])
