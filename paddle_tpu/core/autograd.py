@@ -79,16 +79,22 @@ class GradNode:
     cotangents can be materialized as zeros.
     """
 
-    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "_buffer", "_hooks")
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "_buffer",
+                 "_hooks", "fwd_fn")
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence,
-                 out_avals: Sequence[jax.ShapeDtypeStruct]):
+                 out_avals: Sequence[jax.ShapeDtypeStruct],
+                 fwd_fn: Callable | None = None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)   # Tensor objects (strong refs, like the reference)
         self.out_avals = list(out_avals)
         self._buffer = None          # per-output accumulated cotangents
         self._hooks = []
+        # pure forward over tensor primals — kept so create_graph can
+        # REPLAY jax.vjp through the dispatcher (higher-order grads need
+        # the primal dependence recorded, not the baked vjp closure)
+        self.fwd_fn = fwd_fn
 
     def accumulate(self, index: int, cotangent) -> None:
         if self._buffer is None:
@@ -96,13 +102,16 @@ class GradNode:
         cur = self._buffer[index]
         self._buffer[index] = cotangent if cur is None else cur + cotangent
 
-    def take_cotangents(self):
+    def take_cotangents(self, as_tensor: bool = False):
         import jax.numpy as jnp
         buf = self._buffer or [None] * len(self.out_avals)
         outs = []
         for aval, c in zip(self.out_avals, buf):
             if c is None:
                 c = jnp.zeros(aval.shape, aval.dtype)
+                if as_tensor:
+                    from .tensor import Tensor
+                    c = Tensor(c, stop_gradient=True)
             elif c.dtype != aval.dtype:
                 # AMP boundary: consumer ran in a different precision than
                 # this node's output (reference casts grads the same way)
@@ -146,28 +155,51 @@ def _toposort_count(roots: list[GradNode]) -> dict[GradNode, int]:
 
 def run_backward(tensors: Sequence, grad_tensors: Sequence | None = None,
                  retain_graph: bool = False,
-                 accumulate_fn: Callable | None = None) -> None:
+                 accumulate_fn: Callable | None = None,
+                 create_graph: bool = False) -> None:
     """BFS backward over the grad-node graph.
 
     ``accumulate_fn(leaf_tensor, cotangent)`` lets :func:`grad` capture
     gradients without touching ``.grad`` (reference GeneralGrad analogue);
     default behavior accumulates into ``tensor.grad``.
-    """
-    import jax.numpy as jnp
+
+    ``create_graph=True`` runs the backward itself through the op
+    dispatcher (cotangents are Tensors, each vjp is replayed with the
+    node's original inputs as primals), so the produced gradients carry
+    their own grad graph — reference prim/composite higher-order autodiff
+    (fluid/prim, fluid/eager general_grad)."""
+    import jax.numpy as jnp  # noqa: F401 — used by nested helpers
 
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
 
+    if create_graph:
+        retain_graph = True
+        from .tensor import Tensor as _T
+
+        def _as_cot(g, t):
+            if g is None:
+                if t._value.size != 1:
+                    raise RuntimeError(
+                        "grad can be implicitly created only for scalar "
+                        f"outputs, got shape {t.shape}")
+                return _T(jnp.ones(t._value.shape, t._value.dtype),
+                          stop_gradient=True)
+            return g if isinstance(g, _T) else _T(jnp.asarray(g),
+                                                  stop_gradient=True)
+    else:
+        def _as_cot(g, t):
+            if g is None:
+                if t._value.size != 1:
+                    raise RuntimeError(
+                        "grad can be implicitly created only for scalar "
+                        f"outputs, got shape {t.shape}")
+                return jnp.ones(t._value.shape, t._value.dtype)
+            return g._value if hasattr(g, "_value") else g
+
     roots: list[GradNode] = []
     for t, g in zip(tensors, grad_tensors):
-        if g is None:
-            if t._value.size != 1:
-                raise RuntimeError(
-                    f"grad can be implicitly created only for scalar outputs, "
-                    f"got shape {t.shape}")
-            g = jnp.ones(t._value.shape, t._value.dtype)
-        elif hasattr(g, "_value"):
-            g = g._value
+        g = _as_cot(g, t)
         node = t._grad_node
         if node is None:
             if accumulate_fn is not None:
@@ -195,10 +227,25 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence | None = None,
                 "(the saved intermediates were already released); call "
                 ".backward(retain_graph=True) on the first backward if you "
                 "need to backward twice")
-        cots = node.take_cotangents()
+        cots = node.take_cotangents(as_tensor=create_graph)
         for hook in node._hooks:
             cots = tuple(hook(c) for c in cots)
-        in_cots = node.vjp_fn(cots)
+        if create_graph:
+            # a hook may hand back a raw array (e.g. jnp.clip of a Tensor)
+            # — rewrap so the replayed vjp keeps it as a differentiable
+            # primal instead of baking it in as a constant
+            from .tensor import Tensor as _TT
+            cots = tuple(c if isinstance(c, _TT)
+                         else _TT(jnp.asarray(c), stop_gradient=True)
+                         for c in cots)
+        if create_graph and node.fwd_fn is not None:
+            in_cots = _replay_vjp(node, cots)
+        else:
+            if create_graph:
+                raise RuntimeError(
+                    f"op {node.name!r} has no replayable forward; "
+                    f"create_graph is unsupported through it")
+            in_cots = node.vjp_fn(cots)
         for t, c in zip(node.inputs, in_cots):
             if t.stop_gradient:
                 continue
@@ -221,19 +268,34 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence | None = None,
             node.release()
 
 
+def _replay_vjp(node: GradNode, cot_tensors):
+    """Run a node's vjp THROUGH the dispatcher with its original inputs as
+    primals, so the resulting cotangents depend differentiably on both the
+    primals and the incoming cotangents (higher-order autodiff)."""
+    from .dispatch import apply_op
+    n_in = len(node.inputs)
+
+    def backward_fn(*arrs):
+        prims, cots = arrs[:n_in], arrs[n_in:]
+        _, vjp = jax.vjp(node.fwd_fn, *prims)
+        return vjp(tuple(cots))
+
+    out = apply_op(node.name + "_grad", backward_fn,
+                   tuple(node.inputs) + tuple(cot_tensors), {})
+    return out if isinstance(out, tuple) else (out,)
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
     """paddle.grad parity (reference python/paddle/autograd + GeneralGrad).
 
     Returns gradients of ``outputs`` w.r.t. ``inputs`` without writing
-    ``.grad``. ``create_graph`` (higher-order) is not yet supported.
-    """
+    ``.grad``. With ``create_graph=True`` the returned gradients carry
+    their own autograd graph, so grad-of-grad works (reference
+    prim/composite higher-order rules)."""
     import jax.numpy as jnp
 
-    if create_graph:
-        raise NotImplementedError("create_graph=True (higher-order grad) "
-                                  "is not supported yet; use paddle_tpu.incubate.autograd")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
@@ -249,7 +311,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
     retain = bool(retain_graph) if retain_graph is not None else create_graph
     run_backward(outputs, grad_outputs, retain_graph=retain,
-                 accumulate_fn=capture)
+                 accumulate_fn=capture, create_graph=create_graph)
 
     from .tensor import Tensor
     results = []
@@ -261,6 +323,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "one of the input tensors received no gradient; "
                     "pass allow_unused=True to return None for it")
             results.append(None)
+        elif isinstance(c, Tensor):
+            results.append(c)        # create_graph: keep the grad graph
         else:
             results.append(Tensor(c, stop_gradient=True))
     return results

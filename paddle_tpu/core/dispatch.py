@@ -41,6 +41,11 @@ def register_op(name: str, fn: Callable) -> None:
 # dispatch, platform/profiler) and for test coverage accounting.
 OP_OBSERVERS: list[Callable[[str], None]] = []
 
+# Recorders called as f(name, fn, args, kwargs, outputs) after dispatch —
+# the static-graph Program capture hook (reference: static ops appended to
+# the ProgramDesc as they're built).
+OP_RECORDERS: list[Callable] = []
+
 
 def _check_nan_inf(name: str, arrays) -> None:
     """reference FLAGS_check_nan_inf (eager nan_inf_utils.h:38). Jit-safe:
@@ -94,7 +99,10 @@ def apply_op(name: str, fn: Callable, args: tuple, kwargs: dict,
         if flags.flag("check_nan_inf"):
             _check_nan_inf(name, outs)
         wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
-        return tuple(wrapped) if multi else wrapped[0]
+        result = tuple(wrapped) if multi else wrapped[0]
+        for rec in OP_RECORDERS:
+            rec(name, fn, args, kwargs, wrapped)
+        return result
 
     def f(*tensor_arrays):
         full = list(arrays)
@@ -110,13 +118,16 @@ def apply_op(name: str, fn: Callable, args: tuple, kwargs: dict,
 
     out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
     node = autograd.GradNode(name, vjp_fn,
-                             [args[i] for i in tensor_idx], out_avals)
+                             [args[i] for i in tensor_idx], out_avals,
+                             fwd_fn=f)
     wrapped = []
     for i, o in enumerate(outs):
         t = Tensor(o, stop_gradient=False)
         t._grad_node = node
         t._out_index = i
         wrapped.append(t)
+    for rec in OP_RECORDERS:
+        rec(name, fn, args, kwargs, tuple(wrapped))
     # Re-detect multi-output from the raw fn contract: f always tuples.
     return tuple(wrapped) if len(wrapped) > 1 else wrapped[0]
 

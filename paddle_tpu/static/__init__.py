@@ -1,0 +1,229 @@
+"""paddle_tpu.static — static-graph Program / Executor surface
+(reference: python/paddle/static/ over fluid/framework ProgramDesc +
+new_executor StandaloneExecutor; Executor.run base/executor.py:1482,
+_ExecutorCache :819).
+
+TPU-native: "building a program" records the SAME eager ops through a
+dispatch hook (OP_RECORDERS) into a Program op list — the ProgramDesc
+analogue; ``Executor.run`` replays the list as one pure function and
+jit-compiles it per feed-shape signature (the StandaloneExecutor +
+instruction-list role collapses onto XLA)."""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import OP_RECORDERS
+from ..core.tensor import Tensor
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "data", "Executor", "InputSpec",
+           "name_scope"]
+
+from ..jit.api import InputSpec  # noqa: E402,F401  (shared spec type)
+
+
+class _RecordedOp:
+    __slots__ = ("name", "fn", "arg_slots", "kwargs", "out_ids",
+                 "out_refs")
+
+    def __init__(self, name, fn, arg_slots, kwargs, out_ids, out_refs):
+        self.name = name
+        self.fn = fn
+        self.arg_slots = arg_slots     # ("var", id, ref) | ("const", v, None)
+        self.kwargs = kwargs
+        self.out_ids = out_ids
+        # strong refs: ids key the replay env, so the Tensors must stay
+        # alive for the Program's lifetime (CPython reuses freed ids)
+        self.out_refs = out_refs
+
+
+class Program:
+    """reference framework.Program / ProgramDesc — an ordered op list with
+    named feed vars."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self.idx = Program._counter
+        self.ops: list[_RecordedOp] = []
+        self.feed_vars: dict[str, Tensor] = {}
+
+    # -- introspection (ProgramDesc parity) ---------------------------------
+    def block(self, i=0):
+        return self
+
+    def global_block(self):
+        return self
+
+    @property
+    def op_types(self):
+        return [op.name for op in self.ops]
+
+    def __str__(self):
+        lines = [f"Program(id={self.idx}, ops={len(self.ops)})"]
+        for op in self.ops:
+            ins = [s[1] if s[0] == "var" else repr(s[1])[:20]
+                   for s in op.arg_slots]
+            lines.append(f"  {op.name}({', '.join(map(str, ins))}) "
+                         f"-> {op.out_ids}")
+        return "\n".join(lines)
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.ops = list(self.ops)
+        p.feed_vars = dict(self.feed_vars)
+        return p
+
+    # -- recording ----------------------------------------------------------
+    def _record(self, name, fn, args, kwargs, outs):
+        slots = []
+        for a in args:
+            if isinstance(a, Tensor):
+                # keep the Tensor ref: externals (parameters created
+                # outside the guard) read their live value at run time
+                slots.append(("var", id(a), a))
+            else:
+                slots.append(("const", a, None))
+        self.ops.append(_RecordedOp(name, fn, slots, dict(kwargs),
+                                    [id(o) for o in outs], list(outs)))
+
+    def external_vars(self):
+        """Tensors consumed by the program but produced outside it (model
+        parameters etc.) — they become runner inputs so updates between
+        runs are seen without recompiling."""
+        produced = set()
+        for n in self.feed_vars.values():
+            produced.add(id(n))
+        ext = {}
+        for op in self.ops:
+            for kind, vid, ref in op.arg_slots:
+                if kind == "var" and vid not in produced:
+                    ext[vid] = ref
+            produced.update(op.out_ids)
+        return ext
+
+
+_PROGRAMS = [Program()]          # default main program stack
+_STARTUP = Program()
+
+
+def default_main_program():
+    return _PROGRAMS[-1]
+
+
+def default_startup_program():
+    return _STARTUP
+
+
+@contextmanager
+def program_guard(main_program: Program, startup_program: Program = None):
+    """reference static.program_guard — ops built inside record into
+    ``main_program``."""
+    _PROGRAMS.append(main_program)
+    hook = main_program._record
+    OP_RECORDERS.append(hook)
+    try:
+        yield
+    finally:
+        OP_RECORDERS.remove(hook)
+        _PROGRAMS.pop()
+
+
+@contextmanager
+def name_scope(prefix):
+    yield
+
+
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """reference static.data — a named feed placeholder. Dims given as
+    None/-1 trace as 1 and accept any size at run time."""
+    from ..core.dtype import convert_dtype
+    concrete = [1 if (d is None or d < 0) else int(d) for d in shape]
+    t = Tensor(jnp.zeros(concrete, convert_dtype(dtype)),
+               stop_gradient=True)
+    t.name = name
+    prog = default_main_program()
+    prog.feed_vars[name] = t
+    return t
+
+
+class Executor:
+    """reference base/executor.py:1482 — run(program, feed, fetch_list).
+    Replays the recorded op list as one pure function, jit-compiled per
+    feed-shape signature (the _ExecutorCache analogue)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: dict = {}
+
+    def run(self, program: Program = None, feed: dict | None = None,
+            fetch_list=None, return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_ids = [id(t) if isinstance(t, Tensor) else id(
+            program.feed_vars[t]) for t in fetch_list]
+
+        feed_names = sorted(program.feed_vars)
+        if feed:
+            missing = [n for n in feed_names if n not in feed]
+            if missing:
+                raise KeyError(
+                    f"feed is missing declared data vars {missing}; "
+                    f"got keys {sorted(feed)}")
+        feed_vals = []
+        for n in feed_names:
+            v = feed.get(n)
+            if v is None:       # no feed at all: placeholder zeros
+                v = np.asarray(program.feed_vars[n]._value)
+            v = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            feed_vals.append(v)
+
+        ext = program.external_vars()
+        ext_ids = sorted(ext)
+        ext_vals = [ext[i]._value for i in ext_ids]
+        key = (program.idx, len(program.ops),
+               tuple((v.shape, str(v.dtype)) for v in feed_vals),
+               tuple(fetch_ids))
+        entry = self._cache.get(key)
+        if entry is None:
+            # hold the Program in the entry: idx is unique per Program
+            # instance, and the ref also pins every recorded Tensor id
+            entry = (jax.jit(self._make_runner(program, feed_names,
+                                               fetch_ids, ext_ids)),
+                     program)
+            self._cache[key] = entry
+        outs = entry[0](feed_vals, ext_vals)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    @staticmethod
+    def _make_runner(program, feed_names, fetch_ids, ext_ids):
+        def pure(feed_vals, ext_vals):
+            env: dict[int, Any] = {}
+            for n, v in zip(feed_names, feed_vals):
+                env[id(program.feed_vars[n])] = v
+            for vid, v in zip(ext_ids, ext_vals):
+                env.setdefault(vid, v)
+            for op in program.ops:
+                args = []
+                for kind, vid, _ref in op.arg_slots:
+                    if kind == "var":
+                        args.append(env[vid])
+                    else:
+                        args.append(vid._value if isinstance(vid, Tensor)
+                                    else vid)
+                out = op.fn(*args, **op.kwargs)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                for oid, o in zip(op.out_ids, outs):
+                    env[oid] = o
+            return [env[fid] for fid in fetch_ids]
+        return pure

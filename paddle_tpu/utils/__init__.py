@@ -1,0 +1,6 @@
+"""paddle_tpu.utils (reference: python/paddle/utils/ — cpp_extension,
+unique_name, deprecated helpers)."""
+
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["cpp_extension"]

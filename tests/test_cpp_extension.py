@@ -1,0 +1,89 @@
+"""Custom C++ op extension tests (reference: test/custom_op/ — builds a
+real shared library with the system toolchain and runs it as an op;
+VERDICT item 22)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+SRC = r"""
+#include <cstdint>
+
+// PD_OP: square_plus_one 1
+extern "C" void square_plus_one(const float* x, float* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) out[i] = x[i] * x[i] + 1.0f;
+}
+
+// backward: d/dx (x^2+1) * cot = 2x * cot
+extern "C" void square_plus_one_grad(const float* x, const float* cot,
+                                     float* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) out[i] = 2.0f * x[i] * cot[i];
+}
+
+// PD_OP: pair_max 2
+extern "C" void pair_max(const float* a, const float* b, float* out,
+                         int64_t n) {
+    for (int64_t i = 0; i < n; ++i) out[i] = a[i] > b[i] ? a[i] : b[i];
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "custom_ops.cc"
+    src.write_text(SRC)
+    return cpp_extension.load("custom_ops", [str(src)],
+                              build_directory=str(d))
+
+
+class TestCppExtension:
+    def test_forward(self, ext):
+        x = np.linspace(-2, 2, 7).astype(np.float32)
+        out = ext.square_plus_one(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out._value), x * x + 1,
+                                   rtol=1e-6)
+
+    def test_binary_op(self, ext):
+        a = np.array([1.0, 5.0, -2.0], np.float32)
+        b = np.array([3.0, 2.0, -1.0], np.float32)
+        out = ext.pair_max(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(np.asarray(out._value), np.maximum(a, b))
+
+    def test_backward_through_custom_op(self, ext):
+        x = paddle.to_tensor(np.array([1.0, -3.0, 0.5], np.float32))
+        x.stop_gradient = False
+        y = ext.square_plus_one(x)
+        y.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._value),
+                                   2 * np.array([1.0, -3.0, 0.5]),
+                                   rtol=1e-6)
+
+    def test_works_under_jit(self, ext):
+        import jax
+        from paddle_tpu.core.tensor import Tensor
+
+        def f(arr):
+            return ext.square_plus_one(Tensor(arr))._value
+
+        x = np.linspace(0, 1, 8).astype(np.float32)
+        out = jax.jit(f)(x)
+        np.testing.assert_allclose(np.asarray(out), x * x + 1, rtol=1e-6)
+
+    def test_build_cache_reuses_so(self, ext, tmp_path):
+        src = tmp_path / "again.cc"
+        src.write_text(SRC)
+        e2 = cpp_extension.load("custom_ops", [str(src)],
+                                build_directory=str(tmp_path))
+        out = e2.square_plus_one(paddle.to_tensor([2.0]))
+        np.testing.assert_allclose(np.asarray(out._value), [5.0])
+
+    def test_setup_api(self, tmp_path):
+        src = tmp_path / "s.cc"
+        src.write_text(SRC)
+        ext = cpp_extension.setup(
+            name="s", ext_modules=cpp_extension.CppExtension(
+                sources=[str(src)]))
+        assert hasattr(ext, "square_plus_one")

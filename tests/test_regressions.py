@@ -211,3 +211,45 @@ class TestGradScalerMultiOptimizer:
             scaler.update()
         # exactly 2 good steps -> one doubling
         assert scaler._scale == 8.0
+
+
+class TestHigherOrderGrad:
+    """create_graph=True (reference prim/composite higher-order autodiff;
+    VERDICT item 23 — previously raised NotImplementedError)."""
+
+    def test_triple_backward_scalar(self):
+        x = paddle.to_tensor(np.float32(2.0))
+        x.stop_gradient = False
+        y = x * x * x
+        g1, = paddle.grad(y, x, create_graph=True)
+        g2, = paddle.grad(g1, x, create_graph=True)
+        g3, = paddle.grad(g2, x)
+        assert abs(float(g1) - 12) < 1e-5
+        assert abs(float(g2) - 12) < 1e-5
+        assert abs(float(g3) - 6) < 1e-5
+
+    def test_grad_penalty_into_weights(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        xb = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        xb.stop_gradient = False
+        out = net(xb).sum()
+        gx, = paddle.grad(out, xb, create_graph=True)
+        ((gx ** 2).sum()).backward()
+        assert net.weight.grad is not None
+        # d/dw sum((dout/dx)^2) = d/dw sum(w^2 broadcast) = 2*w*batch
+        want = 2 * np.asarray(net.weight._value) * 8
+        np.testing.assert_allclose(np.asarray(net.weight.grad._value),
+                                   want, rtol=1e-4)
+
+    def test_mixed_ops_second_derivative(self):
+        x = paddle.to_tensor(np.linspace(0.2, 1.0, 5).astype(np.float32))
+        x.stop_gradient = False
+        y = (paddle.sin(x) * paddle.exp(x)).sum()
+        g1, = paddle.grad(y, x, create_graph=True)
+        g2, = paddle.grad(g1.sum(), x)
+        # d2/dx2 sin(x)e^x = 2cos(x)e^x
+        want = 2 * np.cos(np.linspace(0.2, 1.0, 5)) * np.exp(
+            np.linspace(0.2, 1.0, 5))
+        np.testing.assert_allclose(np.asarray(g2._value), want, rtol=1e-4)

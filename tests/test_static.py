@@ -1,0 +1,81 @@
+"""Static Program/Executor tests (reference: test/legacy_test static
+executor tests; base/executor.py:1482, program_guard patterns)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import static
+
+
+class TestProgramExecutor:
+    def test_record_and_run(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4])
+            y = paddle.exp(x) + 1.0
+        assert "exp" in prog.op_types
+        exe = static.Executor()
+        feed = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        out, = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+        np.testing.assert_allclose(out, np.exp(feed) + 1, rtol=1e-5)
+
+    def test_feed_shape_polymorphism(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4])
+            y = (x * 2).sum()
+        exe = static.Executor()
+        for n in (2, 7):
+            feed = np.ones((n, 4), np.float32)
+            out, = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+            assert float(out) == 8 * n
+
+    def test_layer_params_are_live_inputs(self):
+        """Parameter updates between runs must be visible without
+        recompiling (externals are runner inputs, not baked constants)."""
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4])
+            y = net(x)
+        exe = static.Executor()
+        feed = np.ones((2, 4), np.float32)
+        out1, = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+        net.weight._in_place_update(net.weight._value * 2)
+        net.bias._in_place_update(net.bias._value * 2)
+        out2, = exe.run(prog, feed={"x": feed}, fetch_list=[y])
+        np.testing.assert_allclose(out2, out1 * 2, rtol=1e-5)
+
+    def test_multiple_fetches_and_default_program(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            assert static.default_main_program() is prog
+            x = static.data("x", [3])
+            a = x + 1
+            b = a * a
+        exe = static.Executor()
+        feed = np.array([1.0, 2.0, 3.0], np.float32)
+        ra, rb = exe.run(prog, feed={"x": feed}, fetch_list=[a, b])
+        np.testing.assert_allclose(ra, feed + 1)
+        np.testing.assert_allclose(rb, (feed + 1) ** 2)
+
+    def test_program_str_and_clone(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2])
+            _ = paddle.tanh(x)
+        text = str(prog)
+        assert "tanh" in text
+        c = prog.clone(for_test=True)
+        assert c.op_types == prog.op_types
+
+    def test_ops_outside_guard_not_recorded(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2])
+            _ = x + 1
+        _ = paddle.exp(paddle.to_tensor([1.0]))  # outside: not recorded
+        assert "exp" not in prog.op_types
