@@ -127,6 +127,7 @@ class GradNode:
         self.vjp_fn = None
         self.inputs = []
         self._buffer = None
+        self.fwd_fn = None   # closure pins the op's input arrays
 
 
 def _toposort_count(roots: list[GradNode]) -> dict[GradNode, int]:

@@ -30,6 +30,9 @@ from . import fcollectives  # noqa: F401
 from . import communication  # noqa: F401
 from . import launch  # noqa: F401
 from . import auto_tuner  # noqa: F401
+from . import rpc  # noqa: F401
+from . import auto_parallel_static  # noqa: F401
+from .auto_parallel_static import Engine, Strategy  # noqa: F401
 from . import fleet  # noqa: F401
 from .fleet.sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .auto_parallel import shard_layer, shard_optimizer, to_static_dist  # noqa: F401

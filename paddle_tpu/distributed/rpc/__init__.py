@@ -1,0 +1,227 @@
+"""paddle.distributed.rpc (reference: python/paddle/distributed/rpc/
+rpc.py — init_rpc:73, rpc_sync:143, rpc_async:183, shutdown; C++ brpc
+RpcAgent paddle/fluid/distributed/rpc/rpc_agent.h).
+
+TPU-native: the brpc data plane is replaced by a request/response channel
+over the jax.distributed coordinator KV store (DCN control plane). Each
+worker runs a serving thread that polls its inbox, executes pickled
+callables, and posts pickled results. Suited to control-plane RPCs
+(metrics, orchestration) — bulk tensels belong on ICI collectives."""
+
+from __future__ import annotations
+
+import base64
+import pickle
+import threading
+import time
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info"]
+
+_STATE = {"name": None, "rank": None, "world": None, "serving": False,
+          "thread": None, "store": None, "nonce": None,
+          "seq_to": None}
+
+
+class WorkerInfo:
+    """reference rpc.py WorkerInfo(name, rank, ip, port)."""
+
+    def __init__(self, name, rank, ip="", port=0):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank})"
+
+
+class _KVStore:
+    """Store adapter: jax.distributed client when up, else an in-process
+    dict (single-process tests / local mode)."""
+
+    def __init__(self):
+        from jax._src import distributed
+        self._client = distributed.global_state.client
+        self._local = {} if self._client is None else None
+        self._lock = threading.Lock()
+
+    def set(self, key, data: bytes):
+        if self._client is None:
+            with self._lock:
+                self._local[key] = data
+        else:
+            self._client.key_value_set(
+                key, base64.b64encode(data).decode())
+
+    def try_get(self, key):
+        if self._client is None:
+            with self._lock:
+                return self._local.get(key)
+        try:
+            payload = self._client.key_value_try_get(key)
+        except Exception:  # noqa: BLE001 — missing key
+            return None
+        return base64.b64decode(payload)
+
+    def wait_get(self, key, timeout_s):
+        if self._client is None:
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                v = self.try_get(key)
+                if v is not None:
+                    return v
+                time.sleep(0.005)
+            raise TimeoutError(f"rpc result {key} not ready")
+        payload = self._client.blocking_key_value_get(
+            key, int(timeout_s * 1000))
+        return base64.b64decode(payload)
+
+    def delete(self, key):
+        if self._client is None:
+            with self._lock:
+                self._local.pop(key, None)
+        else:
+            try:
+                self._client.key_value_delete(key)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """reference rpc.py:73 — register this worker and start serving."""
+    from .. import env
+    if rank is None:
+        rank = env.get_rank()
+    if world_size is None:
+        world_size = env.get_world_size()
+    store = _KVStore()
+    nonce = str(time.time_ns())
+    _STATE.update(name=name, rank=rank, world=world_size, store=store,
+                  serving=True, nonce=nonce, seq_to={})
+    store.set(f"rpc/worker/{rank}", pickle.dumps(WorkerInfo(name, rank)))
+    # name -> rank directory for rpc_sync(to=<name>)
+    store.set(f"rpc/name/{name}", pickle.dumps(rank))
+
+    def serve():
+        # one ordered stream per SENDER: key rpc/req/{dst}/{src}/{nonce}/
+        # {seq} has a single writer (the sender), so no read-modify-write
+        # races; the sender's nonce namespaces streams across re-inits
+        cursors: dict[tuple, int] = {}
+        streams: dict[int, str] = {}
+        while _STATE["serving"]:
+            progressed = False
+            for src in range(world_size):
+                sdata = store.try_get(f"rpc/stream/{rank}/{src}")
+                if sdata is None:
+                    continue
+                snonce = pickle.loads(sdata)
+                if streams.get(src) != snonce:
+                    streams[src] = snonce          # (re)started sender
+                    cursors[(src, snonce)] = 0
+                cur = cursors[(src, snonce)]
+                key = f"rpc/req/{rank}/{src}/{snonce}/{cur}"
+                data = store.try_get(key)
+                if data is None:
+                    continue
+                req_id, fn, args, kwargs = pickle.loads(data)
+                try:
+                    result = (True, fn(*args, **kwargs))
+                except Exception as e:  # noqa: BLE001 — shipped to caller
+                    result = (False, e)
+                store.set(f"rpc/res/{req_id}", pickle.dumps(result))
+                store.delete(key)
+                cursors[(src, snonce)] = cur + 1
+                progressed = True
+            if not progressed:
+                time.sleep(0.01)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    _STATE["thread"] = t
+
+
+def _resolve(to):
+    if isinstance(to, int):
+        return to
+    data = _STATE["store"].try_get(f"rpc/name/{to}")
+    if data is None:
+        raise ValueError(f"unknown rpc worker {to!r}")
+    return pickle.loads(data)
+
+
+class _Future:
+    """reference FutureWrapper — wait() returns the result."""
+
+    def __init__(self, req_id, timeout):
+        self._req_id = req_id
+        self._timeout = timeout
+        self._done = None
+
+    def wait(self):
+        if self._done is None:
+            data = _STATE["store"].wait_get(f"rpc/res/{self._req_id}",
+                                            self._timeout)
+            ok, payload = pickle.loads(data)
+            _STATE["store"].delete(f"rpc/res/{self._req_id}")
+            self._done = (ok, payload)
+        ok, payload = self._done
+        if not ok:
+            raise payload
+        return payload
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=180.0):
+    """reference rpc.py:183 — returns a Future. Each sender writes its own
+    per-destination stream (single-writer keys: no shared counters)."""
+    if _STATE["store"] is None:
+        raise RuntimeError("call init_rpc first")
+    dst = _resolve(to)
+    store = _STATE["store"]
+    rank, nonce = _STATE["rank"], _STATE["nonce"]
+    seq = _STATE["seq_to"].get(dst, 0)
+    _STATE["seq_to"][dst] = seq + 1
+    if seq == 0:
+        # announce this sender's stream to dst (single writer: us)
+        store.set(f"rpc/stream/{dst}/{rank}", pickle.dumps(nonce))
+    req_id = f"{rank}_{dst}_{nonce}_{seq}"
+    payload = pickle.dumps((req_id, fn, tuple(args or ()),
+                            dict(kwargs or {})))
+    store.set(f"rpc/req/{dst}/{rank}/{nonce}/{seq}", payload)
+    return _Future(req_id, timeout)
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=180.0):
+    """reference rpc.py:143."""
+    return rpc_async(to, fn, args, kwargs, timeout).wait()
+
+
+def get_worker_info(name):
+    data = _STATE["store"].try_get(f"rpc/name/{name}")
+    if data is None:
+        raise ValueError(f"unknown rpc worker {name!r}")
+    rank = pickle.loads(data)
+    return pickle.loads(_STATE["store"].try_get(f"rpc/worker/{rank}"))
+
+
+def get_current_worker_info():
+    return WorkerInfo(_STATE["name"], _STATE["rank"])
+
+
+def get_all_worker_infos():
+    infos = []
+    for r in range(_STATE["world"] or 1):
+        data = _STATE["store"].try_get(f"rpc/worker/{r}")
+        if data is not None:
+            infos.append(pickle.loads(data))
+    return infos
+
+
+def shutdown(graceful=True):
+    """reference rpc.py shutdown — stop serving."""
+    _STATE["serving"] = False
+    t = _STATE.get("thread")
+    if t is not None:
+        t.join(timeout=2)
+    _STATE.update(name=None, rank=None, store=None, thread=None,
+                  nonce=None, seq_to=None)

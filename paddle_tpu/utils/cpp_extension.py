@@ -51,7 +51,13 @@ def _compile(name, sources, extra_cflags, build_directory, verbose):
                *(extra_cflags or []), *sources, "-o", so_path]
         if verbose:
             print("cpp_extension:", " ".join(cmd))
-        subprocess.run(cmd, check=True, capture_output=not verbose)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"g++ failed (exit {proc.returncode}) for {name}:\n"
+                f"{proc.stderr}")
+        if verbose and proc.stderr:
+            print(proc.stderr)
     return so_path
 
 

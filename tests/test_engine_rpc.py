@@ -1,0 +1,94 @@
+"""Static auto-parallel Engine + rpc tests (reference:
+test/auto_parallel/ engine api tests; test/rpc/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.io import Dataset
+
+
+class RegDS(Dataset):
+    def __init__(self, n=64):
+        rng = np.random.RandomState(0)
+        self.x = rng.rand(n, 8).astype(np.float32)
+        w = rng.rand(8, 1).astype(np.float32)
+        self.y = (self.x @ w).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class TestEngine:
+    def test_fit_evaluate_predict(self, tmp_path):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        engine = dist.Engine(model=model, loss=nn.MSELoss(),
+                             optimizer=paddle.optimizer.Adam(
+                                 learning_rate=1e-2,
+                                 parameters=model.parameters()))
+        mesh = dist.ProcessMesh(shape=[8], dim_names=["dp"])
+        engine.prepare(mesh=mesh)
+        ds = RegDS()
+        hist = engine.fit(ds, batch_size=16, epochs=3, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+        ev = engine.evaluate(ds, batch_size=16, verbose=0)
+        assert ev["loss"] < hist["loss"][0]
+        preds = engine.predict(ds, batch_size=16)
+        assert preds[0].shape == (16, 1)
+        engine.save(str(tmp_path / "m"))
+        engine.load(str(tmp_path / "m"))
+
+    def test_sharding_strategy_applies(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(64, 64))
+        strategy = dist.Strategy()
+        strategy.sharding.enable = True
+        strategy.sharding.stage = 3
+        engine = dist.Engine(model=model, loss=nn.MSELoss(),
+                             optimizer=paddle.optimizer.SGD(
+                                 learning_rate=0.1,
+                                 parameters=model.parameters()),
+                             strategy=strategy)
+        mesh = dist.ProcessMesh(shape=[8], dim_names=["dp"])
+        engine.prepare(mesh=mesh)
+        w = model[0].weight
+        assert "dp" in str(w._value.sharding.spec)  # stage-3 param sharding
+
+
+def _double(x):
+    return x * 2
+
+
+def _fail():
+    raise ValueError("boom")
+
+
+class TestRpc:
+    def test_local_sync_async(self):
+        from paddle_tpu.distributed import rpc
+        rpc.init_rpc("worker0", rank=0, world_size=1)
+        try:
+            assert rpc.rpc_sync("worker0", _double, args=(21,)) == 42
+            fut = rpc.rpc_async(0, _double, args=(5,))
+            assert fut.wait() == 10
+            info = rpc.get_worker_info("worker0")
+            assert info.rank == 0
+            assert rpc.get_current_worker_info().name == "worker0"
+            assert len(rpc.get_all_worker_infos()) == 1
+        finally:
+            rpc.shutdown()
+
+    def test_remote_exception_propagates(self):
+        from paddle_tpu.distributed import rpc
+        rpc.init_rpc("w", rank=0, world_size=1)
+        try:
+            with pytest.raises(ValueError, match="boom"):
+                rpc.rpc_sync("w", _fail)
+        finally:
+            rpc.shutdown()
