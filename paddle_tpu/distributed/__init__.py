@@ -31,6 +31,8 @@ from . import communication  # noqa: F401
 from . import launch  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import rpc  # noqa: F401
+from . import ps  # noqa: F401
+from . import watchdog  # noqa: F401
 from . import auto_parallel_static  # noqa: F401
 from .auto_parallel_static import Engine, Strategy  # noqa: F401
 from . import fleet  # noqa: F401

@@ -135,7 +135,24 @@ def _mh(group=None):
             f"compiled collectives (fcollectives / shard_map) for "
             f"per-axis communication.")
     from jax.experimental import multihost_utils
-    return multihost_utils
+    return _Watched(multihost_utils)
+
+
+class _Watched:
+    """Wrap the multihost module so every cross-host collective is
+    tracked by the comm watchdog (reference CommTaskManager)."""
+
+    def __init__(self, mh):
+        self._mh = mh
+
+    def __getattr__(self, name):
+        fn = getattr(self._mh, name)
+
+        def call(*a, **k):
+            from .watchdog import comm_guard
+            with comm_guard(name):
+                return fn(*a, **k)
+        return call
 
 
 # -- eager collectives ------------------------------------------------------
@@ -298,7 +315,9 @@ def _recv_at(tensor, src, seq):
     from .. import flags
     timeout_ms = 1000 * int(flags.flag("comm_timeout_seconds"))
     key = f"ptpu_p2p/{src}/{get_rank()}/{seq}"
-    payload = client.blocking_key_value_get(key, timeout_ms)
+    from .watchdog import comm_guard
+    with comm_guard("recv", f"src={src} seq={seq}"):
+        payload = client.blocking_key_value_get(key, timeout_ms)
     try:
         client.key_value_delete(key)  # free the coordinator's copy
     except Exception:  # noqa: BLE001 — cleanup is best-effort

@@ -1,0 +1,199 @@
+"""Parameter server (reference: paddle/fluid/distributed/ps/ —
+BrpcPsClient/BrpcPsServer ps/service/brpc_ps_client.h,
+MemorySparseTable ps/table/memory_sparse_table.h, accessors, async/geo
+communicator; python fleet/runtime/the_one_ps.py).
+
+TPU-native stance (SURVEY §7.2 M8: PS is CPU/brpc-shaped — "implement
+the table/accessor API over host CPUs + DCN"): tables live in host
+memory on server ranks; the brpc transport is replaced by
+paddle.distributed.rpc (coordinator-KV channel). Sparse rows initialize
+on first pull (reference CtrCommonAccessor lazy init) and apply
+SGD-with-decay on push. Dense training belongs on the TPU path — this
+serves the huge-embedding recommender workloads the reference's PS
+exists for."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["MemorySparseTable", "MemoryDenseTable", "PsServer", "PsClient",
+           "SparseAccessor"]
+
+
+class SparseAccessor:
+    """reference ps/table/ctr_accessor.h (simplified): per-row value
+    layout + init + update rule."""
+
+    def __init__(self, embedding_dim, init_std=0.01, learning_rate=0.05,
+                 decay_rate=0.0, seed=0):
+        self.dim = embedding_dim
+        self.init_std = init_std
+        self.lr = learning_rate
+        self.decay = decay_rate
+        self._rng = np.random.default_rng(seed)
+
+    def init_row(self):
+        return (self._rng.standard_normal(self.dim)
+                * self.init_std).astype(np.float32)
+
+    def update(self, row, grad):
+        if self.decay:
+            row *= (1.0 - self.decay)
+        row -= self.lr * grad
+        return row
+
+
+class MemorySparseTable:
+    """reference memory_sparse_table.h — id → embedding row, lazy init,
+    thread-safe (the reference shards by id hash across threads)."""
+
+    def __init__(self, embedding_dim, accessor=None, **accessor_kwargs):
+        self.accessor = accessor or SparseAccessor(embedding_dim,
+                                                   **accessor_kwargs)
+        self._rows: dict[int, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    def pull(self, ids):
+        ids = np.asarray(ids).reshape(-1)
+        with self._lock:
+            out = np.stack([self._rows.setdefault(
+                int(i), self.accessor.init_row()) for i in ids])
+        return out
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        with self._lock:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                row = self._rows.setdefault(i, self.accessor.init_row())
+                self._rows[i] = self.accessor.update(row, g)
+
+    def size(self):
+        with self._lock:
+            return len(self._rows)
+
+    def save(self, path):
+        with self._lock:
+            np.savez(path, ids=np.array(list(self._rows), np.int64),
+                     rows=np.stack(list(self._rows.values()))
+                     if self._rows else np.zeros((0, self.accessor.dim)))
+
+    def load(self, path):
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        with self._lock:
+            self._rows = {int(i): r.astype(np.float32)
+                          for i, r in zip(data["ids"], data["rows"])}
+
+
+class MemoryDenseTable:
+    """reference ps/table/memory_dense_table.h — one dense param block."""
+
+    def __init__(self, shape, learning_rate=0.05, seed=0):
+        rng = np.random.default_rng(seed)
+        self._value = (rng.standard_normal(shape) * 0.01).astype(np.float32)
+        self.lr = learning_rate
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self._value.copy()
+
+    def push(self, grad):
+        with self._lock:
+            self._value -= self.lr * np.asarray(grad, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# server / client over paddle.distributed.rpc
+# ---------------------------------------------------------------------------
+_SERVER_TABLES: dict[int, object] = {}
+
+
+def _srv_register_sparse(table_id, dim, kwargs):
+    _SERVER_TABLES[table_id] = MemorySparseTable(dim, **kwargs)
+    return True
+
+
+def _srv_register_dense(table_id, shape, lr):
+    _SERVER_TABLES[table_id] = MemoryDenseTable(shape, lr)
+    return True
+
+
+def _srv_pull_sparse(table_id, ids):
+    return _SERVER_TABLES[table_id].pull(ids)
+
+
+def _srv_push_sparse(table_id, ids, grads):
+    _SERVER_TABLES[table_id].push(ids, grads)
+    return True
+
+
+def _srv_pull_dense(table_id):
+    return _SERVER_TABLES[table_id].pull()
+
+
+def _srv_push_dense(table_id, grad):
+    _SERVER_TABLES[table_id].push(grad)
+    return True
+
+
+def _srv_table_size(table_id):
+    return _SERVER_TABLES[table_id].size()
+
+
+class PsServer:
+    """reference BrpcPsServer — hosts tables, serves pull/push. Runs on
+    the rpc worker registered as ``name`` (default 'ps_server_0')."""
+
+    def __init__(self, name="ps_server_0", rank=None, world_size=None):
+        from .. import rpc
+        self.name = name
+        if rpc._STATE["store"] is None:
+            rpc.init_rpc(name, rank=rank, world_size=world_size)
+
+    def stop(self):
+        from .. import rpc
+        rpc.shutdown()
+
+
+class PsClient:
+    """reference BrpcPsClient — pull/push against a server by rpc name."""
+
+    def __init__(self, server_name="ps_server_0"):
+        from .. import rpc
+        self._rpc = rpc
+        self.server = server_name
+
+    def create_sparse_table(self, table_id, embedding_dim, **kwargs):
+        return self._rpc.rpc_sync(self.server, _srv_register_sparse,
+                                  args=(table_id, embedding_dim, kwargs))
+
+    def create_dense_table(self, table_id, shape, learning_rate=0.05):
+        return self._rpc.rpc_sync(self.server, _srv_register_dense,
+                                  args=(table_id, shape, learning_rate))
+
+    def pull_sparse(self, table_id, ids):
+        return self._rpc.rpc_sync(self.server, _srv_pull_sparse,
+                                  args=(table_id, np.asarray(ids)))
+
+    def push_sparse(self, table_id, ids, grads, sync=True):
+        fut = self._rpc.rpc_async(self.server, _srv_push_sparse,
+                                  args=(table_id, np.asarray(ids),
+                                        np.asarray(grads)))
+        return fut.wait() if sync else fut
+
+    def pull_dense(self, table_id):
+        return self._rpc.rpc_sync(self.server, _srv_pull_dense,
+                                  args=(table_id,))
+
+    def push_dense(self, table_id, grad, sync=True):
+        fut = self._rpc.rpc_async(self.server, _srv_push_dense,
+                                  args=(table_id, np.asarray(grad)))
+        return fut.wait() if sync else fut
+
+    def table_size(self, table_id):
+        return self._rpc.rpc_sync(self.server, _srv_table_size,
+                                  args=(table_id,))

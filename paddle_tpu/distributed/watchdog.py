@@ -1,0 +1,118 @@
+"""Collective hang watchdog (reference: paddle/phi/core/distributed/
+comm_task_manager.h:37 CommTaskManager — a thread watching in-flight
+NCCLCommTasks with a 30-min default timeout, nccl_comm_task.h:32
+IsTimeout:52, store-based error propagation trace_utils.h).
+
+TPU-native: compiled collectives can't hang partially (XLA programs
+complete or the runtime errors), but eager DCN collectives (the
+communication module's multihost paths, KV-store p2p) CAN stall when a
+peer dies. ``CommWatchdog`` tracks entry/exit of every eager collective
+and a daemon thread flags any op outstanding past the timeout — logging
+the op, peer info, and elapsed time, then optionally raising in the
+stalled thread via an exception callback."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["CommWatchdog", "comm_guard", "get_watchdog"]
+
+
+class _Inflight:
+    __slots__ = ("name", "start", "thread", "detail")
+
+    def __init__(self, name, detail):
+        self.name = name
+        self.start = time.monotonic()
+        self.thread = threading.current_thread().name
+        self.detail = detail
+
+
+class CommWatchdog:
+    """reference CommTaskManager — singleton watcher over eager comm."""
+
+    def __init__(self, timeout_s: float | None = None, poll_s: float = 5.0,
+                 on_timeout=None):
+        from .. import flags
+        self.timeout_s = (timeout_s if timeout_s is not None
+                          else float(flags.flag("comm_timeout_seconds")))
+        self.poll_s = poll_s
+        self.on_timeout = on_timeout
+        self._inflight: dict[int, _Inflight] = {}
+        self._next = 0
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        self.timed_out: list[dict] = []
+
+    # -- tracking -----------------------------------------------------------
+    def enter(self, name, detail="") -> int:
+        with self._lock:
+            self._next += 1
+            tid = self._next
+            self._inflight[tid] = _Inflight(name, detail)
+            self._ensure_thread()
+        return tid
+
+    def exit(self, tid: int) -> None:
+        with self._lock:
+            self._inflight.pop(tid, None)
+
+    # -- watching -----------------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._watch, daemon=True)
+            self._thread.start()
+
+    def _watch(self):
+        while not self._stop.wait(self.poll_s):
+            now = time.monotonic()
+            with self._lock:
+                stalled = [t for t in self._inflight.values()
+                           if now - t.start > self.timeout_s]
+            for t in stalled:
+                info = {"op": t.name, "thread": t.thread,
+                        "elapsed_s": round(now - t.start, 1),
+                        "detail": t.detail}
+                self.timed_out.append(info)
+                print(f"[comm watchdog] collective {t.name!r} outstanding "
+                      f"{info['elapsed_s']}s (> {self.timeout_s}s) on "
+                      f"thread {t.thread} {t.detail} — a peer is likely "
+                      f"down (reference CommTaskManager would abort the "
+                      f"communicator)")
+                if self.on_timeout is not None:
+                    self.on_timeout(info)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1)
+
+
+_WATCHDOG: list[CommWatchdog | None] = [None]
+
+
+def get_watchdog() -> CommWatchdog:
+    if _WATCHDOG[0] is None:
+        _WATCHDOG[0] = CommWatchdog()
+    return _WATCHDOG[0]
+
+
+class comm_guard:
+    """Context manager wrapping one eager collective with watchdog
+    tracking (used by the communication module's multihost paths)."""
+
+    def __init__(self, name, detail=""):
+        self.name = name
+        self.detail = detail
+        self._tid = None
+
+    def __enter__(self):
+        self._tid = get_watchdog().enter(self.name, self.detail)
+        return self
+
+    def __exit__(self, *exc):
+        get_watchdog().exit(self._tid)
+        return False

@@ -1,0 +1,85 @@
+"""Parameter server + comm watchdog tests (reference: test/ps/,
+dist_fleet_ctr.py subprocess harness; CommTaskManager watchdog)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import ps, rpc, watchdog
+
+
+class TestSparseTable:
+    def test_lazy_init_and_update(self):
+        t = ps.MemorySparseTable(8, learning_rate=0.5, init_std=0.0)
+        rows = t.pull([3, 7, 3])
+        assert rows.shape == (3, 8)
+        np.testing.assert_allclose(rows, 0.0)     # init_std 0
+        t.push([3], np.ones((1, 8)))
+        np.testing.assert_allclose(t.pull([3]), -0.5)
+        assert t.size() == 2
+
+    def test_save_load(self, tmp_path):
+        t = ps.MemorySparseTable(4, init_std=0.1)
+        t.pull([1, 2, 3])
+        t.save(str(tmp_path / "table"))
+        t2 = ps.MemorySparseTable(4)
+        t2.load(str(tmp_path / "table"))
+        assert t2.size() == 3
+        np.testing.assert_allclose(t2.pull([1]), t.pull([1]))
+
+    def test_dense_table(self):
+        t = ps.MemoryDenseTable([4, 2], learning_rate=1.0, seed=0)
+        v0 = t.pull()
+        t.push(np.ones((4, 2)))
+        np.testing.assert_allclose(t.pull(), v0 - 1.0, rtol=1e-6)
+
+
+class TestPsOverRpc:
+    def test_client_server_roundtrip(self):
+        server = ps.PsServer("ps0", rank=0, world_size=1)
+        try:
+            client = ps.PsClient("ps0")
+            client.create_sparse_table(0, embedding_dim=8, init_std=0.0,
+                                       learning_rate=0.1)
+            vals = client.pull_sparse(0, [5, 9])
+            assert vals.shape == (2, 8)
+            client.push_sparse(0, [5], np.ones((1, 8)))
+            np.testing.assert_allclose(client.pull_sparse(0, [5]), -0.1,
+                                       rtol=1e-5)
+            assert client.table_size(0) == 2
+            client.create_dense_table(1, [3], learning_rate=1.0)
+            d0 = client.pull_dense(1)
+            client.push_dense(1, np.ones(3))
+            np.testing.assert_allclose(client.pull_dense(1), d0 - 1,
+                                       rtol=1e-5)
+        finally:
+            server.stop()
+
+
+class TestWatchdog:
+    def test_flags_stalled_collective(self):
+        events = []
+        wd = watchdog.CommWatchdog(timeout_s=0.1, poll_s=0.05,
+                                   on_timeout=events.append)
+        tid = wd.enter("all_reduce", "test")
+        time.sleep(0.3)
+        assert wd.timed_out and wd.timed_out[0]["op"] == "all_reduce"
+        assert events
+        wd.exit(tid)
+        wd.stop()
+
+    def test_fast_op_not_flagged(self):
+        wd = watchdog.CommWatchdog(timeout_s=5.0, poll_s=0.05)
+        tid = wd.enter("broadcast")
+        wd.exit(tid)
+        time.sleep(0.15)
+        assert not wd.timed_out
+        wd.stop()
+
+    def test_comm_guard(self):
+        from paddle_tpu.distributed.watchdog import comm_guard, get_watchdog
+        with comm_guard("allgather"):
+            assert get_watchdog()._inflight
+        assert not get_watchdog()._inflight
