@@ -31,9 +31,14 @@ __all__ = ["apply_op", "defop", "OP_REGISTRY", "register_op"]
 # per op, keyed by name; "backend" selection is jax's own (TPU vs CPU).
 OP_REGISTRY: dict[str, Callable] = {}
 
+# Per-op metadata recorded at registration (differentiability etc.) —
+# consumed by the schema generator (ops/schema.py).
+OP_META: dict[str, dict] = {}
 
-def register_op(name: str, fn: Callable) -> None:
+
+def register_op(name: str, fn: Callable, differentiable: bool = True) -> None:
     OP_REGISTRY[name] = fn
+    OP_META[name] = {"differentiable": differentiable}
 
 
 # Observers called as f(op_name) on every dispatch — the hook point for the
@@ -140,7 +145,7 @@ def defop(name: str, differentiable: bool = True):
     reachable as ``op.raw`` for use inside other kernels and jit tracing.
     """
     def deco(fn: Callable):
-        register_op(name, fn)
+        register_op(name, fn, differentiable)
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):

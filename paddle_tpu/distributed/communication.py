@@ -313,7 +313,10 @@ def _recv_at(tensor, src, seq):
     import base64
     client = _kv_client()
     from .. import flags
-    timeout_ms = 1000 * int(flags.flag("comm_timeout_seconds"))
+    # transport timeout 2x the watchdog threshold so the watchdog flags a
+    # stalled peer BEFORE the blocking get raises (reference
+    # CommTaskManager reports, then the comm op aborts)
+    timeout_ms = 2000 * int(flags.flag("comm_timeout_seconds"))
     key = f"ptpu_p2p/{src}/{get_rank()}/{seq}"
     from .watchdog import comm_guard
     with comm_guard("recv", f"src={src} seq={seq}"):

@@ -58,9 +58,13 @@ class MemorySparseTable:
     def pull(self, ids):
         ids = np.asarray(ids).reshape(-1)
         with self._lock:
-            out = np.stack([self._rows.setdefault(
-                int(i), self.accessor.init_row()) for i in ids])
-        return out
+            out = []
+            for i in ids:
+                row = self._rows.get(int(i))
+                if row is None:     # lazy init only for cold ids
+                    row = self._rows[int(i)] = self.accessor.init_row()
+                out.append(row)
+        return np.stack(out)
 
     def push(self, ids, grads):
         ids = np.asarray(ids).reshape(-1)
@@ -68,7 +72,9 @@ class MemorySparseTable:
         with self._lock:
             for i, g in zip(ids, grads):
                 i = int(i)
-                row = self._rows.setdefault(i, self.accessor.init_row())
+                row = self._rows.get(i)
+                if row is None:
+                    row = self._rows[i] = self.accessor.init_row()
                 self._rows[i] = self.accessor.update(row, g)
 
     def size(self):
@@ -149,14 +155,23 @@ class PsServer:
     the rpc worker registered as ``name`` (default 'ps_server_0')."""
 
     def __init__(self, name="ps_server_0", rank=None, world_size=None):
+        import pickle
+
         from .. import rpc
         self.name = name
-        if rpc._STATE["store"] is None:
+        self._owns_rpc = rpc._STATE["store"] is None
+        if self._owns_rpc:
             rpc.init_rpc(name, rank=rank, world_size=world_size)
+        else:
+            # rpc already serving under another worker name: add this
+            # name to the directory so PsClient(name) resolves here
+            rpc._STATE["store"].set(f"rpc/name/{name}",
+                                    pickle.dumps(rpc._STATE["rank"]))
 
     def stop(self):
         from .. import rpc
-        rpc.shutdown()
+        if self._owns_rpc:     # don't tear down a shared rpc runtime
+            rpc.shutdown()
 
 
 class PsClient:

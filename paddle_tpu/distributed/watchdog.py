@@ -20,13 +20,14 @@ __all__ = ["CommWatchdog", "comm_guard", "get_watchdog"]
 
 
 class _Inflight:
-    __slots__ = ("name", "start", "thread", "detail")
+    __slots__ = ("name", "start", "thread", "detail", "flagged")
 
     def __init__(self, name, detail):
         self.name = name
         self.start = time.monotonic()
         self.thread = threading.current_thread().name
         self.detail = detail
+        self.flagged = False   # report each stalled op once
 
 
 class CommWatchdog:
@@ -71,7 +72,10 @@ class CommWatchdog:
             now = time.monotonic()
             with self._lock:
                 stalled = [t for t in self._inflight.values()
-                           if now - t.start > self.timeout_s]
+                           if now - t.start > self.timeout_s
+                           and not t.flagged]
+                for t in stalled:
+                    t.flagged = True
             for t in stalled:
                 info = {"op": t.name, "thread": t.thread,
                         "elapsed_s": round(now - t.start, 1),

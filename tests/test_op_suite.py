@@ -842,3 +842,21 @@ class TestDftMatmulPath:
                         "irfft")
         np.testing.assert_allclose(np.asarray(out), np.fft.irfftn(h, [4, 6]),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestOpSchema:
+    """ops.yaml is the checked-in single-source contract (reference
+    phi/api/yaml/ops.yaml); it must never drift from the live registry."""
+
+    def test_schema_in_sync_with_registry(self):
+        from paddle_tpu.ops import schema
+        assert schema.generate() == schema.load_schema()
+
+    def test_schema_covers_registry(self):
+        from paddle_tpu.ops import schema
+        data = schema.load_schema()
+        assert data["op_count"] == len(OP_REGISTRY)
+        assert set(data["ops"]) == set(OP_REGISTRY)
+        # differentiability recorded faithfully for known cases
+        assert data["ops"]["matmul"]["differentiable"] is True
+        assert data["ops"]["argmax"]["differentiable"] is False
