@@ -67,3 +67,23 @@ class mix_precision_utils:
 
 
 from .recompute import recompute  # noqa: E402  (reference re-exports here)
+
+
+def get_logger(name="paddle_tpu", level=None, fmt=None):
+    """reference fleet/utils/log_util.py get_logger — namespaced logger
+    honoring FLAGS_log_level."""
+    import logging
+
+    from ... import flags
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(
+            fmt or "%(asctime)s %(levelname)s [%(name)s] %(message)s"))
+        logger.addHandler(handler)
+        logger.propagate = False
+    if level is None:
+        level = logging.DEBUG if flags.flag("log_level") > 0 \
+            else logging.INFO
+    logger.setLevel(level)
+    return logger

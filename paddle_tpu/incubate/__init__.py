@@ -2,5 +2,7 @@
 ops under nn/functional, MoE models, extra optimizers)."""
 
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
-__all__ = ["nn"]
+__all__ = ["nn", "optimizer", "LookAhead", "ModelAverage"]

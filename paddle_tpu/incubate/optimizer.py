@@ -1,0 +1,122 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/ —
+lookahead.py LookAhead:25, modelaverage.py ModelAverage,
+gradient_merge.py / fleet GradientMergeOptimizer).
+
+All three are wrappers over an inner optimizer operating on the same
+Parameter objects; the wrapped math is pure jnp so it runs on-device and
+composes with DistTrainStep."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage", "GradientMergeOptimizer"]
+
+
+class LookAhead:
+    """reference lookahead.py:25 — slow weights track fast weights every k
+    steps: slow += alpha * (fast - slow); fast <- slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        # copies, not views: the inner optimizer's jitted update DONATES
+        # the old parameter buffers, which would delete captured values
+        self._slow = {id(p): jnp.copy(p._value)
+                      for p in inner_optimizer._parameter_list}
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self.inner_optimizer._parameter_list:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._value - slow)
+                self._slow[id(p)] = slow
+                # hand the param a SEPARATE buffer: the next inner step
+                # donates the param's buffer, which must not be _slow's
+                p._in_place_update(jnp.copy(slow))
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        state = self.inner_optimizer.state_dict()
+        state["lookahead_step"] = self._step_count
+        return state
+
+
+class ModelAverage:
+    """reference modelaverage.py — running average of parameters applied
+    for evaluation via apply()/restore()."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sums = {id(p): jnp.zeros_like(p._value) for p in self._params}
+        self._counts = {id(p): 0 for p in self._params}
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current weights (call after optimizer.step)."""
+        for p in self._params:
+            self._sums[id(p)] = self._sums[id(p)] + p._value
+            self._counts[id(p)] += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap in averaged weights (context-style: restore() undoes)."""
+        self._backup = {id(p): jnp.copy(p._value) for p in self._params}
+        for p in self._params:
+            c = max(self._counts[id(p)], 1)
+            p._in_place_update(self._sums[id(p)] / c)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._in_place_update(self._backup[id(p)])
+        self._backup = None
+
+
+class GradientMergeOptimizer:
+    """reference fleet/meta_optimizers/gradient_merge_optimizer.py — only
+    every k-th backward triggers an optimizer step; earlier grads
+    accumulate (our Tensor grads already accumulate across backwards, so
+    the wrapper gates step/clear and optionally averages)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._count = 0
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def step(self):
+        self._count += 1
+        if self._count % self.k_steps != 0:
+            return                        # keep accumulating
+        if self.avg and self.k_steps > 1:
+            for p in self.inner_optimizer._parameter_list:
+                if p.grad is not None:
+                    p.grad._in_place_update(p.grad._value / self.k_steps)
+        self.inner_optimizer.step()
+        self.inner_optimizer.clear_grad()
+
+    def clear_grad(self, set_to_zero=False):
+        # grads are cleared internally on the merged step; explicit calls
+        # between merge boundaries would drop accumulation
+        if self._count % self.k_steps == 0:
+            self.inner_optimizer.clear_grad(set_to_zero)

@@ -133,3 +133,77 @@ class TestFleetWrappers:
             np.testing.assert_allclose(_np(cls(net)(x)), want, rtol=1e-6)
         sp = SegmentParallel(net)
         np.testing.assert_allclose(_np(sp(x)), want, rtol=1e-6)
+
+
+class TestIncubateOptimizers:
+    def _net_and_data(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .rand(8, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.randint(0, 2, (8,)))
+        return net, x, y
+
+    def test_lookahead_syncs_slow_weights(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.incubate import LookAhead
+        net, x, y = self._net_and_data()
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=2)
+        w0 = np.asarray(net.weight._value).copy()
+        losses = []
+        for _ in range(4):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        # after k-multiples the fast weights equal the slow weights
+        np.testing.assert_allclose(np.asarray(net.weight._value),
+                                   opt._slow[id(net.weight)])
+        assert not np.allclose(np.asarray(net.weight._value), w0)
+
+    def test_model_average_apply_restore(self):
+        from paddle_tpu.incubate import ModelAverage
+        net, x, y = self._net_and_data()
+        ma = ModelAverage(parameters=net.parameters())
+        vals = []
+        for i in range(3):
+            net.weight._in_place_update(net.weight._value + 1.0)
+            ma.step()
+            vals.append(np.asarray(net.weight._value).copy())
+        cur = np.asarray(net.weight._value).copy()
+        ma.apply()
+        np.testing.assert_allclose(np.asarray(net.weight._value),
+                                   np.mean(vals, axis=0), rtol=1e-6)
+        ma.restore()
+        np.testing.assert_allclose(np.asarray(net.weight._value), cur)
+
+    def test_gradient_merge_accumulates(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.incubate.optimizer import GradientMergeOptimizer
+        net, x, y = self._net_and_data()
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net.parameters())
+        opt = GradientMergeOptimizer(inner, k_steps=2, avg=True)
+        w0 = np.asarray(net.weight._value).copy()
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()                      # step 1: no update yet
+        np.testing.assert_allclose(np.asarray(net.weight._value), w0)
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()                      # step 2: merged update fires
+        assert not np.allclose(np.asarray(net.weight._value), w0)
+        # merged-averaged step == single step on same data (same grads)
+        g_equiv = w0 - np.asarray(net.weight._value)
+        assert np.abs(g_equiv).max() > 0
+
+    def test_get_logger(self):
+        from paddle_tpu.distributed.fleet.utils import get_logger
+        lg = get_logger("t_unit")
+        lg.info("hello")
+        assert lg.name == "t_unit"
