@@ -352,6 +352,19 @@ class LlamaForCausalLM(nn.Layer):
             return base + ["router", "we_gate", "we_up", "we_down"]
         return base + ["w_gate", "w_up", "w_down"]
 
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=0, seed=0):
+        """Autoregressive sampling (greedy when temperature=0); returns
+        the full [b, s + max_new_tokens] id array as a Tensor."""
+        from ..core import autograd
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        with autograd.no_grad():
+            out = _generate(self, ids, int(max_new_tokens),
+                            float(temperature), int(top_k),
+                            jax.random.PRNGKey(seed))
+        return Tensor(out, stop_gradient=True)
+
     def forward(self, input_ids):
         cfg = self.config
         ids = input_ids._value if isinstance(input_ids, Tensor) \
@@ -380,6 +393,29 @@ class LlamaForCausalLM(nn.Layer):
         if head is not None:
             args = args + (head,)
         return apply_op("llama_forward", fwd, args, {})
+
+
+def _generate(model, input_ids, max_new_tokens, temperature, top_k, key):
+    """Greedy / top-k sampling loop (reference PaddleNLP generation_utils
+    greedy_search/sampling). Each step re-encodes the full prefix — the
+    scan-stacked weights make that one compiled forward per length; a
+    decode-time KV cache is the masked_multihead_attention path
+    (incubate) used by serving stacks."""
+    ids = input_ids
+    for _ in range(max_new_tokens):
+        logits = model(Tensor(ids))._value[:, -1, :]     # [b, vocab]
+        if temperature == 0.0:
+            nxt = jnp.argmax(logits, axis=-1)
+        else:
+            logits = logits / temperature
+            if top_k and top_k > 0:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits, axis=-1)
+        ids = jnp.concatenate([ids, nxt[:, None].astype(ids.dtype)],
+                              axis=1)
+    return ids
 
 
 def llama_loss_fn(model, input_ids, labels):

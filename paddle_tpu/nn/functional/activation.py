@@ -234,18 +234,20 @@ def maxout(x, groups, axis=1, name=None):
     return _maxout(_t(x), groups=groups, axis=axis)
 
 
+@defop("gumbel_softmax")
+def _gs(x, g, temperature, hard, axis):
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        y = jax.lax.stop_gradient(y_hard - y) + y
+    return y
+
+
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from ...ops.random import next_key
     x = _t(x)
     g = jax.random.gumbel(next_key(), tuple(x.shape), x._value.dtype)
 
-    @defop("gumbel_softmax")
-    def _gs(x, g, temperature, hard, axis):
-        y = jax.nn.softmax((x + g) / temperature, axis=axis)
-        if hard:
-            idx = jnp.argmax(y, axis=axis, keepdims=True)
-            y_hard = jnp.zeros_like(y)
-            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
-            y = jax.lax.stop_gradient(y_hard - y) + y
-        return y
     return _gs(x, Tensor(g), temperature=temperature, hard=hard, axis=axis)

@@ -105,10 +105,12 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** 0.5)
     b = -a * alpha_p * p
 
-    @defop("alpha_dropout")
-    def _ad(x, keep, a, b, alpha_p):
-        return a * jnp.where(keep, x, alpha_p) + b
-    return _ad(x, Tensor(keep), a=a, b=b, alpha_p=alpha_p)
+    return _alpha_dropout_op(x, Tensor(keep), a=a, b=b, alpha_p=alpha_p)
+
+
+@defop("alpha_dropout")
+def _alpha_dropout_op(x, keep, a, b, alpha_p):
+    return a * jnp.where(keep, x, alpha_p) + b
 
 
 @defop("pad_op")
@@ -297,74 +299,77 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
     raise NotImplementedError("fold: planned (inverse of unfold)")
 
 
+@defop("affine_grid")
+def _ag(theta, H, W, align_corners):
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+    else:
+        ys = (jnp.arange(H) + 0.5) / H * 2 - 1
+        xs = (jnp.arange(W) + 0.5) / W * 2 - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+    return jnp.einsum("hwk,njk->nhwj", base, theta)
+
+
 def affine_grid(theta, out_shape, align_corners=True, name=None):
     theta = _t(theta)
-    N, C, H, W = [int(s) for s in (out_shape.tolist() if isinstance(out_shape, Tensor) else out_shape)]
-
-    @defop("affine_grid")
-    def _ag(theta, H, W, align_corners):
-        if align_corners:
-            ys = jnp.linspace(-1.0, 1.0, H)
-            xs = jnp.linspace(-1.0, 1.0, W)
-        else:
-            ys = (jnp.arange(H) + 0.5) / H * 2 - 1
-            xs = (jnp.arange(W) + 0.5) / W * 2 - 1
-        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
-        ones = jnp.ones_like(gx)
-        base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
-        return jnp.einsum("hwk,njk->nhwj", base, theta)
+    N, C, H, W = [int(s) for s in (out_shape.tolist() if isinstance(
+        out_shape, Tensor) else out_shape)]
     return _ag(theta, H=H, W=W, align_corners=align_corners)
+
+
+@defop("grid_sample")
+def _gs(x, grid, align_corners):
+    N, C, H, W = x.shape
+    gx = (grid[..., 0] + 1) * (W - 1) / 2 if align_corners else \
+        ((grid[..., 0] + 1) * W - 1) / 2
+    gy = (grid[..., 1] + 1) * (H - 1) / 2 if align_corners else \
+        ((grid[..., 1] + 1) * H - 1) / 2
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yy, xx):
+        yy = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xx = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        flat = x.reshape(N, C, H * W)
+        idx = (yy * W + xx).reshape(N, 1, -1)
+        out = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (N, C, idx.shape[-1])), axis=2)
+        return out.reshape(N, C, *gx.shape[1:])
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx = wx[:, None]
+    wy = wy[:, None]
+    return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+            + v10 * (1 - wx) * wy + v11 * wx * wy)
 
 
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 align_corners=True, name=None):
     x, grid = _t(x), _t(grid)
-
-    @defop("grid_sample")
-    def _gs(x, grid, align_corners):
-        N, C, H, W = x.shape
-        gx = (grid[..., 0] + 1) * (W - 1) / 2 if align_corners else \
-            ((grid[..., 0] + 1) * W - 1) / 2
-        gy = (grid[..., 1] + 1) * (H - 1) / 2 if align_corners else \
-            ((grid[..., 1] + 1) * H - 1) / 2
-        x0 = jnp.floor(gx)
-        y0 = jnp.floor(gy)
-        wx = gx - x0
-        wy = gy - y0
-
-        def gather(yy, xx):
-            yy = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
-            xx = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
-            flat = x.reshape(N, C, H * W)
-            idx = (yy * W + xx).reshape(N, 1, -1)
-            out = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (N, C, idx.shape[-1])), axis=2)
-            return out.reshape(N, C, *gx.shape[1:])
-        v00 = gather(y0, x0)
-        v01 = gather(y0, x0 + 1)
-        v10 = gather(y0 + 1, x0)
-        v11 = gather(y0 + 1, x0 + 1)
-        wx = wx[:, None]
-        wy = wy[:, None]
-        return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
-                + v10 * (1 - wx) * wy + v11 * wx * wy)
     return _gs(x, grid, align_corners=align_corners)
 
 
-def npair_loss(anchor, positive, labels, l2_reg=0.002):
-    from . import loss as L
-    anchor, positive = _t(anchor), _t(positive)
+@defop("npair_loss")
+def _np(anchor, positive, labels, l2_reg):
+    reg = l2_reg * (jnp.sum(anchor * anchor) + jnp.sum(positive * positive)) \
+        / anchor.shape[0] * 0.25
+    sim = anchor @ positive.T
+    lab = labels.reshape(-1, 1) == labels.reshape(1, -1)
+    lab = lab.astype(sim.dtype)
+    lab = lab / jnp.sum(lab, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(lab * logp, axis=1))
+    return ce + reg
 
-    @defop("npair_loss")
-    def _np(anchor, positive, labels, l2_reg):
-        reg = l2_reg * (jnp.sum(anchor * anchor) + jnp.sum(positive * positive)) \
-            / anchor.shape[0] * 0.25
-        sim = anchor @ positive.T
-        lab = labels.reshape(-1, 1) == labels.reshape(1, -1)
-        lab = lab.astype(sim.dtype)
-        lab = lab / jnp.sum(lab, axis=1, keepdims=True)
-        logp = jax.nn.log_softmax(sim, axis=1)
-        ce = -jnp.mean(jnp.sum(lab * logp, axis=1))
-        return ce + reg
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    anchor, positive = _t(anchor), _t(positive)
     return _np(anchor, positive, _t(labels), l2_reg=l2_reg)
 
 

@@ -340,31 +340,35 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
     return _dice(_t(input), lbl, epsilon=epsilon)
 
 
+@defop("ctc_loss")
+def _ctc(logits, labels, input_lengths, label_lengths, blank, reduction):
+    import optax
+
+    # optax expects [B, T, C] logits and [B, N] labels with 0 = pad
+    logits_btc = jnp.swapaxes(logits, 0, 1)
+    B, T, C = logits_btc.shape
+    labels = labels.astype(jnp.int32)
+    N = labels.shape[1]
+    logit_pad = (jnp.arange(T)[None, :] >= input_lengths[:, None]).astype(jnp.float32)
+    label_pad = (jnp.arange(N)[None, :] >= label_lengths[:, None]).astype(jnp.float32)
+    per_seq = optax.ctc_loss(logits_btc, logit_pad, labels, label_pad,
+                             blank_id=blank)
+    if reduction == "mean":
+        return jnp.mean(per_seq / jnp.maximum(label_lengths, 1))
+    if reduction == "sum":
+        return jnp.sum(per_seq)
+    return per_seq
+
+
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
     """CTC via optax's implementation pattern (forward algorithm in log space)."""
-    import optax
     lp = _t(log_probs)  # [T, B, C] paddle layout
-
-    @defop("ctc_loss")
-    def _ctc(logits, labels, input_lengths, label_lengths, blank, reduction):
-        # optax expects [B, T, C] logits and [B, N] labels with 0 = pad
-        logits_btc = jnp.swapaxes(logits, 0, 1)
-        B, T, C = logits_btc.shape
-        labels = labels.astype(jnp.int32)
-        N = labels.shape[1]
-        logit_pad = (jnp.arange(T)[None, :] >= input_lengths[:, None]).astype(jnp.float32)
-        label_pad = (jnp.arange(N)[None, :] >= label_lengths[:, None]).astype(jnp.float32)
-        per_seq = optax.ctc_loss(logits_btc, logit_pad, labels, label_pad,
-                                 blank_id=blank)
-        if reduction == "mean":
-            return jnp.mean(per_seq / jnp.maximum(label_lengths, 1))
-        if reduction == "sum":
-            return jnp.sum(per_seq)
-        return per_seq
     lab = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
-    il = input_lengths._value if isinstance(input_lengths, Tensor) else jnp.asarray(input_lengths)
-    ll = label_lengths._value if isinstance(label_lengths, Tensor) else jnp.asarray(label_lengths)
+    il = input_lengths._value if isinstance(input_lengths, Tensor) \
+        else jnp.asarray(input_lengths)
+    ll = label_lengths._value if isinstance(label_lengths, Tensor) \
+        else jnp.asarray(label_lengths)
     return _ctc(lp, lab, il, ll, blank=blank, reduction=reduction)
 
 

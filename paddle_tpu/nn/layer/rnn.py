@@ -70,6 +70,51 @@ def _rnn_scan(x, h0, wi, wh, bi, bh, activation):
 
 
 # -- cells -----------------------------------------------------------------
+@defop("rnn_cell")
+def _rnn_cell_op(x, h, wi, wh, bi, bh, activation):
+    out, hT = _rnn_scan(x[None], h, wi, wh, bi, bh, activation)
+    return out[0]
+
+
+@defop("lstm_cell")
+def _lstm_cell_op(x, h, c, wi, wh, bi, bh):
+    out, hT, cT = _lstm_scan(x[None], h, c, wi, wh, bi, bh)
+    return out[0], cT
+
+
+@defop("gru_cell")
+def _gru_cell_op(x, h, wi, wh, bi, bh):
+    out, hT = _gru_scan(x[None], h, wi, wh, bi, bh)
+    return out[0]
+
+
+@defop("simple_rnn_layer")
+def _rnn_layer_op(x, wi, wh, bi, bh, h0, reverse, activation):
+    xs = jnp.flip(x, 0) if reverse else x
+    out, hT = _rnn_scan(xs, h0, wi, wh, bi, bh, activation)
+    if reverse:
+        out = jnp.flip(out, 0)
+    return out, hT
+
+
+@defop("lstm_layer")
+def _lstm_layer_op(x, wi, wh, bi, bh, h0, c0, reverse):
+    xs = jnp.flip(x, 0) if reverse else x
+    out, hT, cT = _lstm_scan(xs, h0, c0, wi, wh, bi, bh)
+    if reverse:
+        out = jnp.flip(out, 0)
+    return out, hT, cT
+
+
+@defop("gru_layer")
+def _gru_layer_op(x, wi, wh, bi, bh, h0, reverse):
+    xs = jnp.flip(x, 0) if reverse else x
+    out, hT = _gru_scan(xs, h0, wi, wh, bi, bh)
+    if reverse:
+        out = jnp.flip(out, 0)
+    return out, hT
+
+
 class RNNCellBase(Layer):
     def get_initial_states(self, batch_ref, shape=None, dtype=None,
                            init_value=0.0, batch_dim_idx=0):
@@ -110,12 +155,9 @@ class SimpleRNNCell(RNNCellBase):
         if states is None:
             states = self.get_initial_states(inputs)
 
-        @defop("rnn_cell")
-        def _cell(x, h, wi, wh, bi, bh, activation):
-            out, hT = _rnn_scan(x[None], h, wi, wh, bi, bh, activation)
-            return out[0]
-        h = _cell(_t(inputs), _t(states), self.weight_ih, self.weight_hh,
-                  self.bias_ih, self.bias_hh, activation=self.activation)
+        h = _rnn_cell_op(_t(inputs), _t(states), self.weight_ih,
+                         self.weight_hh, self.bias_ih, self.bias_hh,
+                         activation=self.activation)
         return h, h
 
 
@@ -153,12 +195,8 @@ class LSTMCell(RNNCellBase):
         else:
             h, c = states
 
-        @defop("lstm_cell")
-        def _cell(x, h, c, wi, wh, bi, bh):
-            out, hT, cT = _lstm_scan(x[None], h, c, wi, wh, bi, bh)
-            return out[0], cT
-        h2, c2 = _cell(_t(inputs), _t(h), _t(c), self.weight_ih,
-                       self.weight_hh, self.bias_ih, self.bias_hh)
+        h2, c2 = _lstm_cell_op(_t(inputs), _t(h), _t(c), self.weight_ih,
+                               self.weight_hh, self.bias_ih, self.bias_hh)
         return h2, (h2, c2)
 
 
@@ -193,12 +231,8 @@ class GRUCell(RNNCellBase):
         if states is None:
             states = self.get_initial_states(inputs)
 
-        @defop("gru_cell")
-        def _cell(x, h, wi, wh, bi, bh):
-            out, hT = _gru_scan(x[None], h, wi, wh, bi, bh)
-            return out[0]
-        h = _cell(_t(inputs), _t(states), self.weight_ih, self.weight_hh,
-                  self.bias_ih, self.bias_hh)
+        h = _gru_cell_op(_t(inputs), _t(states), self.weight_ih,
+                         self.weight_hh, self.bias_ih, self.bias_hh)
         return h, h
 
 
@@ -308,15 +342,8 @@ class SimpleRNN(_RNNBase):
     MODE = "RNN_TANH"
 
     def _apply_direction(self, x, wi, wh, bi, bh, h0, c0, reverse):
-        @defop("simple_rnn_layer")
-        def _run(x, wi, wh, bi, bh, h0, reverse, activation):
-            xs = jnp.flip(x, 0) if reverse else x
-            out, hT = _rnn_scan(xs, h0, wi, wh, bi, bh, activation)
-            if reverse:
-                out = jnp.flip(out, 0)
-            return out, hT
-        out, hT = _run(x, wi, wh, bi, bh, h0, reverse=reverse,
-                       activation=self.activation)
+        out, hT = _rnn_layer_op(x, wi, wh, bi, bh, h0, reverse=reverse,
+                                activation=self.activation)
         return out, hT, None
 
 
@@ -324,28 +351,14 @@ class LSTM(_RNNBase):
     MODE = "LSTM"
 
     def _apply_direction(self, x, wi, wh, bi, bh, h0, c0, reverse):
-        @defop("lstm_layer")
-        def _run(x, wi, wh, bi, bh, h0, c0, reverse):
-            xs = jnp.flip(x, 0) if reverse else x
-            out, hT, cT = _lstm_scan(xs, h0, c0, wi, wh, bi, bh)
-            if reverse:
-                out = jnp.flip(out, 0)
-            return out, hT, cT
-        return _run(x, wi, wh, bi, bh, h0, c0, reverse=reverse)
+        return _lstm_layer_op(x, wi, wh, bi, bh, h0, c0, reverse=reverse)
 
 
 class GRU(_RNNBase):
     MODE = "GRU"
 
     def _apply_direction(self, x, wi, wh, bi, bh, h0, c0, reverse):
-        @defop("gru_layer")
-        def _run(x, wi, wh, bi, bh, h0, reverse):
-            xs = jnp.flip(x, 0) if reverse else x
-            out, hT = _gru_scan(xs, h0, wi, wh, bi, bh)
-            if reverse:
-                out = jnp.flip(out, 0)
-            return out, hT
-        out, hT = _run(x, wi, wh, bi, bh, h0, reverse=reverse)
+        out, hT = _gru_layer_op(x, wi, wh, bi, bh, h0, reverse=reverse)
         return out, hT, None
 
 

@@ -125,9 +125,6 @@ def norm(x, p=None, axis=None, keepdim=False, name=None):
     if p is None or p == "fro":
         return _fro_norm(x, axis=axis, keepdim=keepdim)
     if p == "nuc":
-        @defop("nuclear_norm")
-        def _nuc(a):
-            return jnp.sum(jnp.linalg.svd(a, compute_uv=False))
         return _nuc(x)
     return _p_norm(x, p=float(p), axis=axis, keepdim=keepdim)
 
@@ -159,10 +156,12 @@ def cholesky(x, upper=False, name=None):
     return _cholesky(_t(x), upper=upper)
 
 
+@defop("qr")
+def _qr(a, mode):
+    return tuple(jnp.linalg.qr(a, mode=mode))
+
+
 def qr(x, mode="reduced", name=None):
-    @defop("qr")
-    def _qr(a, mode):
-        return tuple(jnp.linalg.qr(a, mode=mode))
     if mode == "r":
         r = jnp.linalg.qr(_t(x)._value, mode="r")
         return Tensor(r)
@@ -170,12 +169,19 @@ def qr(x, mode="reduced", name=None):
     return q, r
 
 
+@defop("svd")
+def _svd(a, full_matrices):
+    u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2)
+
+
 def svd(x, full_matrices=False, name=None):
-    @defop("svd")
-    def _svd(a, full_matrices):
-        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
-        return u, s, jnp.swapaxes(vh, -1, -2)
     return _svd(_t(x), full_matrices=full_matrices)
+
+
+@defop("nuclear_norm")
+def _nuc(a):
+    return jnp.sum(jnp.linalg.svd(a, compute_uv=False))
 
 
 @defop("inverse")
@@ -262,11 +268,13 @@ def det(x, name=None):
     return _det(_t(x))
 
 
+@defop("slogdet")
+def _slogdet(a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet
+
+
 def slogdet(x, name=None):
-    @defop("slogdet")
-    def _slogdet(a):
-        sign, logdet = jnp.linalg.slogdet(a)
-        return sign, logdet
     sign, logdet = _slogdet(_t(x))
     from .manipulation import stack
     return stack([sign, logdet], axis=0)
@@ -284,18 +292,22 @@ def eigvals(x, name=None):
     return Tensor(jnp.asarray(w))
 
 
+@defop("eigh")
+def _eigh(a, UPLO):
+    w, v = jnp.linalg.eigh(a, UPLO=UPLO)
+    return w, v
+
+
 def eigh(x, UPLO="L", name=None):
-    @defop("eigh")
-    def _eigh(a, UPLO):
-        w, v = jnp.linalg.eigh(a, UPLO=UPLO)
-        return w, v
     return _eigh(_t(x), UPLO=UPLO)
 
 
+@defop("eigvalsh")
+def _eigvalsh(a, UPLO):
+    return jnp.linalg.eigvalsh(a, UPLO=UPLO)
+
+
 def eigvalsh(x, UPLO="L", name=None):
-    @defop("eigvalsh")
-    def _eigvalsh(a, UPLO):
-        return jnp.linalg.eigvalsh(a, UPLO=UPLO)
     return _eigvalsh(_t(x), UPLO=UPLO)
 
 
@@ -304,10 +316,12 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
     return (Tensor(sol), Tensor(res), Tensor(rank.astype(jnp.int64)), Tensor(sv))
 
 
+@defop("multi_dot")
+def _md(*arrs):
+    return jnp.linalg.multi_dot(arrs)
+
+
 def multi_dot(x, name=None):
-    @defop("multi_dot")
-    def _md(*arrs):
-        return jnp.linalg.multi_dot(arrs)
     return _md(*[_t(a) for a in x])
 
 

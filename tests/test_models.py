@@ -128,3 +128,27 @@ class TestGPT:
         assert out.shape == [2, 16, 128]
         loss = paddle.mean(out ** 2)
         loss.backward()
+
+
+class TestGeneration:
+    def test_greedy_and_sampled_generate(self):
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        paddle.seed(0)
+        model = LlamaForCausalLM("debug")
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (2, 8), dtype=np.int32))
+        out = model.generate(ids, max_new_tokens=4, temperature=0.0)
+        arr = np.asarray(out._value)
+        assert arr.shape == (2, 12)
+        np.testing.assert_array_equal(arr[:, :8], np.asarray(ids._value))
+        # greedy is deterministic
+        out2 = model.generate(ids, max_new_tokens=4, temperature=0.0)
+        np.testing.assert_array_equal(arr, np.asarray(out2._value))
+        # sampling with top_k stays in-vocab and differs across seeds
+        s1 = model.generate(ids, max_new_tokens=4, temperature=1.0,
+                            top_k=10, seed=1)
+        s2 = model.generate(ids, max_new_tokens=4, temperature=1.0,
+                            top_k=10, seed=2)
+        assert np.asarray(s1._value).max() < 128
+        assert not np.array_equal(np.asarray(s1._value),
+                                  np.asarray(s2._value))
