@@ -19,27 +19,77 @@ __all__ = ["save_state_dict", "load_state_dict", "DistributedSaver"]
 
 
 def _to_arrays(state_dict):
-    return {k: (v._value if isinstance(v, Tensor) else v)
-            for k, v in state_dict.items()}
+    out = {}
+    for k, v in state_dict.items():
+        if isinstance(v, Tensor):
+            out[k] = v._value          # jax arrays are immutable
+        elif isinstance(v, np.ndarray):
+            out[k] = v.copy()          # snapshot: host arrays can mutate
+        else:
+            out[k] = v
+    return out
+
+
+class AsyncSaveHandle:
+    """Handle for an in-flight async checkpoint (reference auto_checkpoint
+    / async save in incubate dist_save): training continues while the
+    snapshot writes; wait() joins."""
+
+    def __init__(self, thread, box):
+        self._thread = thread
+        self._box = box
+
+    def wait(self):
+        self._thread.join()
+        if self._box["exc"] is not None:
+            raise self._box["exc"]
+
+    def done(self):
+        return not self._thread.is_alive()
 
 
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     async_save=False):
     """reference distributed/checkpoint/save_state_dict. Uses orbax when the
-    state is device-sharded; plain pickle otherwise."""
-    arrays = _to_arrays(state_dict)
-    try:
-        import orbax.checkpoint as ocp
-        ckptr = ocp.StandardCheckpointer()
-        path = os.path.abspath(path)
-        ckptr.save(path, arrays, force=True)
-        ckptr.wait_until_finished()
-        return
-    except Exception:  # noqa: BLE001 — fall back to host gather + pickle
-        from ..framework.io import save
-        host = {k: np.asarray(v) for k, v in arrays.items()}
-        save(host, os.path.join(path, "state.pdparams")
-             if not path.endswith(".pdparams") else path)
+    state is device-sharded; plain pickle otherwise. ``async_save=True``
+    snapshots the array refs now (jax arrays are immutable, so later
+    train steps can't corrupt the snapshot) and writes on a background
+    thread, returning an :class:`AsyncSaveHandle`."""
+    arrays = _to_arrays(state_dict)     # snapshot: immutable array refs
+
+    def write():
+        try:
+            import orbax.checkpoint as ocp
+            ckptr = ocp.StandardCheckpointer()
+            ckptr.save(os.path.abspath(path), arrays, force=True)
+            ckptr.wait_until_finished()
+            return
+        except Exception:  # noqa: BLE001 — fall back to host pickle
+            from ..framework.io import save
+            host = {k: np.asarray(v) for k, v in arrays.items()}
+            save(host, os.path.join(path, "state.pdparams")
+                 if not path.endswith(".pdparams") else path)
+
+    if not async_save:
+        write()
+        return None
+    import atexit
+    import threading
+    box = {"exc": None}
+
+    def run():
+        try:
+            write()
+        except BaseException as e:  # noqa: BLE001 — re-raised in wait()
+            box["exc"] = e
+
+    # non-daemon + atexit join: an in-flight checkpoint must finish even
+    # if the script exits without calling wait() (a killed daemon thread
+    # would leave a truncated checkpoint on disk)
+    t = threading.Thread(target=run, daemon=False)
+    t.start()
+    atexit.register(lambda: t.join())
+    return AsyncSaveHandle(t, box)
 
 
 def load_state_dict(state_dict, path, process_group=None,

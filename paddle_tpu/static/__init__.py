@@ -75,6 +75,25 @@ class Program:
                          f"-> {op.out_ids}")
         return "\n".join(lines)
 
+    def to_jaxpr(self, feed_shapes=None):
+        """Export the recorded program as a jaxpr — the inspectable IR
+        (reference PIR Program print; jit.save exports StableHLO from the
+        same replay)."""
+        import jax
+        feed_names = sorted(self.feed_vars)
+        feed_vals = []
+        for n in feed_names:
+            v = self.feed_vars[n]._value
+            if feed_shapes and n in feed_shapes:
+                v = jnp.zeros(feed_shapes[n], v.dtype)
+            feed_vals.append(v)
+        ext = self.external_vars()
+        ext_ids = sorted(ext)
+        ext_vals = [ext[i]._value for i in ext_ids]
+        fetch = [op.out_ids[0] for op in self.ops[-1:]]
+        runner = Executor._make_runner(self, feed_names, fetch, ext_ids)
+        return jax.make_jaxpr(runner)(feed_vals, ext_vals)
+
     def clone(self, for_test=False):
         p = Program()
         p.ops = list(self.ops)

@@ -79,3 +79,28 @@ class TestProgramExecutor:
             _ = x + 1
         _ = paddle.exp(paddle.to_tensor([1.0]))  # outside: not recorded
         assert "exp" not in prog.op_types
+
+
+class TestIrAndAsyncCkpt:
+    def test_program_to_jaxpr(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4])
+            _ = paddle.tanh(x * 2).sum()
+        jaxpr = prog.to_jaxpr()
+        text = str(jaxpr)
+        assert "tanh" in text and "reduce_sum" in text
+
+    def test_async_checkpoint_save(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        net = nn.Linear(4, 2)
+        sd = net.state_dict()
+        handle = dist.checkpoint.save_state_dict(
+            sd, str(tmp_path / "ck"), async_save=True)
+        handle.wait()
+        assert handle.done()
+        net2 = nn.Linear(4, 2)
+        dist.checkpoint.load_state_dict(net2.state_dict(),
+                                        str(tmp_path / "ck"))
+        np.testing.assert_allclose(np.asarray(net2.weight._value),
+                                   np.asarray(net.weight._value))
