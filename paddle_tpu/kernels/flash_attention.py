@@ -93,10 +93,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, seq_len,
 
 
 def _choose_blocks(seq_len, head_dim, dtype):
-    bq = 512
+    import os
+    base = int(os.environ.get("PT_FLASH_BLOCK", 512))
+    bq = base
     while seq_len % bq != 0 and bq > 8:
         bq //= 2
-    bk = 512
+    bk = base
     while seq_len % bk != 0 and bk > 8:
         bk //= 2
     # keep q/k/v blocks + accumulators well under VMEM (~16MB)
