@@ -95,6 +95,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, seq_len,
 def _choose_blocks(seq_len, head_dim, dtype):
     import os
     base = int(os.environ.get("PT_FLASH_BLOCK", 512))
+    if base < 8 or (base & (base - 1)) != 0:
+        raise ValueError(
+            f"PT_FLASH_BLOCK={base} must be a power of two >= 8 (block "
+            f"sizes must divide the sequence and stay lane-aligned)")
     bq = base
     while seq_len % bq != 0 and bq > 8:
         bq //= 2

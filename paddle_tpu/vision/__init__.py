@@ -1,7 +1,8 @@
 """paddle_tpu.vision (reference: python/paddle/vision)."""
 
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 
-__all__ = ["models", "transforms", "datasets"]
+__all__ = ["models", "transforms", "datasets", "ops"]

@@ -1,0 +1,82 @@
+"""SqueezeNet (reference: python/paddle/vision/models/squeezenet.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class _Fire(nn.Layer):
+    """reference squeezenet.py MakeFire — squeeze 1x1 then expand 1x1+3x3
+    concatenated."""
+
+    def __init__(self, in_ch, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_ch, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        from ...ops.manipulation import concat
+        x = self.relu(self.squeeze(x))
+        return concat([self.relu(self.expand1(x)),
+                       self.relu(self.expand3(x))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """reference squeezenet.py SqueezeNet (versions 1.0 / 1.1)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            )
+        head = [nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1),
+                nn.ReLU()]
+        if with_pool:
+            head.append(nn.AdaptiveAvgPool2D(1))
+        self.classifier = nn.Sequential(*head)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = self.classifier(x)
+        if not self.with_pool:
+            return x                     # un-pooled class activation map
+        from ...ops.manipulation import flatten
+        return flatten(x, start_axis=1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights unavailable in this build")
+    return SqueezeNet(version="1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights unavailable in this build")
+    return SqueezeNet(version="1.1", **kwargs)

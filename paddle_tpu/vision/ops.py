@@ -1,0 +1,275 @@
+"""paddle.vision.ops (reference: python/paddle/vision/ops.py — nms:1859,
+roi_align:1632, roi_pool:1506, box_coder:566, deform_conv2d:746; CUDA
+kernels in phi/kernels/gpu/*nms*, roi_align_kernel.cu).
+
+TPU-native: roi_align/roi_pool are pure-jnp gather+bilinear programs
+(differentiable, jit-able); nms is a fixed-iteration lax.fori_loop
+suppression (static shapes — XLA can't do data-dependent output sizes,
+so it returns indices padded with -1 like the masked TPU detection
+stacks do)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import defop
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "box_iou"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _iou_matrix(boxes_a, boxes_b):
+    """[N,4] x [M,4] (x1,y1,x2,y2) -> [N,M] IoU."""
+    area_a = ((boxes_a[:, 2] - boxes_a[:, 0])
+              * (boxes_a[:, 3] - boxes_a[:, 1]))[:, None]
+    area_b = ((boxes_b[:, 2] - boxes_b[:, 0])
+              * (boxes_b[:, 3] - boxes_b[:, 1]))[None, :]
+    lt = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])
+    rb = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-9)
+
+
+@defop("box_iou", differentiable=False)
+def _box_iou(a, b):
+    return _iou_matrix(a, b)
+
+
+def box_iou(boxes1, boxes2, name=None):
+    """Pairwise IoU (building block shared by nms/matrix_nms)."""
+    return _box_iou(_t(boxes1), _t(boxes2))
+
+
+@defop("nms", differentiable=False)
+def _nms(boxes, scores, iou_threshold, top_k):
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    sboxes = jnp.take(boxes, order, axis=0)
+    iou = _iou_matrix(sboxes, sboxes)
+
+    def body(i, keep):
+        # suppress i iff a KEPT higher-scored box overlaps it
+        suppressed = jnp.any(jnp.where(jnp.arange(n) < i,
+                                       (iou[:, i] > iou_threshold) & keep,
+                                       False))
+        return keep.at[i].set(~suppressed)
+
+    keep = jax.lax.fori_loop(1, n, body,
+                             jnp.ones((n,), bool))
+    # stable-compact the kept indices to the front, -1 padding after
+    rank = jnp.cumsum(keep) - 1
+    out = jnp.full((n,), -1, order.dtype)
+    out = out.at[jnp.where(keep, rank, n - 1)].set(
+        jnp.where(keep, order, out[-1]))
+    if top_k is not None:
+        out = out[:top_k]
+    return out
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """reference vision/ops.py nms:1859 — returns kept box indices sorted
+    by score; -1-padded to a static length (TPU detection convention).
+    Category-aware when category_idxs is given (boxes of different
+    categories never suppress each other — implemented by offsetting
+    boxes per category, the torchvision batched_nms trick)."""
+    b = _t(boxes)
+    s = _t(scores) if scores is not None else Tensor(
+        jnp.arange(b.shape[0], 0, -1, dtype=jnp.float32))
+    bv = b._value
+    if category_idxs is not None:
+        cat = jnp.asarray(_t(category_idxs)._value)
+        offset = (cat.astype(bv.dtype) * (bv.max() + 1.0))[:, None]
+        bv = bv + offset
+    return _nms(Tensor(bv), s, iou_threshold=float(iou_threshold),
+                top_k=top_k)
+
+
+@defop("roi_align")
+def _roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+               sampling_ratio, aligned):
+    n, c, h, w = x.shape
+    ph, pw = output_size
+    num_rois = boxes.shape[0]
+    # batch index per roi from boxes_num (static python ints)
+    batch_idx = jnp.repeat(jnp.arange(len(boxes_num)),
+                           jnp.asarray(boxes_num),
+                           total_repeat_length=num_rois)
+    off = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - off
+    y1 = boxes[:, 1] * spatial_scale - off
+    x2 = boxes[:, 2] * spatial_scale - off
+    y2 = boxes[:, 3] * spatial_scale - off
+    roi_w = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+    roi_h = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+    bin_w = roi_w / pw
+    bin_h = roi_h / ph
+    ratio = sampling_ratio            # resolved statically by the wrapper
+    # sample grid: [num_rois, ph, pw, ratio, ratio, 2]
+    iy = (jnp.arange(ratio) + 0.5) / ratio
+    ix = (jnp.arange(ratio) + 0.5) / ratio
+    py = jnp.arange(ph)
+    px = jnp.arange(pw)
+    ys = (y1[:, None, None] + (py[None, :, None] + iy[None, None, :])
+          * bin_h[:, None, None])                    # [R, ph, ratio]
+    xs = (x1[:, None, None] + (px[None, :, None] + ix[None, None, :])
+          * bin_w[:, None, None])                    # [R, pw, ratio]
+
+    def bilinear(img, yy, xx):
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy = yy - y0
+        wx = xx - x0
+
+        def at(yi, xi):
+            yi = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
+            xi = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
+            return img[:, yi, xi]                    # [C, ...]
+        v = (at(y0, x0) * (1 - wy) * (1 - wx)
+             + at(y0, x0 + 1) * (1 - wy) * wx
+             + at(y0 + 1, x0) * wy * (1 - wx)
+             + at(y0 + 1, x0 + 1) * wy * wx)
+        return v
+
+    def per_roi(r):
+        img = x[batch_idx[r]]                        # [C, H, W]
+        yy = ys[r][:, None, :, None]                 # [ph,1,ratio,1]
+        xx = xs[r][None, :, None, :]                 # [1,pw,1,ratio]
+        yy = jnp.broadcast_to(yy, (ph, pw, ratio, ratio))
+        xx = jnp.broadcast_to(xx, (ph, pw, ratio, ratio))
+        vals = bilinear(img, yy, xx)                 # [C, ph, pw, r, r]
+        return vals.mean(axis=(-1, -2))              # [C, ph, pw]
+
+    return jax.vmap(per_roi)(jnp.arange(num_rois))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference vision/ops.py roi_align:1632 — [num_rois, C, ph, pw],
+    differentiable bilinear sampling."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    bn = [int(v) for v in (boxes_num.tolist()
+                           if isinstance(boxes_num, Tensor) else boxes_num)]
+    ratio = int(sampling_ratio)
+    if ratio <= 0:
+        # reference adaptive ratio ceil(roi/output) — resolved here where
+        # box values are concrete (one static ratio for the whole batch,
+        # sized to the largest ROI); default 2 if boxes are traced
+        import numpy as np_
+        bv = _t(boxes)._value
+        if not isinstance(bv, jax.core.Tracer):
+            b_np = np_.asarray(bv) * float(spatial_scale)
+            if len(b_np):
+                mh = (b_np[:, 3] - b_np[:, 1]).max() / output_size[0]
+                mw = (b_np[:, 2] - b_np[:, 0]).max() / output_size[1]
+                ratio = max(2, int(np_.ceil(max(mh, mw, 1.0))))
+            else:
+                ratio = 2
+        else:
+            ratio = 2
+    return _roi_align(_t(x), _t(boxes), boxes_num=tuple(bn),
+                      output_size=tuple(output_size),
+                      spatial_scale=float(spatial_scale),
+                      sampling_ratio=ratio, aligned=aligned)
+
+
+@defop("roi_pool")
+def _roi_pool(x, boxes, boxes_num, output_size, spatial_scale,
+              spatial_samples):
+    # max-pool variant via dense sampling then max
+    n, c, h, w = x.shape
+    ph, pw = output_size
+    num_rois = boxes.shape[0]
+    batch_idx = jnp.repeat(jnp.arange(len(boxes_num)),
+                           jnp.asarray(boxes_num),
+                           total_repeat_length=num_rois)
+    x1 = jnp.round(boxes[:, 0] * spatial_scale)
+    y1 = jnp.round(boxes[:, 1] * spatial_scale)
+    x2 = jnp.round(boxes[:, 2] * spatial_scale)
+    y2 = jnp.round(boxes[:, 3] * spatial_scale)
+    roi_w = jnp.maximum(x2 - x1 + 1, 1.0)
+    roi_h = jnp.maximum(y2 - y1 + 1, 1.0)
+    samples = spatial_samples         # resolved statically by the wrapper
+
+    def per_roi(r):
+        img = x[batch_idx[r]]
+        ys = y1[r] + (jnp.arange(ph * samples) + 0.5) \
+            * roi_h[r] / (ph * samples)
+        xs = x1[r] + (jnp.arange(pw * samples) + 0.5) \
+            * roi_w[r] / (pw * samples)
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        grid = img[:, yi][:, :, xi]                  # [C, ph*s, pw*s]
+        grid = grid.reshape(c, ph, samples, pw, samples)
+        return grid.max(axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(num_rois))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """reference vision/ops.py roi_pool:1506."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    bn = [int(v) for v in (boxes_num.tolist()
+                           if isinstance(boxes_num, Tensor) else boxes_num)]
+    # dense enough that every integer pixel of the largest ROI is touched
+    # (reference takes the exact max per bin); resolved where boxes are
+    # concrete, default 4 under trace
+    import numpy as np_
+    bv = _t(boxes)._value
+    samples = 4
+    if not isinstance(bv, jax.core.Tracer) and len(np_.asarray(bv)):
+        b_np = np_.asarray(bv) * float(spatial_scale)
+        mh = (b_np[:, 3] - b_np[:, 1] + 1).max() / output_size[0]
+        mw = (b_np[:, 2] - b_np[:, 0] + 1).max() / output_size[1]
+        samples = max(4, int(np_.ceil(max(mh, mw))))
+    return _roi_pool(_t(x), _t(boxes), boxes_num=tuple(bn),
+                     output_size=tuple(output_size),
+                     spatial_scale=float(spatial_scale),
+                     spatial_samples=samples)
+
+
+@defop("box_coder", differentiable=False)
+def _box_coder(prior_box, prior_var, target_box, code_type, normalized):
+    pw = prior_box[:, 2] - prior_box[:, 0] + (0.0 if normalized else 1.0)
+    ph = prior_box[:, 3] - prior_box[:, 1] + (0.0 if normalized else 1.0)
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] \
+            + (0.0 if normalized else 1.0)
+        th = target_box[:, 3] - target_box[:, 1] \
+            + (0.0 if normalized else 1.0)
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+        if prior_var is not None:
+            out = out / prior_var
+        return out
+    # decode_center_size: target_box [N, 4] deltas
+    d = target_box * prior_var if prior_var is not None else target_box
+    cx = d[:, 0] * pw + pcx
+    cy = d[:, 1] * ph + pcy
+    w = jnp.exp(d[:, 2]) * pw
+    h = jnp.exp(d[:, 3]) * ph
+    sub = 0.0 if normalized else 1.0
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - sub, cy + h * 0.5 - sub], axis=1)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """reference vision/ops.py box_coder:566 (center-size codec)."""
+    pv = _t(prior_box_var) if prior_box_var is not None else None
+    return _box_coder(_t(prior_box), pv, _t(target_box),
+                      code_type=code_type, normalized=box_normalized)

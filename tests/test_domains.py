@@ -237,3 +237,106 @@ class TestAudio:
         spec.sum().backward()
         assert x.grad is not None
         assert np.all(np.isfinite(_np(x.grad)))
+
+
+class TestVisionOps:
+    def test_nms_matches_reference_algorithm(self):
+        from paddle_tpu.vision import ops as V
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60],
+                          [0, 0, 5, 5]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+        keep = np.asarray(V.nms(paddle.to_tensor(boxes), 0.5,
+                                paddle.to_tensor(scores))._value)
+        # box1 overlaps box0 (iou>0.5) -> suppressed; others kept
+        kept = [i for i in keep.tolist() if i >= 0]
+        assert kept == [0, 2, 3]
+
+    def test_nms_category_aware(self):
+        from paddle_tpu.vision import ops as V
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1])
+        keep = np.asarray(V.nms(paddle.to_tensor(boxes), 0.5,
+                                paddle.to_tensor(scores),
+                                category_idxs=paddle.to_tensor(cats),
+                                categories=[0, 1])._value)
+        kept = [i for i in keep.tolist() if i >= 0]
+        assert kept == [0, 1]   # different categories: no suppression
+
+    def test_roi_align_uniform_region(self):
+        from paddle_tpu.vision import ops as V
+        x = paddle.to_tensor(np.full((1, 1, 8, 8), 3.0, np.float32))
+        boxes = paddle.to_tensor(np.array([[0, 0, 8, 8]], np.float32))
+        out = V.roi_align(x, boxes, [1], output_size=2, aligned=False)
+        arr = np.asarray(out._value)
+        assert arr.shape == (1, 1, 2, 2)
+        np.testing.assert_allclose(arr, 3.0, rtol=1e-5)
+
+    def test_roi_align_differentiable(self):
+        from paddle_tpu.vision import ops as V
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(1, 2, 8, 8).astype(np.float32))
+        x.stop_gradient = False
+        boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], np.float32))
+        out = V.roi_align(x, boxes, [1], output_size=3)
+        out.sum().backward()
+        assert x.grad is not None
+        assert np.abs(np.asarray(x.grad._value)).sum() > 0
+
+    def test_roi_pool_max_semantics(self):
+        from paddle_tpu.vision import ops as V
+        img = np.zeros((1, 1, 8, 8), np.float32)
+        img[0, 0, 2, 2] = 9.0
+        out = V.roi_pool(paddle.to_tensor(img),
+                         paddle.to_tensor(np.array([[0, 0, 7, 7]],
+                                                   np.float32)),
+                         [1], output_size=2)
+        arr = np.asarray(out._value)
+        assert arr.max() == 9.0 and arr.shape == (1, 1, 2, 2)
+
+    def test_box_coder_roundtrip(self):
+        from paddle_tpu.vision import ops as V
+        prior = np.array([[0, 0, 10, 10], [5, 5, 15, 25]], np.float32)
+        target = np.array([[1, 1, 9, 11], [6, 4, 14, 28]], np.float32)
+        enc = V.box_coder(paddle.to_tensor(prior), None,
+                          paddle.to_tensor(target),
+                          code_type="encode_center_size")
+        dec = V.box_coder(paddle.to_tensor(prior), None, enc,
+                          code_type="decode_center_size")
+        np.testing.assert_allclose(np.asarray(dec._value), target,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_box_iou(self):
+        from paddle_tpu.vision import ops as V
+        a = np.array([[0, 0, 10, 10]], np.float32)
+        b = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]],
+                     np.float32)
+        iou = np.asarray(V.box_iou(paddle.to_tensor(a),
+                                   paddle.to_tensor(b))._value)
+        np.testing.assert_allclose(iou[0], [1.0, 25 / 175, 0.0], rtol=1e-5)
+
+
+class TestMoreVisionModels:
+    def test_alexnet_and_squeezenet_forward(self):
+        from paddle_tpu.vision.models import alexnet, squeezenet1_1
+        paddle.seed(0)
+        net = alexnet(num_classes=10)
+        x = paddle.randn([1, 3, 224, 224])
+        net.eval()
+        out = net(x)
+        assert list(out.shape) == [1, 10]
+        sq = squeezenet1_1(num_classes=7)
+        sq.eval()
+        out2 = sq(x)
+        assert list(out2.shape) == [1, 7]
+
+    def test_roi_pool_large_roi_exact_max(self):
+        """Regression: fixed 4-samples/bin missed maxima in large ROIs."""
+        from paddle_tpu.vision import ops as V
+        img = np.zeros((1, 1, 64, 64), np.float32)
+        img[0, 0, 3, 5] = 9.0
+        out = V.roi_pool(paddle.to_tensor(img),
+                         paddle.to_tensor(np.array([[0, 0, 63, 63]],
+                                                   np.float32)),
+                         [1], output_size=2)
+        assert np.asarray(out._value).max() == 9.0
