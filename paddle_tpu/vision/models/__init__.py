@@ -9,9 +9,15 @@ from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .alexnet import AlexNet, alexnet  # noqa: F401
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
+from .densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+)
 
 __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "resnet101", "resnet152", "wide_resnet50_2", "wide_resnet101_2",
            "resnext50_32x4d", "resnext101_64x4d", "VGG", "vgg11", "vgg13",
            "vgg16", "vgg19", "MobileNetV2", "mobilenet_v2", "AlexNet",
-           "alexnet", "SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+           "alexnet", "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+           "MobileNetV1", "mobilenet_v1", "DenseNet", "densenet121",
+           "densenet161", "densenet169", "densenet201"]

@@ -1,0 +1,102 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201"]
+
+_CFGS = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    """reference densenet.py DenseLayer — BN-ReLU-1x1 then BN-ReLU-3x3,
+    output concatenated onto the running feature stack."""
+
+    def __init__(self, in_ch, growth_rate, bn_size=4):
+        super().__init__()
+        inter = bn_size * growth_rate
+        self.bn1 = nn.BatchNorm2D(in_ch)
+        self.conv1 = nn.Conv2D(in_ch, inter, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(inter)
+        self.conv2 = nn.Conv2D(inter, growth_rate, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        from ...ops.manipulation import concat
+        y = self.conv1(self.relu(self.bn1(x)))
+        y = self.conv2(self.relu(self.bn2(y)))
+        return concat([x, y], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_ch)
+        self.conv = nn.Conv2D(in_ch, out_ch, 1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    """reference densenet.py DenseNet(layers=121, ...)."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        init_ch, growth, blocks = _CFGS[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stem = [nn.Conv2D(3, init_ch, 7, stride=2, padding=3,
+                          bias_attr=False),
+                nn.BatchNorm2D(init_ch), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2, padding=1)]
+        ch = init_ch
+        body = []
+        for bi, n_layers in enumerate(blocks):
+            for _ in range(n_layers):
+                body.append(_DenseLayer(ch, growth, bn_size))
+                ch += growth
+            if bi != len(blocks) - 1:
+                body.append(_Transition(ch, ch // 2))
+                ch = ch // 2
+        tail = [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*(stem + body + tail))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...ops.manipulation import flatten
+            x = self.classifier(flatten(x, start_axis=1))
+        return x
+
+
+def _make(layers):
+    def builder(pretrained=False, **kwargs):
+        if pretrained:
+            raise ValueError("pretrained weights unavailable in this build")
+        return DenseNet(layers=layers, **kwargs)
+    builder.__name__ = f"densenet{layers}"
+    return builder
+
+
+densenet121 = _make(121)
+densenet161 = _make(161)
+densenet169 = _make(169)
+densenet201 = _make(201)

@@ -1,0 +1,74 @@
+"""MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class _ConvBNRelu(nn.Layer):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0, groups=1):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                              padding=padding, groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _DepthwiseSeparable(nn.Layer):
+    """reference mobilenetv1.py DepthwiseSeparable — depthwise 3x3 then
+    pointwise 1x1."""
+
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.depthwise = _ConvBNRelu(in_ch, in_ch, 3, stride=stride,
+                                     padding=1, groups=in_ch)
+        self.pointwise = _ConvBNRelu(in_ch, out_ch, 1)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+class MobileNetV1(nn.Layer):
+    """reference mobilenetv1.py MobileNetV1(scale, num_classes)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(c(32), c(64), 1), (c(64), c(128), 2), (c(128), c(128), 1),
+               (c(128), c(256), 2), (c(256), c(256), 1),
+               (c(256), c(512), 2)] \
+            + [(c(512), c(512), 1)] * 5 \
+            + [(c(512), c(1024), 2), (c(1024), c(1024), 1)]
+        layers = [_ConvBNRelu(3, c(32), 3, stride=2, padding=1)]
+        layers += [_DepthwiseSeparable(i, o, s) for i, o, s in cfg]
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...ops.manipulation import flatten
+            x = self.fc(flatten(x, start_axis=1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights unavailable in this build")
+    return MobileNetV1(scale=scale, **kwargs)

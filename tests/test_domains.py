@@ -340,3 +340,15 @@ class TestMoreVisionModels:
                                                    np.float32)),
                          [1], output_size=2)
         assert np.asarray(out._value).max() == 9.0
+
+    def test_mobilenetv1_and_densenet_forward(self):
+        from paddle_tpu.vision.models import densenet121, mobilenet_v1
+        paddle.seed(0)
+        m = mobilenet_v1(scale=0.25, num_classes=6)
+        m.eval()
+        out = m(paddle.randn([1, 3, 64, 64]))
+        assert list(out.shape) == [1, 6]
+        d = densenet121(num_classes=5)
+        d.eval()
+        out2 = d(paddle.randn([1, 3, 64, 64]))
+        assert list(out2.shape) == [1, 5]
