@@ -16,10 +16,10 @@ _CFGS = {
 
 
 class _DenseLayer(nn.Layer):
-    """reference densenet.py DenseLayer — BN-ReLU-1x1 then BN-ReLU-3x3,
-    output concatenated onto the running feature stack."""
+    """reference densenet.py DenseLayer — BN-ReLU-1x1 then BN-ReLU-3x3
+    (+ dropout), output concatenated onto the running feature stack."""
 
-    def __init__(self, in_ch, growth_rate, bn_size=4):
+    def __init__(self, in_ch, growth_rate, bn_size=4, dropout=0.0):
         super().__init__()
         inter = bn_size * growth_rate
         self.bn1 = nn.BatchNorm2D(in_ch)
@@ -28,11 +28,14 @@ class _DenseLayer(nn.Layer):
         self.conv2 = nn.Conv2D(inter, growth_rate, 3, padding=1,
                                bias_attr=False)
         self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(dropout) if dropout else None
 
     def forward(self, x):
         from ...ops.manipulation import concat
         y = self.conv1(self.relu(self.bn1(x)))
         y = self.conv2(self.relu(self.bn2(y)))
+        if self.dropout is not None:
+            y = self.dropout(y)
         return concat([x, y], axis=1)
 
 
@@ -65,7 +68,7 @@ class DenseNet(nn.Layer):
         body = []
         for bi, n_layers in enumerate(blocks):
             for _ in range(n_layers):
-                body.append(_DenseLayer(ch, growth, bn_size))
+                body.append(_DenseLayer(ch, growth, bn_size, dropout))
                 ch += growth
             if bi != len(blocks) - 1:
                 body.append(_Transition(ch, ch // 2))

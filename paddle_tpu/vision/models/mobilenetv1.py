@@ -43,7 +43,9 @@ class MobileNetV1(nn.Layer):
         self.with_pool = with_pool
 
         def c(ch):
-            return max(8, int(ch * scale))
+            # reference mobilenetv1.py uses plain int(ch*scale) — keep
+            # checkpoint-shape parity (no divisor clamp)
+            return max(1, int(ch * scale))
 
         cfg = [(c(32), c(64), 1), (c(64), c(128), 2), (c(128), c(128), 1),
                (c(128), c(256), 2), (c(256), c(256), 1),
