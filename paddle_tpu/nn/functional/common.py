@@ -14,7 +14,8 @@ __all__ = ["linear", "embedding", "one_hot", "dropout", "dropout2d",
            "dropout3d", "alpha_dropout", "pad", "interpolate", "upsample",
            "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
            "label_smooth", "bilinear", "unfold", "fold", "affine_grid",
-           "grid_sample", "npair_loss", "zeropad2d", "pairwise_distance"]
+           "grid_sample", "npair_loss", "zeropad2d", "pairwise_distance",
+           "channel_shuffle"]
 
 
 def _t(x):
@@ -389,3 +390,24 @@ def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
     """reference nn/functional/distance.py pairwise_distance."""
     return _pairwise_distance(_t(x), _t(y), p=float(p),
                               epsilon=float(epsilon), keepdim=keepdim)
+
+
+@defop("channel_shuffle")
+def _channel_shuffle(x, groups, channel_axis):
+    shape = x.shape
+    c = shape[channel_axis]
+    pre = shape[:channel_axis]
+    post = shape[channel_axis + 1:]
+    y = x.reshape(pre + (groups, c // groups) + post)
+    y = jnp.swapaxes(y, channel_axis, channel_axis + 1)
+    return y.reshape(shape)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """reference nn/functional/vision.py channel_shuffle:455."""
+    x = _t(x)
+    if x.shape[1 if data_format == "NCHW" else -1] % groups != 0:
+        raise ValueError(
+            f"channels {x.shape} not divisible by groups={groups}")
+    axis = 1 if data_format == "NCHW" else x.ndim - 1
+    return _channel_shuffle(x, groups=groups, channel_axis=axis)

@@ -13,6 +13,10 @@ from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
 from .densenet import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201,
 )
+from .shufflenetv2 import (  # noqa: F401
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+)
 
 __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "resnet101", "resnet152", "wide_resnet50_2", "wide_resnet101_2",
@@ -20,4 +24,7 @@ __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "vgg16", "vgg19", "MobileNetV2", "mobilenet_v2", "AlexNet",
            "alexnet", "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
            "MobileNetV1", "mobilenet_v1", "DenseNet", "densenet121",
-           "densenet161", "densenet169", "densenet201"]
+           "densenet161", "densenet169", "densenet201", "ShuffleNetV2",
+           "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0"]

@@ -352,3 +352,16 @@ class TestMoreVisionModels:
         d.eval()
         out2 = d(paddle.randn([1, 3, 64, 64]))
         assert list(out2.shape) == [1, 5]
+
+    def test_channel_shuffle_and_shufflenet(self):
+        import paddle_tpu.nn.functional as F
+        x = np.arange(1 * 4 * 1 * 1, dtype=np.float32).reshape(1, 4, 1, 1)
+        out = np.asarray(F.channel_shuffle(paddle.to_tensor(x), 2)._value)
+        # [0,1,2,3] grouped as (2,2) -> transposed -> [0,2,1,3]
+        np.testing.assert_array_equal(out.reshape(-1), [0, 2, 1, 3])
+        from paddle_tpu.vision.models import shufflenet_v2_x0_25
+        paddle.seed(0)
+        net = shufflenet_v2_x0_25(num_classes=4)
+        net.eval()
+        out2 = net(paddle.randn([1, 3, 64, 64]))
+        assert list(out2.shape) == [1, 4]
