@@ -13,6 +13,10 @@ from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
 from .densenet import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201,
 )
+from .mobilenetv3 import (  # noqa: F401
+    MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small,
+    mobilenet_v3_large,
+)
 from .shufflenetv2 import (  # noqa: F401
     ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
     shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
@@ -27,4 +31,5 @@ __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "densenet161", "densenet169", "densenet201", "ShuffleNetV2",
            "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
            "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
-           "shufflenet_v2_x2_0"]
+           "shufflenet_v2_x2_0", "MobileNetV3Small", "MobileNetV3Large",
+           "mobilenet_v3_small", "mobilenet_v3_large"]

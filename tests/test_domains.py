@@ -365,3 +365,14 @@ class TestMoreVisionModels:
         net.eval()
         out2 = net(paddle.randn([1, 3, 64, 64]))
         assert list(out2.shape) == [1, 4]
+
+    def test_mobilenetv3_forward(self):
+        from paddle_tpu.vision.models import (mobilenet_v3_large,
+                                              mobilenet_v3_small)
+        paddle.seed(0)
+        m = mobilenet_v3_small(scale=0.5, num_classes=3)
+        m.eval()
+        assert list(m(paddle.randn([1, 3, 64, 64])).shape) == [1, 3]
+        lg = mobilenet_v3_large(scale=0.35, num_classes=2)
+        lg.eval()
+        assert list(lg(paddle.randn([1, 3, 64, 64])).shape) == [1, 2]
