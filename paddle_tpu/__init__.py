@@ -70,6 +70,59 @@ from .framework.io import save, load  # noqa: F401
 from .framework.param_attr import ParamAttr  # noqa: F401
 from .device import set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu  # noqa: F401
 from .metric import accuracy  # noqa: F401
+from .framework.core import (  # noqa: F401
+    finfo, iinfo, set_printoptions, CPUPlace, CUDAPlace, CUDAPinnedPlace,
+    TPUPlace, XPUPlace, CustomPlace, in_dynamic_mode, in_dygraph_mode,
+    enable_static, disable_static, create_parameter, LazyGuard,
+    disable_signal_handler, is_complex, is_floating_point, is_integer,
+    is_tensor, flops,
+)
+
+from .distributed.parallel import DataParallel  # noqa: F401
+
+# dtype alias shadowing the builtin, as the reference does (paddle.bool)
+globals()["bool"] = bool_
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader-decorator batching (reference: python/paddle/batch.py)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def check_shape(shape, op_name="", expected_shape_type=(list, tuple),
+                expected_element_type=(int,), expected_tensor_dtype=None):
+    """Shape-argument validation (reference: base/data_feeder.py:212).
+    Dygraph skips checks like the reference; static scripts get the type
+    errors."""
+    if in_dynamic_mode():
+        return
+    if not isinstance(shape, expected_shape_type):
+        raise TypeError(f"The shape of '{op_name}' must be "
+                        f"{expected_shape_type}, got {type(shape)}")
+    for item in shape:
+        if not isinstance(item, expected_element_type):
+            raise TypeError(f"element of shape in '{op_name}' must be "
+                            f"{expected_element_type}, got {type(item)}")
+
+
+def get_cuda_rng_state():
+    """Device RNG state (reference: paddle.get_cuda_rng_state; on TPU the
+    accelerator RNG is the same counter-based generator)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
 
 __version__ = "0.1.0"
 
@@ -77,7 +130,11 @@ __all__ = (
     ["Tensor", "Parameter", "to_tensor", "no_grad", "enable_grad", "grad",
      "seed", "save", "load", "set_default_dtype", "get_default_dtype",
      "set_flags", "get_flags", "set_device", "get_device", "ParamAttr",
-     "Model", "summary",
-     "accuracy"]
+     "Model", "summary", "accuracy",
+     "finfo", "iinfo", "set_printoptions", "CPUPlace", "CUDAPlace",
+     "CUDAPinnedPlace", "TPUPlace", "in_dynamic_mode", "in_dygraph_mode",
+     "enable_static", "disable_static", "create_parameter", "LazyGuard",
+     "disable_signal_handler", "is_complex", "is_floating_point",
+     "is_integer", "is_tensor", "flops"]
     + list(_ops_all)
 )

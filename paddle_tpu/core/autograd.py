@@ -79,8 +79,8 @@ class GradNode:
     cotangents can be materialized as zeros.
     """
 
-    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "_buffer",
-                 "_hooks", "fwd_fn")
+    __slots__ = ("name", "vjp_fn", "inputs", "input_positions", "out_avals",
+                 "_buffer", "_hooks", "fwd_fn")
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence,
                  out_avals: Sequence[jax.ShapeDtypeStruct],
@@ -88,6 +88,15 @@ class GradNode:
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)   # Tensor objects (strong refs, like the reference)
+        # graph-position snapshot taken at record time (producer node,
+        # out index, stop_gradient, inplace version). Backward routes
+        # through these, NOT the live tensor attributes: an in-place op
+        # later rebinds the same python Tensor to a new graph position,
+        # and following the live pointer would misroute cotangents
+        # (reference: TensorWrapper snapshots + inplace version counter)
+        self.input_positions = [
+            (t._grad_node, t._out_index, t.stop_gradient, t._version)
+            for t in inputs]
         self.out_avals = list(out_avals)
         self._buffer = None          # per-output accumulated cotangents
         self._hooks = []
@@ -144,9 +153,11 @@ def _toposort_count(roots: list[GradNode]) -> dict[GradNode, int]:
         seen.add(id(r))
     while stack:
         node = stack.pop()
-        for t in node.inputs:
-            p = t._grad_node
-            if p is not None:
+        for (p, _oi, sg, _ver) in node.input_positions:
+            # sg edges are skipped by run_backward's routing loop, so they
+            # must not inflate the producer's in-degree either — otherwise
+            # the producer never drains and upstream grads are dropped
+            if p is not None and not sg:
                 indeg[p] = indeg.get(p, 0) + 1
                 if id(p) not in seen:
                     seen.add(id(p))
@@ -247,21 +258,22 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence | None = None,
                     f"op {node.name!r} has no replayable forward; "
                     f"create_graph is unsupported through it")
             in_cots = node.vjp_fn(cots)
-        for t, c in zip(node.inputs, in_cots):
-            if t.stop_gradient:
+        for t, (p, out_index, sg, _ver), c in zip(node.inputs,
+                                                  node.input_positions,
+                                                  in_cots):
+            if sg:
                 continue
             for h in t._grad_hooks:
                 r = h(c)
                 if r is not None:
                     c = r
-            p = t._grad_node
             if p is None:
                 if accumulate_fn is not None:
                     accumulate_fn(t, c)
                 else:
                     t._accumulate_grad(c)
             else:
-                p.accumulate(t._out_index, c)
+                p.accumulate(out_index, c)
                 indeg[p] -= 1
                 if indeg[p] == 0:
                     queue.append(p)
@@ -274,6 +286,13 @@ def _replay_vjp(node: GradNode, cot_tensors):
     primals, so the resulting cotangents depend differentiably on both the
     primals and the incoming cotangents (higher-order autodiff)."""
     from .dispatch import apply_op
+    for t, (_p, _oi, _sg, ver) in zip(node.inputs, node.input_positions):
+        if t._version != ver:
+            raise RuntimeError(
+                f"a tensor saved for the backward of op {node.name!r} was "
+                f"modified by an inplace operation (version {t._version} vs "
+                f"recorded {ver}); replaying its vjp would use stale "
+                "primals (reference inplace version-counter error)")
     n_in = len(node.inputs)
 
     def backward_fn(*arrs):

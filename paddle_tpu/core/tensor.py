@@ -26,7 +26,7 @@ __all__ = ["Tensor", "Parameter", "to_tensor"]
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_out_index",
                  "_grad_hooks", "name", "persistable", "dist_attr",
-                 "_dist_spec", "_opt_shard_spec", "__weakref__")
+                 "_dist_spec", "_opt_shard_spec", "_version", "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True, name: str | None = None):
         if isinstance(value, Tensor):
@@ -44,6 +44,10 @@ class Tensor:
         self.dist_attr = None
         self._dist_spec = None  # PartitionSpec annotation for pjit paths
         self._opt_shard_spec = None  # ZeRO-1/2 optimizer-slot sharding
+        # inplace version counter (reference: eager TensorWrapper
+        # inplace_version checks) — bumped on every in-place mutation so
+        # replayed vjps can detect stale primals
+        self._version = 0
 
     # -- metadata ----------------------------------------------------------
     @property
@@ -153,6 +157,7 @@ class Tensor:
             raise ValueError(
                 f"set_value shape mismatch: {value.shape} vs {self._value.shape}")
         self._value = value.astype(self._value.dtype)
+        self._version += 1
 
     def copy_(self, other, blocking: bool = True) -> "Tensor":
         self.set_value(other)
@@ -161,6 +166,7 @@ class Tensor:
     def _in_place_update(self, new_value) -> None:
         """Optimizer-style in-place update: rebinds the buffer, keeps identity."""
         self._value = new_value
+        self._version += 1
 
     # -- misc --------------------------------------------------------------
     def block_until_ready(self) -> "Tensor":

@@ -3,5 +3,18 @@
 from .param_attr import ParamAttr  # noqa: F401
 from .io import save, load  # noqa: F401
 from . import random  # noqa: F401
+from .core import (  # noqa: F401
+    finfo, iinfo, set_printoptions, CPUPlace, CUDAPlace, CUDAPinnedPlace,
+    TPUPlace, XPUPlace, CustomPlace, in_dynamic_mode, in_dygraph_mode,
+    enable_static, disable_static, create_parameter, LazyGuard,
+    disable_signal_handler, is_complex, is_floating_point, is_integer,
+    is_tensor, flops,
+)
 
-__all__ = ["ParamAttr", "save", "load", "random"]
+__all__ = ["ParamAttr", "save", "load", "random",
+           "finfo", "iinfo", "set_printoptions", "CPUPlace", "CUDAPlace",
+           "CUDAPinnedPlace", "TPUPlace", "XPUPlace", "CustomPlace",
+           "in_dynamic_mode", "in_dygraph_mode", "enable_static",
+           "disable_static", "create_parameter", "LazyGuard",
+           "disable_signal_handler", "is_complex", "is_floating_point",
+           "is_integer", "is_tensor", "flops"]

@@ -1,0 +1,278 @@
+"""Framework-level compat surface: dtype info, places, printing, dygraph
+mode queries (reference: python/paddle/framework/__init__.py,
+base/core places, tensor/attribute.py is_* queries)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core.dtype import convert_dtype
+
+__all__ = [
+    "finfo", "iinfo", "set_printoptions", "CPUPlace", "CUDAPlace",
+    "CUDAPinnedPlace", "TPUPlace", "XPUPlace", "CustomPlace",
+    "in_dynamic_mode", "in_dygraph_mode", "enable_static", "disable_static",
+    "create_parameter", "LazyGuard", "disable_signal_handler",
+    "is_complex", "is_floating_point", "is_integer", "is_tensor", "flops",
+]
+
+
+# ---- dtype info ----------------------------------------------------------
+
+class _FInfo:
+    """paddle.finfo result (reference: pybind FloatingPointInfo)."""
+
+    def __init__(self, dt):
+        fi = jnp.finfo(dt)
+        self.dtype = str(dt)
+        self.bits = fi.bits
+        self.eps = float(fi.eps)
+        self.min = float(fi.min)
+        self.max = float(fi.max)
+        self.tiny = float(fi.tiny)
+        self.smallest_normal = float(fi.tiny)
+        self.resolution = float(getattr(fi, "resolution", fi.eps))
+
+    def __repr__(self):
+        return (f"finfo(dtype={self.dtype}, bits={self.bits}, "
+                f"eps={self.eps}, min={self.min}, max={self.max})")
+
+
+class _IInfo:
+    def __init__(self, dt):
+        ii = jnp.iinfo(dt)
+        self.dtype = str(dt)
+        self.bits = ii.bits
+        self.min = int(ii.min)
+        self.max = int(ii.max)
+
+    def __repr__(self):
+        return (f"iinfo(dtype={self.dtype}, bits={self.bits}, "
+                f"min={self.min}, max={self.max})")
+
+
+def finfo(dtype):
+    """Float dtype limits (reference: paddle.finfo)."""
+    return _FInfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    """Integer dtype limits (reference: paddle.iinfo)."""
+    return _IInfo(convert_dtype(dtype))
+
+
+# ---- printing ------------------------------------------------------------
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr options — delegates to numpy since tensor repr renders
+    through np.asarray (reference: paddle.set_printoptions)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# ---- places --------------------------------------------------------------
+
+class _Place:
+    """Device place handle. On TPU every dense tensor lives where jax puts
+    it; places are identity markers for API parity (reference:
+    phi::Place/paddle.CPUPlace)."""
+
+    _kind = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, _Place) and self._kind == other._kind
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self._kind, self.device_id))
+
+
+class CPUPlace(_Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class CUDAPlace(_Place):
+    """Accepted for API compat; maps onto the default accelerator."""
+    _kind = "gpu"
+
+
+class CUDAPinnedPlace(_Place):
+    _kind = "gpu_pinned"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class TPUPlace(_Place):
+    _kind = "tpu"
+
+
+class XPUPlace(_Place):
+    _kind = "xpu"
+
+
+class CustomPlace(_Place):
+    _kind = "custom"
+
+    def __init__(self, dev_type, device_id=0):
+        super().__init__(device_id)
+        self.dev_type = dev_type
+
+
+# ---- mode queries --------------------------------------------------------
+
+_STATIC_MODE = False
+
+
+def in_dynamic_mode() -> bool:
+    """True while in define-by-run mode (reference: paddle.in_dynamic_mode).
+    Eager is the default; ``enable_static`` flips the flag for legacy
+    static-program scripts driving framework.Program/Executor."""
+    return not _STATIC_MODE
+
+
+def in_dygraph_mode() -> bool:
+    return not _STATIC_MODE
+
+
+def enable_static():
+    global _STATIC_MODE
+    _STATIC_MODE = True
+
+
+def disable_static():
+    global _STATIC_MODE
+    _STATIC_MODE = False
+
+
+def disable_signal_handler():
+    """No-op: the reference installs C++ fatal-signal dumpers, jax does not
+    hook signals (reference: paddle.disable_signal_handler)."""
+
+
+class LazyGuard:
+    """Context manager for deferred parameter initialization (reference:
+    paddle.LazyGuard / base/framework LazyInitHelper). Layers created under
+    the guard still materialize eagerly here — XLA has no lazy host-side
+    weight concept; kept for API compatibility."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# ---- parameter creation --------------------------------------------------
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone Parameter factory (reference: paddle.create_parameter →
+    static/nn/common.py)."""
+    from ..nn import initializer as I
+    shape = [int(s) for s in shape]
+    init = default_initializer
+    if init is None and attr is not None and getattr(attr, "initializer", None):
+        init = attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    return Parameter(init(shape, convert_dtype(dtype)), trainable=True,
+                     name=name)
+
+
+# ---- tensor queries ------------------------------------------------------
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def is_complex(x) -> bool:
+    dt = x.dtype if isinstance(x, Tensor) else x
+    return jnp.issubdtype(dt, jnp.complexfloating)
+
+
+def is_floating_point(x) -> bool:
+    dt = x.dtype if isinstance(x, Tensor) else x
+    return jnp.issubdtype(dt, jnp.floating)
+
+
+def is_integer(x) -> bool:
+    dt = x.dtype if isinstance(x, Tensor) else x
+    return jnp.issubdtype(dt, jnp.integer)
+
+
+# ---- flops ---------------------------------------------------------------
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Static per-layer FLOPs estimate of a ``nn.Layer``'s forward
+    (reference: python/paddle/hapi/dynamic_flops.py flops). Counts the
+    dominant layer types by hooking forward like the reference."""
+    from .. import nn
+
+    counts = {}
+
+    def count(layer, x, y):
+        x = x[0] if isinstance(x, (list, tuple)) else x
+        n = 0
+        if isinstance(layer, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):
+            kernel_ops = int(np.prod(layer._kernel_size)) * (
+                layer._in_channels // layer._groups)
+            bias_ops = 1 if layer.bias is not None else 0
+            n = int(np.prod(y.shape)) * (kernel_ops + bias_ops)
+        elif isinstance(layer, nn.Linear):
+            n = int(np.prod(x.shape)) * layer.weight.shape[-1]
+            if layer.bias is not None:
+                n += int(np.prod(y.shape))
+        elif isinstance(layer, (nn.BatchNorm1D, nn.BatchNorm2D,
+                                nn.BatchNorm3D, nn.LayerNorm)):
+            n = 2 * int(np.prod(x.shape))
+        elif isinstance(layer, (nn.ReLU, nn.ReLU6, nn.LeakyReLU,
+                                nn.Sigmoid, nn.Tanh)):
+            n = int(np.prod(x.shape))
+        elif isinstance(layer, (nn.AvgPool2D, nn.MaxPool2D,
+                                nn.AdaptiveAvgPool2D)):
+            n = int(np.prod(y.shape))
+        elif custom_ops and type(layer) in custom_ops:
+            n = custom_ops[type(layer)](layer, x, y)
+        counts[id(layer)] = (type(layer).__name__, n)
+
+    handles = []
+    for sub in net.sublayers(include_self=True):
+        handles.append(sub.register_forward_post_hook(count))
+
+    import paddle_tpu as p
+    x = p.zeros(list(input_size), "float32")
+    net(x)
+    for h in handles:
+        h.remove()
+
+    total = sum(n for _, n in counts.values())
+    if print_detail:
+        for name, n in counts.values():
+            if n:
+                print(f"{name:>24}: {n:,}")
+        print(f"Total FLOPs: {total:,}")
+    return total

@@ -11,8 +11,9 @@ from .layer.activation import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
+from .layer.extra import *  # noqa: F401,F403
 
-from .layer import common, conv, pooling, norm, activation, loss, transformer, rnn  # noqa: F401
+from .layer import common, conv, pooling, norm, activation, loss, transformer, rnn, extra  # noqa: F401
 from .utils import clip_grad_norm_, clip_grad_value_  # noqa: F401
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401
 
@@ -20,4 +21,4 @@ __all__ = (["Layer", "Sequential", "LayerList", "ParameterList", "LayerDict",
             "functional", "initializer"]
            + common.__all__ + conv.__all__ + pooling.__all__ + norm.__all__
            + activation.__all__ + loss.__all__ + transformer.__all__
-           + rnn.__all__)
+           + rnn.__all__ + extra.__all__)

@@ -8,8 +8,10 @@ from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
+from .extra import *  # noqa: F401,F403
 
-from . import activation, common, conv, pooling, norm, loss, attention  # noqa: F401
+from . import activation, common, conv, pooling, norm, loss, attention, extra  # noqa: F401
 
 __all__ = (activation.__all__ + common.__all__ + conv.__all__
-           + pooling.__all__ + norm.__all__ + loss.__all__ + attention.__all__)
+           + pooling.__all__ + norm.__all__ + loss.__all__ + attention.__all__
+           + extra.__all__)

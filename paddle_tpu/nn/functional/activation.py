@@ -43,12 +43,21 @@ mish = _simple("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
 
 
 def relu_(x, name=None):
-    out = relu(x)
-    x._value = out._value
-    x._grad_node = out._grad_node
-    x._out_index = out._out_index
-    x.stop_gradient = out.stop_gradient
-    return x
+    from ...ops.inplace import _adopt, _guard_leaf
+    _guard_leaf(x, "relu_")
+    return _adopt(x, relu(x))
+
+
+def _make_act_inplace(name, base):
+    """Generated inplace activation variants (reference: the generated
+    elu_/tanh_/... inplace APIs)."""
+    def fn_(x, *args, **kwargs):
+        from ...ops.inplace import _adopt, _guard_leaf
+        kwargs.pop("name", None)
+        _guard_leaf(x, name)
+        return _adopt(x, base(x, *args, **kwargs))
+    fn_.__name__ = name
+    return fn_
 
 
 @defop("gelu")
@@ -251,3 +260,14 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     g = jax.random.gumbel(next_key(), tuple(x.shape), x._value.dtype)
 
     return _gs(x, Tensor(g), temperature=temperature, hard=hard, axis=axis)
+
+
+# generated inplace activation variants
+elu_ = _make_act_inplace("elu_", elu)
+tanh_ = _make_act_inplace("tanh_", tanh)
+hardtanh_ = _make_act_inplace("hardtanh_", hardtanh)
+leaky_relu_ = _make_act_inplace("leaky_relu_", leaky_relu)
+thresholded_relu_ = _make_act_inplace("thresholded_relu_", thresholded_relu)
+softmax_ = _make_act_inplace("softmax_", softmax)
+__all__ += ["elu_", "tanh_", "hardtanh_", "leaky_relu_",
+            "thresholded_relu_", "softmax_"]

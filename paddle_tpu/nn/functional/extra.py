@@ -1,0 +1,465 @@
+"""Long-tail nn functionals completing the reference surface (reference:
+python/paddle/nn/functional/ — sequence_mask, temporal_shift, rrelu,
+max_unpool*, margin losses, hsigmoid_loss, rnnt_loss, beam-search utils).
+
+Differentiable pieces are pure-jnp under ``defop`` (vjp'd by the autograd
+engine); dynamic-shape utilities (class_center_sample, gather_tree) are
+host-side eager like the reference's dynamic-output kernels."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import defop
+
+__all__ = [
+    "sequence_mask", "temporal_shift", "rrelu", "max_unpool1d",
+    "max_unpool2d", "max_unpool3d", "gather_tree", "class_center_sample",
+    "margin_cross_entropy", "multi_margin_loss",
+    "triplet_margin_with_distance_loss", "hsigmoid_loss", "rnnt_loss",
+    "sparse_attention",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+# ---- masks & video -------------------------------------------------------
+
+@defop("sequence_mask", differentiable=False)
+def _sequence_mask(lengths, maxlen, dtype):
+    rng = jnp.arange(maxlen)
+    return (rng[None, :] < lengths[..., None].astype(rng.dtype)).astype(dtype)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """[..., maxlen] mask of 1s up to each length (reference:
+    nn/functional/extension.py sequence_mask)."""
+    from ...core.dtype import convert_dtype
+    xx = _t(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(xx._value).max())
+    return _sequence_mask(xx, maxlen=int(maxlen), dtype=convert_dtype(dtype))
+
+
+@defop("temporal_shift")
+def _temporal_shift(x, seg_num, shift_ratio):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    back = jnp.concatenate(
+        [x5[:, 1:, :c1], jnp.zeros_like(x5[:, :1, :c1])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(x5[:, :1, c1:c2]), x5[:, :-1, c1:c2]], axis=1)
+    keep = x5[:, :, c2:]
+    return jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift (reference: nn/functional/extension.py
+    temporal_shift → phi temporal_shift kernel)."""
+    xx = _t(x)
+    if data_format == "NHWC":
+        from ...ops.manipulation import transpose
+        xx = transpose(xx, [0, 3, 1, 2])
+        out = _temporal_shift(xx, seg_num=int(seg_num),
+                              shift_ratio=float(shift_ratio))
+        return transpose(out, [0, 2, 3, 1])
+    return _temporal_shift(xx, seg_num=int(seg_num),
+                           shift_ratio=float(shift_ratio))
+
+
+# ---- rrelu ---------------------------------------------------------------
+
+@defop("rrelu_train")
+def _rrelu_train(xa, key, lo, hi):
+    a = jax.random.uniform(key, xa.shape, xa.dtype, lo, hi)
+    return jnp.where(xa >= 0, xa, a * xa)
+
+
+@defop("rrelu_eval")
+def _rrelu_eval(xa, s):
+    return jnp.where(xa >= 0, xa, s * xa)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    """Randomized leaky ReLU (reference: nn/functional/activation.py rrelu).
+    Training samples the negative slope U(lower, upper); eval uses the
+    mean slope like the reference kernel."""
+    from ...ops.random import next_key
+    xx = _t(x)
+    if training:
+        return _rrelu_train(xx, key=next_key(), lo=float(lower),
+                            hi=float(upper))
+    return _rrelu_eval(xx, s=(float(lower) + float(upper)) / 2.0)
+
+
+# ---- max unpool ----------------------------------------------------------
+
+@defop("max_unpool")
+def _unpool_scatter(xa, ia, out_shape):
+    nb, c = xa.shape[0], xa.shape[1]
+    plane = 1
+    for d in out_shape:
+        plane *= d
+    flat_x = xa.reshape(nb, c, -1)
+    flat_i = ia.reshape(nb, c, -1)
+    zeros = jnp.zeros((nb, c, plane), xa.dtype)
+    out = jax.vmap(jax.vmap(lambda z, i, v: z.at[i].set(v)))(
+        zeros, flat_i, flat_x)
+    return out.reshape((nb, c) + tuple(out_shape))
+
+
+def _unpool(x, indices, n, kernel_size, stride, padding, output_size):
+    """Shared unpool body: scatter pooled values back to their argmax flat
+    positions within each (N, C) plane (reference: phi unpool kernels)."""
+
+    def _norm(v, default=None):
+        if v is None:
+            v = default
+        if isinstance(v, int):
+            return [v] * n
+        return list(v)
+
+    k = _norm(kernel_size)
+    s = _norm(stride, k)
+    p = _norm(padding if padding is not None else 0)
+    xx, idx = _t(x), _t(indices)
+    in_spatial = xx.shape[2:]
+    if output_size is None:
+        output_size = [(in_spatial[i] - 1) * s[i] - 2 * p[i] + k[i]
+                       for i in range(n)]
+    else:
+        output_size = list(output_size)[-n:]
+    return _unpool_scatter(xx, idx,
+                           out_shape=tuple(int(d) for d in output_size))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Inverse of max_pool1d given the pooling mask (reference:
+    nn/functional/pooling.py max_unpool1d)."""
+    return _unpool(x, indices, 1, kernel_size, stride, padding, output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool(x, indices, 2, kernel_size, stride, padding, output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool(x, indices, 3, kernel_size, stride, padding, output_size)
+
+
+# ---- beam-search utilities ----------------------------------------------
+
+def gather_tree(ids, parents):
+    """Backtrace full beams from per-step ids and parent pointers
+    (reference: nn/functional/extension.py gather_tree → phi gather_tree
+    kernel). Shapes [max_time, batch, beam]; host-side, non-differentiable
+    int op."""
+    ids_np = np.asarray(_v(ids))
+    par_np = np.asarray(_v(parents))
+    T, B, W = ids_np.shape
+    out = np.empty_like(ids_np)
+    out[T - 1] = ids_np[T - 1]
+    beam_idx = np.tile(np.arange(W)[None, :], (B, 1))
+    for t in range(T - 2, -1, -1):
+        beam_idx = np.take_along_axis(par_np[t + 1], beam_idx, axis=1)
+        out[t] = np.take_along_axis(ids_np[t], beam_idx, axis=1)
+    return Tensor(jnp.asarray(out))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers: all positive classes plus random negatives up
+    to num_samples; labels remapped into the sampled set (reference:
+    nn/functional/common.py class_center_sample, PartialFC). Dynamic-shape
+    → host-side eager like the reference's GPU kernel's host path."""
+    from ...ops.random import next_key
+    lab = np.asarray(_v(label)).astype(np.int64)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos,
+                                assume_unique=True)
+        # negatives drawn through the framework RNG so paddle.seed makes
+        # the sampling reproducible (and replicas sample consistently)
+        rng = np.random.default_rng(
+            np.asarray(jax.random.key_data(next_key())).ravel())
+        extra = rng.choice(neg_pool, size=num_samples - len(pos),
+                           replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    remap = -np.ones(num_classes, dtype=np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lab])),
+            Tensor(jnp.asarray(sampled)))
+
+
+# ---- margin losses -------------------------------------------------------
+
+@defop("margin_cross_entropy")
+def _margin_ce(logits, label, m1, m2, m3, scale):
+    # logits are cosines; apply combined angular margin to the target class
+    # (reference: phi margin_cross_entropy kernel — ArcFace family)
+    n, c = logits.shape
+    cos = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    target_cos = jnp.cos(m1 * theta + m2) - m3
+    onehot = jax.nn.one_hot(label, c, dtype=logits.dtype)
+    out = jnp.where(onehot > 0, target_cos, cos) * scale
+    logp = jax.nn.log_softmax(out, axis=-1)
+    loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+    return loss, jnp.exp(logp)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """Combined-margin softmax CE over cosine logits (reference:
+    nn/functional/common.py margin_cross_entropy)."""
+    loss, softmax = _margin_ce(_t(logits), _v(label).astype("int32"),
+                               m1=float(margin1), m2=float(margin2),
+                               m3=float(margin3), scale=float(scale))
+    from ...ops.reduction import mean as _mean, sum as _sum
+    if reduction == "mean":
+        loss = _mean(loss)
+    elif reduction == "sum":
+        loss = _sum(loss)
+    return (loss, softmax) if return_softmax else loss
+
+
+@defop("multi_margin_loss")
+def _multi_margin(input, label, weight, p, margin, reduction):
+    n, c = input.shape
+    target = jnp.take_along_axis(input, label[:, None], axis=1)
+    diff = jnp.maximum(margin - target + input, 0.0)
+    if p != 1:
+        diff = diff ** p
+    if weight is not None:
+        diff = diff * weight[label][:, None]
+    onehot = jax.nn.one_hot(label, c, dtype=input.dtype)
+    loss = jnp.sum(diff * (1 - onehot), axis=1) / c
+    return _reduce(loss, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class hinge loss (reference: nn/functional/loss.py
+    multi_margin_loss)."""
+    w = _t(weight) if weight is not None else None
+    return _multi_margin(_t(input), _v(label).astype("int32"), w,
+                         p=int(p), margin=float(margin), reduction=reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Triplet loss with a custom distance callable (reference:
+    nn/functional/loss.py triplet_margin_with_distance_loss)."""
+    from ...ops import math as om
+    from .common import pairwise_distance
+    dist = distance_function or pairwise_distance
+    a, p_, n_ = _t(input), _t(positive), _t(negative)
+    d_pos = dist(a, p_)
+    d_neg = dist(a, n_)
+    if swap:
+        d_pn = dist(p_, n_)
+        d_neg = om.minimum(d_neg, d_pn)
+    from ...ops.math import maximum
+    loss = maximum(d_pos - d_neg + margin, _t(jnp.asarray(0.0)))
+    from ...ops.reduction import mean as _mean, sum as _sum
+    if reduction == "mean":
+        return _mean(loss)
+    if reduction == "sum":
+        return _sum(loss)
+    return loss
+
+
+# ---- hierarchical sigmoid ------------------------------------------------
+
+@defop("hsigmoid_loss")
+def _hsig(x, w, b, tbl, cod, msk):
+    # x:[N,D] w:[K,D] tbl/cod/msk:[N,P]
+    wsel = w[tbl]                      # [N,P,D]
+    logits = jnp.einsum("npd,nd->np", wsel, x)
+    if b is not None:
+        logits = logits + b.reshape(-1)[tbl]
+    # BCE with code bit as target, masked over real path length
+    lsf = jax.nn.log_sigmoid(logits)
+    lsb = jax.nn.log_sigmoid(-logits)
+    bce = -(cod * lsf + (1.0 - cod) * lsb)
+    return jnp.sum(bce * msk, axis=1, keepdims=True)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree, or a
+    custom tree via path_table/path_code (reference: nn/functional/loss.py
+    hsigmoid_loss; default-tree bit-code walk mirrors the phi
+    MatrixBitCodeFunctor)."""
+    xx, lab = _t(input), np.asarray(_v(label)).astype(np.int64).reshape(-1)
+    w, b = _t(weight), (_t(bias) if bias is not None else None)
+
+    if path_table is None:
+        # default complete binary tree: leaf for class c is heap node
+        # c + num_classes (1-indexed); internal nodes 1..num_classes-1
+        depth = int(math.floor(math.log2(max(num_classes - 1, 1)))) + 2
+        codes = lab + num_classes
+        tbl = np.zeros((len(lab), depth), dtype=np.int64)
+        cod = np.zeros((len(lab), depth), dtype=np.float32)
+        msk = np.zeros((len(lab), depth), dtype=np.float32)
+        for r, code in enumerate(codes):
+            path = []
+            node = int(code)
+            while node > 1:
+                path.append((node // 2, node & 1))
+                node //= 2
+            path.reverse()  # root -> leaf
+            for i, (parent, bit) in enumerate(path):
+                tbl[r, i] = parent - 1  # weight row of the internal node
+                cod[r, i] = bit
+                msk[r, i] = 1.0
+    else:
+        tbl = np.asarray(_v(path_table)).astype(np.int64)
+        cod = np.asarray(_v(path_code)).astype(np.float32)
+        msk = (tbl >= 0).astype(np.float32)
+        tbl = np.maximum(tbl, 0)
+
+    return _hsig(xx, w, b, tbl=jnp.asarray(tbl), cod=jnp.asarray(cod),
+                 msk=jnp.asarray(msk))
+
+
+# ---- RNN-T loss ----------------------------------------------------------
+
+@defop("rnnt_loss")
+def _rnnt_loss(logits, labels, in_lens, lab_lens, blank, fastemit_lambda):
+    """Transducer forward-algorithm loss in log space (reference: phi
+    warprnnt kernel wrapping warp-transducer; here the alpha recursion is
+    two nested lax.scans XLA unrolls onto the VPU).
+
+    logits: [B, T, U1, V] (U1 = max label len + 1), labels: [B, U]."""
+    B, T, U1, V = logits.shape
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    lp_blank = lp[..., blank]                              # [B, T, U1]
+    lab = labels.astype(jnp.int32)
+    lp_lab = jnp.take_along_axis(
+        lp[:, :, :-1, :], lab[:, None, :, None], axis=-1)[..., 0]  # [B,T,U]
+    if fastemit_lambda:
+        # FastEmit regularization (warprnnt semantics): scale the GRADIENT
+        # of label-emission log-probs by (1 + lambda) while leaving the
+        # forward loss value unchanged — expressed as the straight-through
+        # identity (1+l)*x - l*stop_gradient(x)
+        lp_lab = ((1.0 + fastemit_lambda) * lp_lab
+                  - fastemit_lambda * jax.lax.stop_gradient(lp_lab))
+
+    def row_scan(prev_row, t):
+        # prev_row: alpha[t-1, :] ([B, U1]); compute alpha[t, :]
+        from_blank = prev_row + lp_blank[:, t - 1, :]
+
+        def cell(carry, u):
+            # carry: alpha[t, u-1] ([B])
+            from_lab = carry + lp_lab[:, t, u - 1]
+            val = jnp.logaddexp(from_blank[:, u], from_lab)
+            return val, val
+
+        first = from_blank[:, 0]
+        _, rest = jax.lax.scan(cell, first, jnp.arange(1, U1))
+        row = jnp.concatenate([first[:, None], rest.T], axis=1)
+        return row, row
+
+    # t = 0 row: pure label emissions along u
+    def cell0(carry, u):
+        val = carry + lp_lab[:, 0, u - 1]
+        return val, val
+
+    z = jnp.zeros((B,), lp.dtype)
+    _, rest0 = jax.lax.scan(cell0, z, jnp.arange(1, U1))
+    row0 = jnp.concatenate([z[:, None], rest0.T], axis=1)
+
+    if T > 1:
+        _, rows = jax.lax.scan(row_scan, row0, jnp.arange(1, T))
+        alpha = jnp.concatenate([row0[None], rows], axis=0)  # [T, B, U1]
+    else:
+        alpha = row0[None]
+    alpha = jnp.transpose(alpha, (1, 0, 2))                  # [B, T, U1]
+
+    bidx = jnp.arange(B)
+    t_last = jnp.clip(in_lens.astype(jnp.int32) - 1, 0, T - 1)
+    u_last = jnp.clip(lab_lens.astype(jnp.int32), 0, U1 - 1)
+    final = alpha[bidx, t_last, u_last] + lp_blank[bidx, t_last, u_last]
+    return -final
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference: nn/functional/loss.py rnnt_loss)."""
+    loss = _rnnt_loss(_t(input), _v(label).astype("int32"),
+                      _v(input_lengths).astype("int32"),
+                      _v(label_lengths).astype("int32"),
+                      blank=int(blank),
+                      fastemit_lambda=float(fastemit_lambda))
+    from ...ops.reduction import mean as _mean, sum as _sum
+    if reduction == "mean":
+        return _mean(loss)
+    if reduction == "sum":
+        return _sum(loss)
+    return loss
+
+
+# ---- sparse attention ----------------------------------------------------
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention with a CSR connectivity pattern (reference:
+    nn/functional/sparse_attention.py — GPU-only kernel there). TPU-native
+    semantics: materialize the CSR pattern as an additive mask and let XLA
+    fuse; correct for the reference's [B, H, S, S] CSR layout."""
+    q, k, v = _t(query), _t(key), _t(value)
+    off = np.asarray(_v(sparse_csr_offset)).astype(np.int64)
+    col = np.asarray(_v(sparse_csr_columns)).astype(np.int64)
+    B, H, S, D = q.shape
+    # vectorized CSR -> dense mask: expand row ids by per-row counts, then
+    # one scatter — no per-row python loop on the forward path
+    counts = np.diff(off, axis=-1).reshape(B, H, S)
+    mask = np.zeros((B, H, S, S), dtype=np.float32)
+    bh_rows = counts.reshape(B * H, S)
+    cols_flat = col.reshape(B * H, -1)
+    for bh in range(B * H):
+        rows = np.repeat(np.arange(S), bh_rows[bh])
+        mask.reshape(B * H, S, S)[bh, rows, cols_flat[bh, :len(rows)]] = 1.0
+    return _sa(q, k, v, Tensor(jnp.asarray(mask)))
+
+
+@defop("sparse_attention")
+def _sa(q, k, v, mask):
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k) / jnp.sqrt(
+        jnp.asarray(q.shape[-1], q.dtype))
+    scores = jnp.where(mask > 0, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", probs, v)

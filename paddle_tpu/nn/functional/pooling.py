@@ -3,6 +3,7 @@ phi pool kernels). TPU-native: lax.reduce_window."""
 
 from __future__ import annotations
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -91,28 +92,110 @@ def _avg_pool(x, kernel, stride, padding, n, ceil_mode=False, exclusive=True):
     return summed / denom
 
 
+@defop("max_pool_mask", differentiable=False)
+def _max_pool_mask(x, kernel, stride, padding, n, ceil_mode=False):
+    """Argmax flat index (into each channel's spatial plane) per pooling
+    window — the mask consumed by max_unpool (reference: phi
+    max_pool_with_index kernels)."""
+    spatial = x.shape[2:]
+    if ceil_mode:
+        # extend right pad the same way _pool does so mask and pooled
+        # output shapes agree
+        padding = list(padding)
+        for i in range(n):
+            lo, hi = padding[i]
+            out = (spatial[i] + lo + hi - kernel[i]
+                   + stride[i] - 1) // stride[i] + 1
+            needed = (out - 1) * stride[i] + kernel[i] - spatial[i] - lo
+            padding[i] = (lo, max(hi, needed))
+    out_sizes = [(spatial[i] + padding[i][0] + padding[i][1] - kernel[i])
+                 // stride[i] + 1 for i in range(n)]
+    # flat index of every input cell, padded with -1 sentinels
+    flat = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+    flat = jnp.pad(flat, [(lo, hi) for lo, hi in padding],
+                   constant_values=-1)
+    # window gather: for each output cell collect its kernel's flat indices
+    idx_grids = []
+    for i in range(n):
+        starts = jnp.arange(out_sizes[i]) * stride[i]
+        win = jnp.arange(kernel[i])
+        idx_grids.append(starts[:, None] + win[None, :])  # [out_i, k_i]
+    patches = flat
+    for i in range(n):
+        patches = jnp.take(patches, idx_grids[i].reshape(-1), axis=2 * i)
+        shp = patches.shape
+        patches = patches.reshape(shp[:2 * i]
+                                  + (out_sizes[i], kernel[i]) + shp[2 * i + 1:])
+    # patches dims: [o1, k1, o2, k2, ...] -> [o1, o2, ..., k1*k2*...]
+    perm = [2 * i for i in range(n)] + [2 * i + 1 for i in range(n)]
+    patches = jnp.transpose(patches, perm).reshape(tuple(out_sizes) + (-1,))
+    # gather values for the same windows from x and argmax
+    xflat = x.reshape(x.shape[:2] + (-1,))
+    neg = jnp.asarray(-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                      else jnp.iinfo(x.dtype).min, x.dtype)
+    vals = jnp.where(patches[None, None] >= 0,
+                     xflat[:, :, jnp.clip(patches, 0)], neg)
+    am = jnp.argmax(vals, axis=-1)
+    return jnp.take_along_axis(
+        jnp.broadcast_to(patches[None, None], vals.shape), am[..., None],
+        axis=-1)[..., 0].astype(jnp.int32)
+
+
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     k = _norm(kernel_size, 2)
     s = _norm(stride, 2) or k
-    return _max_pool(_t(x), kernel=k, stride=s, padding=_norm_pad(padding, 2),
-                     n=2, ceil_mode=ceil_mode)
+    out = _max_pool(_t(x), kernel=k, stride=s, padding=_norm_pad(padding, 2),
+                    n=2, ceil_mode=ceil_mode)
+    if return_mask:
+        pad = _norm_pad(padding, 2)
+        if isinstance(pad, str):
+            raise NotImplementedError(
+                "return_mask with string padding is not supported; pass "
+                "explicit pads (reference max_pool_with_index has the "
+                "same explicit-pad contract)")
+        mask = _max_pool_mask(_t(x), kernel=k, stride=s, padding=pad,
+                              n=2, ceil_mode=ceil_mode)
+        return out, mask
+    return out
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
     k = _norm(kernel_size, 1)
     s = _norm(stride, 1) or k
-    return _max_pool(_t(x), kernel=k, stride=s, padding=_norm_pad(padding, 1),
-                     n=1, ceil_mode=ceil_mode)
+    out = _max_pool(_t(x), kernel=k, stride=s, padding=_norm_pad(padding, 1),
+                    n=1, ceil_mode=ceil_mode)
+    if return_mask:
+        pad = _norm_pad(padding, 1)
+        if isinstance(pad, str):
+            raise NotImplementedError(
+                "return_mask with string padding is not supported; pass "
+                "explicit pads (reference max_pool_with_index has the "
+                "same explicit-pad contract)")
+        mask = _max_pool_mask(_t(x), kernel=k, stride=s, padding=pad,
+                              n=1, ceil_mode=ceil_mode)
+        return out, mask
+    return out
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     k = _norm(kernel_size, 3)
     s = _norm(stride, 3) or k
-    return _max_pool(_t(x), kernel=k, stride=s, padding=_norm_pad(padding, 3),
-                     n=3, ceil_mode=ceil_mode)
+    out = _max_pool(_t(x), kernel=k, stride=s, padding=_norm_pad(padding, 3),
+                    n=3, ceil_mode=ceil_mode)
+    if return_mask:
+        pad = _norm_pad(padding, 3)
+        if isinstance(pad, str):
+            raise NotImplementedError(
+                "return_mask with string padding is not supported; pass "
+                "explicit pads (reference max_pool_with_index has the "
+                "same explicit-pad contract)")
+        mask = _max_pool_mask(_t(x), kernel=k, stride=s, padding=pad,
+                              n=3, ceil_mode=ceil_mode)
+        return out, mask
+    return out
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,

@@ -1,0 +1,310 @@
+"""Long-tail nn layers completing the reference surface (reference:
+python/paddle/nn/layer/ — vision.py ChannelShuffle, distance.py
+PairwiseDistance, activation.py Softmax2D/RReLU, common.py Unflatten,
+pooling.py MaxUnPool*, loss.py HSigmoidLoss/MultiMarginLoss/RNNTLoss/
+TripletMarginWithDistanceLoss, and nn/decode.py BeamSearchDecoder +
+dynamic_decode)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, Parameter
+from .layers import Layer
+from .. import functional as F
+
+__all__ = [
+    "ChannelShuffle", "PairwiseDistance", "Softmax2D", "Unflatten", "RReLU",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "HSigmoidLoss",
+    "MultiMarginLoss", "RNNTLoss", "TripletMarginWithDistanceLoss",
+    "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+class ChannelShuffle(Layer):
+    """reference nn/layer/vision.py ChannelShuffle."""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._groups = groups
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self._groups, self._data_format)
+
+
+class PairwiseDistance(Layer):
+    """reference nn/layer/distance.py PairwiseDistance."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (reference:
+    nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects 3D/4D input, got ndim={x.ndim}")
+        return F.softmax(x, axis=-3)
+
+
+class Unflatten(Layer):
+    """reference nn/layer/common.py Unflatten."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ...ops.extras import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class RReLU(Layer):
+    """reference nn/layer/activation.py RReLU — random slope in training,
+    mean slope in eval."""
+
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self._lower, self._upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._lower, self._upper, training=self.training)
+
+
+class _MaxUnPoolNd(Layer):
+    _fn = None
+    _n = 0
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.ksize, self.stride, self.padding = kernel_size, stride, padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return type(self)._fn(x, indices, self.ksize, self.stride,
+                              self.padding, output_size=self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolNd):
+    """reference nn/layer/pooling.py MaxUnPool1D."""
+    _fn = staticmethod(lambda x, i, k, s, p, output_size=None:
+                       F.max_unpool1d(x, i, k, s, p,
+                                      output_size=output_size))
+
+
+class MaxUnPool2D(_MaxUnPoolNd):
+    _fn = staticmethod(lambda x, i, k, s, p, output_size=None:
+                       F.max_unpool2d(x, i, k, s, p,
+                                      output_size=output_size))
+
+
+class MaxUnPool3D(_MaxUnPoolNd):
+    _fn = staticmethod(lambda x, i, k, s, p, output_size=None:
+                       F.max_unpool3d(x, i, k, s, p,
+                                      output_size=output_size))
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classifier head (reference: nn/layer/loss.py
+    HSigmoidLoss — holds the [num_classes-1, feature_size] internal-node
+    weights)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self._num_classes = num_classes
+        from .. import initializer as I
+        init = I.XavierNormal()
+        rows = num_classes - 1 if not is_custom else num_classes
+        self.weight = Parameter(init([rows, feature_size], jnp.float32))
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((rows, 1), jnp.float32))
+        else:
+            self.bias = None
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)
+
+
+class MultiMarginLoss(Layer):
+    """reference nn/layer/loss.py MultiMarginLoss."""
+
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin, self.weight = p, margin, weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class RNNTLoss(Layer):
+    """reference nn/layer/loss.py RNNTLoss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """reference nn/layer/loss.py TripletMarginWithDistanceLoss."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+# ---- beam search decoding ------------------------------------------------
+
+def _map_structure(fn, *structs):
+    s0 = structs[0]
+    if isinstance(s0, (list, tuple)):
+        return type(s0)(_map_structure(fn, *es) for es in zip(*structs))
+    return fn(*structs)
+
+
+class BeamSearchDecoder:
+    """Beam-search decoder over an RNN cell (reference: nn/decode.py
+    BeamSearchDecoder — initialize/step/finalize protocol driven by
+    dynamic_decode). Scores are length-accumulated log probabilities;
+    finished beams only ever extend with end_token."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers ----------------------------------------------------------
+    def _merge(self, t):
+        v = t._value
+        return Tensor(v.reshape((-1,) + v.shape[2:]))
+
+    def _split(self, t, batch):
+        v = t._value
+        return Tensor(v.reshape((batch, self.beam_size) + v.shape[1:]))
+
+    def _tile_beam(self, t):
+        v = t._value
+        tiled = jnp.repeat(v[:, None], self.beam_size, axis=1)
+        return Tensor(tiled)
+
+    def initialize(self, initial_cell_states):
+        states = _map_structure(self._tile_beam, initial_cell_states)
+        probe = states
+        while isinstance(probe, (list, tuple)):
+            probe = probe[0]
+        batch = probe.shape[0]
+        ids = Tensor(jnp.full((batch, self.beam_size), self.start_token,
+                              jnp.int32))
+        # only beam 0 is live initially so identical beams don't dominate
+        log_probs = jnp.full((batch, self.beam_size), -1e9, jnp.float32)
+        log_probs = log_probs.at[:, 0].set(0.0)
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        return ids, states, Tensor(log_probs), Tensor(finished)
+
+    def step(self, inputs, states, log_probs, finished):
+        batch = inputs.shape[0]
+        emb = self.embedding_fn(self._merge(inputs)) if self.embedding_fn \
+            else self._merge(inputs)
+        flat_states = _map_structure(self._merge, states)
+        out, new_states = self.cell(emb, flat_states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        logits = out._value.reshape(batch, self.beam_size, -1)
+        vocab = logits.shape[-1]
+        step_lp = jnp.log(jnp.maximum(
+            jnp.exp(logits - logits.max(-1, keepdims=True)) /
+            jnp.exp(logits - logits.max(-1, keepdims=True)).sum(
+                -1, keepdims=True), 1e-20))
+        # finished beams emit only end_token with prob 1
+        fin = finished._value[..., None]
+        onehot_end = (jnp.arange(vocab) == self.end_token)
+        step_lp = jnp.where(fin, jnp.where(onehot_end, 0.0, -1e9), step_lp)
+        total = log_probs._value[..., None] + step_lp
+        flat = total.reshape(batch, -1)
+        top_lp, top_idx = jax.lax.top_k(flat, self.beam_size)
+        parent = top_idx // vocab
+        token = top_idx % vocab
+        new_states = _map_structure(
+            lambda t: self._gather_beams(self._split(t, batch), parent),
+            new_states)
+        new_finished = jnp.take_along_axis(finished._value, parent, axis=1) \
+            | (token == self.end_token)
+        return (Tensor(token.astype(jnp.int32)), Tensor(parent),
+                new_states, Tensor(top_lp), Tensor(new_finished))
+
+    def _gather_beams(self, t, parent):
+        v = t._value
+        idx = parent
+        for _ in range(v.ndim - 2):
+            idx = idx[..., None]
+        return Tensor(jnp.take_along_axis(
+            v, jnp.broadcast_to(idx, parent.shape + v.shape[2:]), axis=1))
+
+    def finalize(self, step_ids, step_parents):
+        ids = Tensor(jnp.stack([t._value for t in step_ids]))
+        parents = Tensor(jnp.stack([t._value for t in step_parents]))
+        return F.gather_tree(ids, parents)
+
+
+import jax  # noqa: E402
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=
+                   False, is_test=False, return_length=False, **kwargs):
+    """Drive a decoder's initialize/step loop until every beam finishes or
+    max_step_num (reference: nn/decode.py dynamic_decode)."""
+    ids, states, log_probs, finished = decoder.initialize(inits)
+    step_ids, step_parents = [], []
+    lengths = jnp.zeros(finished._value.shape, jnp.int32)
+    for _ in range(max_step_num):
+        token, parent, states, log_probs, finished = decoder.step(
+            ids, states, log_probs, finished)
+        step_ids.append(token)
+        step_parents.append(parent)
+        lengths = lengths + (~finished._value).astype(lengths.dtype)
+        ids = token
+        if bool(finished._value.all()):
+            break
+    out = decoder.finalize(step_ids, step_parents)
+    if not output_time_major:
+        from ...ops.manipulation import transpose
+        out = transpose(out, [1, 0, 2])
+    if return_length:
+        return out, log_probs, Tensor(lengths)
+    return out, log_probs

@@ -14,7 +14,7 @@ from ...core.tensor import Tensor
 from .layers import Layer
 
 __all__ = ["SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell", "GRUCell",
-           "RNN", "BiRNN"]
+           "RNN", "BiRNN", "RNNCellBase"]
 
 
 def _t(x):

@@ -20,10 +20,11 @@ from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
-from . import creation, math, manipulation, reduction, linalg, logic, search, random  # noqa: F401
+from . import creation, math, manipulation, reduction, linalg, logic, search, random, extras  # noqa: F401
 
-_MODULES = [creation, math, manipulation, reduction, linalg, logic, search, random]
+_MODULES = [creation, math, manipulation, reduction, linalg, logic, search, random, extras]
 
 
 def _collect():
@@ -35,6 +36,16 @@ def _collect():
 
 
 _NS = _collect()
+
+# ---------------------------------------------------------------------------
+# In-place variants: generated from the base surface (reference: the
+# generated `add_`/`tanh_`/... inplace APIs + version-counter semantics).
+# ---------------------------------------------------------------------------
+from . import inplace as _inplace_mod  # noqa: E402
+
+_INPLACE_NS = _inplace_mod._install(_NS)
+_NS.update(_INPLACE_NS)
+globals().update(_INPLACE_NS)
 
 # ---------------------------------------------------------------------------
 # Tensor method installation
@@ -74,10 +85,17 @@ _METHOD_NAMES = [
     "index_fill", "searchsorted", "bucketize", "nonzero",
     # random inplace
     "uniform_", "normal_", "exponential_",
+    # extras (long tail)
+    "addmm", "cdist", "cummin", "diag_embed", "diagonal", "diff", "frexp",
+    "renorm", "sgn", "take", "trace", "unflatten", "unfold", "vsplit",
+    "as_strided",
 ]
 
 
 def _install_tensor_methods():
+    for name, fn in _INPLACE_NS.items():
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
     for name in _METHOD_NAMES:
         fn = _NS.get(name)
         if fn is None:
@@ -158,10 +176,8 @@ def _install_tensor_methods():
                            lambda x: x.at[jidx].set(value),
                            (self,), {})
         # in-place semantics: adopt the new value and graph position
-        self._value = out._value
-        self._grad_node = out._grad_node
-        self._out_index = out._out_index
-        self.stop_gradient = out.stop_gradient
+        # (shadow substitution prevents the self-loop — see inplace._adopt)
+        _inplace_mod._adopt(self, out)
 
     Tensor.__getitem__ = _getitem
     Tensor.__setitem__ = _setitem

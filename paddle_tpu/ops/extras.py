@@ -1,0 +1,405 @@
+"""Long-tail tensor ops completing the reference surface (reference:
+python/paddle/tensor/math.py addmm/trace/diff/..., manipulation.py
+unfold/as_strided/..., linalg.py cdist, creation.py diag_embed/vander).
+
+Each op is one pure-jnp body under ``defop`` like the rest of the op
+surface; XLA fuses the gather/arith chains these produce."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import defop
+
+__all__ = [
+    "addmm", "cdist", "cummin", "diag_embed", "diagonal", "diff", "frexp",
+    "polygamma", "renorm", "sgn", "take", "trace", "unflatten",
+    "unfold", "vander", "vsplit", "hsplit", "dsplit", "broadcast_shape",
+    "rank", "shape", "reverse", "scatter_nd", "histogramdd", "as_strided",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+# ---- math ----------------------------------------------------------------
+
+@defop("addmm")
+def _addmm(inp, x, y, beta, alpha):
+    return beta * inp + alpha * (x @ y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) (reference: tensor/math.py addmm)."""
+    return _addmm(_t(input), _t(x), _t(y), beta=float(beta), alpha=float(alpha))
+
+
+@defop("cdist")
+def _cdist(x, y, p):
+    # x: [..., P, M], y: [..., R, M] -> [..., P, R]
+    dx = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(dx * dx, axis=-1) + 1e-30)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(dx), axis=-1)
+    if p == 0.0:
+        return jnp.sum((dx != 0).astype(x.dtype), axis=-1)
+    ad = jnp.abs(dx)
+    return jnp.power(jnp.sum(jnp.power(ad, p), axis=-1), 1.0 / p)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Batched p-norm pairwise distance (reference: tensor/linalg.py cdist)."""
+    return _cdist(_t(x), _t(y), p=float(p))
+
+
+@defop("cummin_val")
+def _cummin_val(x, axis):
+    return jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+@defop("cummin_ind", differentiable=False)
+def _cummin_ind(x, axis, dtype):
+    n = x.shape[axis]
+    idx = jnp.arange(n, dtype=dtype)
+    bshape = [1] * x.ndim
+    bshape[axis] = n
+    idx = jnp.reshape(idx, bshape)
+    vals = jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+    is_new = x <= vals  # position achieving the running min
+    idxb = jnp.broadcast_to(idx, x.shape)
+    masked = jnp.where(is_new, idxb, jnp.array(-1, dtype))
+    return jax.lax.associative_scan(jnp.maximum, masked, axis=axis)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    """Running minimum + first-achieving indices (reference: tensor/math.py
+    cummin)."""
+    from ..core.dtype import convert_dtype
+    xx = _t(x)
+    if axis is None:
+        xx = xx.reshape([-1]) if xx.ndim != 1 else xx
+        axis = 0
+    axis = axis % xx.ndim
+    vals = _cummin_val(xx, axis=axis)
+    inds = _cummin_ind(xx, axis=axis, dtype=convert_dtype(dtype))
+    return vals, inds
+
+
+@defop("frexp_mant")
+def _frexp_mant(x):
+    return jnp.frexp(x)[0]
+
+
+@defop("frexp_exp", differentiable=False)
+def _frexp_exp(x):
+    return jnp.frexp(x)[1].astype(x.dtype)
+
+
+def frexp(x, name=None):
+    """Decompose into mantissa and exponent (reference: tensor/math.py
+    frexp)."""
+    xx = _t(x)
+    return _frexp_mant(xx), _frexp_exp(xx)
+
+
+@defop("polygamma")
+def _polygamma(x, n):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def polygamma(x, n, name=None):
+    if n == 0:
+        from .math import digamma
+        return digamma(x)
+    return _polygamma(_t(x), n=int(n))
+
+
+@defop("renorm")
+def _renorm(x, p, axis, max_norm):
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    if p == float("inf"):
+        norms = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    else:
+        norms = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axes,
+                                  keepdims=True), 1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor.astype(x.dtype)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize slices along `axis` whose p-norm exceeds max_norm
+    (reference: tensor/math.py renorm)."""
+    xx = _t(x)
+    return _renorm(xx, p=float(p), axis=axis % xx.ndim, max_norm=float(max_norm))
+
+
+@defop("sgn")
+def _sgn(x):
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, jnp.zeros_like(x), x / (mag + 1e-30))
+    return jnp.sign(x)
+
+
+def sgn(x, name=None):
+    """sign extended to complex (x/|x|) (reference: tensor/math.py sgn)."""
+    return _sgn(_t(x))
+
+
+@defop("trace")
+def _trace(x, offset, axis1, axis2):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    """Sum of a diagonal (reference: tensor/math.py trace)."""
+    return _trace(_t(x), offset=int(offset), axis1=int(axis1), axis2=int(axis2))
+
+
+@defop("diff")
+def _diff(x, n, axis):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    """n-th forward difference along an axis (reference: tensor/math.py
+    diff)."""
+    from .manipulation import concat
+    xx = _t(x)
+    parts = []
+    if prepend is not None:
+        parts.append(_t(prepend))
+    parts.append(xx)
+    if append is not None:
+        parts.append(_t(append))
+    if len(parts) > 1:
+        xx = concat(parts, axis=axis)
+    return _diff(xx, n=int(n), axis=int(axis))
+
+
+@defop("vander")
+def _vander(x, n, increasing):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (reference: tensor/creation.py vander)."""
+    xx = _t(x)
+    if n is None:
+        n = xx.shape[0]
+    return _vander(xx, n=int(n), increasing=bool(increasing))
+
+
+# ---- manipulation --------------------------------------------------------
+
+@defop("diagonal")
+def _diagonal(x, offset, axis1, axis2):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    """Extract a diagonal view (reference: tensor/manipulation.py
+    diagonal)."""
+    return _diagonal(_t(x), offset=int(offset), axis1=int(axis1),
+                     axis2=int(axis2))
+
+
+@defop("diag_embed")
+def _diag_embed(x, offset, dim1, dim2):
+    n = x.shape[-1] + abs(offset)
+    nd_out = x.ndim + 1
+    d1, d2 = dim1 % nd_out, dim2 % nd_out
+    base = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = base.at[..., r, c].set(x)
+    # move the two trailing (row, col) dims to (d1, d2)
+    perm = list(range(x.ndim - 1))  # batch dims
+    pos = {d1: x.ndim - 1, d2: x.ndim}
+    full = []
+    bi = 0
+    for i in range(nd_out):
+        if i in pos:
+            full.append(pos[i])
+        else:
+            full.append(perm[bi])
+            bi += 1
+    return jnp.transpose(out, full)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Embed last-dim vectors as diagonals of new matrices (reference:
+    tensor/creation.py diag_embed)."""
+    return _diag_embed(_t(input), offset=int(offset), dim1=int(dim1),
+                       dim2=int(dim2))
+
+
+@defop("take_flat")
+def _take_flat(x, index, mode):
+    flat = jnp.ravel(x)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = jnp.mod(index, n)
+    else:  # 'clip' and 'raise' (no eager bounds error under trace)
+        idx = jnp.clip(index, -n, n - 1)
+    idx = jnp.where(idx < 0, idx + n, idx)
+    return flat[idx]
+
+
+def take(x, index, mode="raise", name=None):
+    """Gather from the flattened tensor (reference: tensor/math.py take)."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"take: invalid mode {mode!r}")
+    return _take_flat(_t(x), _t(index), mode=mode)
+
+
+def unflatten(x, axis, shape, name=None):
+    """Split one axis into the given shape (reference:
+    tensor/manipulation.py unflatten)."""
+    from .manipulation import reshape
+    xx = _t(x)
+    axis = axis % xx.ndim
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    new_shape = xx.shape[:axis] + list(shape) + xx.shape[axis + 1:]
+    return reshape(xx, new_shape)
+
+
+@defop("tensor_unfold")  # distinct registry name: "unfold" is F.unfold's im2col
+def _unfold(x, axis, size, step):
+    n = x.shape[axis]
+    num = (n - size) // step + 1
+    starts = jnp.arange(num) * step
+    win = jnp.arange(size)
+    idx = starts[:, None] + win[None, :]  # [num, size]
+    out = jnp.take(x, idx.reshape(-1), axis=axis)
+    shp = list(x.shape)
+    shp[axis:axis + 1] = [num, size]
+    out = jnp.reshape(out, shp)
+    # paddle puts the window dim last
+    perm = list(range(out.ndim))
+    w = perm.pop(axis + 1)
+    perm.append(w)
+    return jnp.transpose(out, perm)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows over one axis, window dim appended last (reference:
+    tensor/manipulation.py unfold)."""
+    xx = _t(x)
+    return _unfold(xx, axis=axis % xx.ndim, size=int(size), step=int(step))
+
+
+@defop("as_strided")
+def _as_strided(x, shape, stride, offset):
+    flat = jnp.ravel(x)
+    idx = jnp.full((), offset, dtype=jnp.int32)
+    for s, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(s, dtype=jnp.int32) * st
+    return flat[idx.reshape(-1)].reshape(shape)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view materialized as a gather — TPU tensors are not
+    byte-addressable so this is a copy, matching XLA semantics (reference:
+    tensor/manipulation.py as_strided)."""
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+    if len(shape) != len(stride):
+        raise ValueError("as_strided: shape and stride must have equal rank")
+    return _as_strided(_t(x), shape=shape, stride=stride, offset=int(offset))
+
+
+def vsplit(x, num_or_indices, name=None):
+    """Split along dim 0 (reference: tensor/manipulation.py vsplit)."""
+    xx = _t(x)
+    if xx.ndim < 2:
+        raise ValueError("vsplit expects ndim >= 2")
+    return _np_style_split(xx, num_or_indices, 0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    xx = _t(x)
+    if xx.ndim < 1:
+        raise ValueError("hsplit expects ndim >= 1")
+    return _np_style_split(xx, num_or_indices, 1 if xx.ndim > 1 else 0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    xx = _t(x)
+    if xx.ndim < 3:
+        raise ValueError("dsplit expects ndim >= 3")
+    return _np_style_split(xx, num_or_indices, 2)
+
+
+def _np_style_split(xx, num_or_indices, axis):
+    from .manipulation import split
+    n = xx.shape[axis]
+    if isinstance(num_or_indices, int):
+        return split(xx, num_or_indices, axis=axis)
+    # indices -> section sizes
+    idx = [int(i) for i in num_or_indices]
+    bounds = [0] + idx + [n]
+    sizes = [b - a for a, b in zip(bounds[:-1], bounds[1:])]
+    return split(xx, sizes, axis=axis)
+
+
+def reverse(x, axis, name=None):
+    """Alias of flip kept for reference API parity (tensor/manipulation.py
+    reverse is deprecated in favor of flip)."""
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+@defop("scatter_nd")
+def _scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(shape, dtype=updates.dtype)
+    return zeros.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """Scatter-add updates into a zero tensor (reference:
+    tensor/manipulation.py scatter_nd → phi scatter_nd_add kernel)."""
+    shape = tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                  for s in shape)
+    return _scatter_nd(_t(index), _t(updates), shape=shape)
+
+
+# ---- search / query ------------------------------------------------------
+
+def broadcast_shape(x_shape, y_shape):
+    """Broadcast result shape of two shapes (reference: tensor/manipulation
+    broadcast_shape) — pure python, returns a list."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def rank(input, name=None):
+    """0-d int32 tensor holding ndim (reference: tensor/attribute.py rank)."""
+    return Tensor(jnp.asarray(_t(input).ndim, dtype=jnp.int32))
+
+
+def shape(input, name=None):
+    """1-d int32 tensor holding the shape (reference: tensor/attribute.py
+    shape op)."""
+    return Tensor(jnp.asarray(_t(input).shape, dtype=jnp.int32))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """Multi-dimensional histogram (reference: tensor/linalg.py
+    histogramdd). Eager host-side like the reference CPU kernel."""
+    arr = np.asarray(_t(x)._value)
+    w = np.asarray(_t(weights)._value) if weights is not None else None
+    if isinstance(bins, (list, tuple)) and len(bins) and isinstance(
+            bins[0], Tensor):
+        bins = [np.asarray(b._value) for b in bins]
+    hist, edges = np.histogramdd(arr, bins=bins, range=ranges,
+                                 density=density, weights=w)
+    return (Tensor(jnp.asarray(hist)),
+            [Tensor(jnp.asarray(e)) for e in edges])

@@ -1,0 +1,188 @@
+"""In-place op variants (reference: python/paddle/tensor/math.py `add_`,
+`tanh_`, ... — generated inplace APIs over phi inplace kernels, with the
+eager layer's inplace version counters).
+
+TPU arrays are immutable, so "in-place" here means: compute the out-of-place
+result through normal dispatch (autograd included), then rebind this python
+Tensor to the output's value and graph position — exactly the semantics the
+reference's inplace version-counter machinery enforces (a tensor mutated
+in-place IS the op output for autograd purposes). The reference's
+inplace-on-leaf rule is kept: mutating a leaf that requires grad raises.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["zero_", "fill_", "fill_diagonal_", "cauchy_", "geometric_",
+           "where_"]
+
+
+def _guard_leaf(x: Tensor, name: str) -> None:
+    from ..core.autograd import is_grad_enabled
+    if not is_grad_enabled():
+        # reference CheckInplace only enforces under require_any_grad:
+        # `with no_grad(): param.zero_()` is the standard optimizer/EMA
+        # pattern and must work
+        return
+    if not x.stop_gradient and x._grad_node is None:
+        raise RuntimeError(
+            f"{name}: in-place operation on a leaf tensor that requires "
+            "grad is not allowed (reference inplace-on-leaf rule)")
+
+
+def _adopt(x: Tensor, out: Tensor) -> Tensor:
+    """Rebind ``x`` to ``out``'s value and graph position.
+
+    Every GradNode snapshots its inputs' graph positions at record time
+    (core/autograd.py GradNode.input_positions), so nodes recorded before
+    this mutation — including the op that produced ``out``, whose input IS
+    ``x`` — keep routing cotangents through x's pre-mutation position.
+    The version bump lets create_graph vjp replay detect stale primals
+    (reference TensorWrapper inplace-version check)."""
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    x._version += 1
+    return x
+
+
+def _make_inplace(name: str, base):
+    def fn_(x, *args, **kwargs):
+        kwargs.pop("name", None)
+        _guard_leaf(x, name)
+        return _adopt(x, base(x, *args, **kwargs))
+
+    fn_.__name__ = name
+    fn_.__qualname__ = name
+    fn_.__doc__ = (f"In-place variant of ``{base.__name__}`` (reference: "
+                   f"tensor/*.py {name}). Returns the mutated tensor.")
+    return fn_
+
+
+# base-op name -> inplace surface name(s). Comparison/logical inplace ops
+# keep the input's buffer but adopt the (non-differentiable) result, same
+# as the reference's generated `equal_`/`logical_and_` surfaces.
+_INPLACE_OF = {
+    "abs": "abs_", "acos": "acos_", "asin": "asin_", "atan": "atan_",
+    "ceil": "ceil_", "clip": "clip_", "cos": "cos_", "cosh": "cosh_",
+    "cumprod": "cumprod_", "cumsum": "cumsum_", "digamma": "digamma_",
+    "divide": "divide_", "equal": "equal_", "erf": "erf_", "exp": "exp_",
+    "expm1": "expm1_", "flatten": "flatten_", "floor": "floor_",
+    "floor_divide": "floor_divide_", "floor_mod": "floor_mod_",
+    "frac": "frac_", "gcd": "gcd_", "greater_equal": "greater_equal_",
+    "greater_than": "greater_than_", "hypot": "hypot_", "i0": "i0_",
+    "lcm": "lcm_", "ldexp": "ldexp_", "lerp": "lerp_",
+    "less_equal": "less_equal_", "less_than": "less_than_",
+    "lgamma": "lgamma_", "log": "log_", "log10": "log10_", "log2": "log2_",
+    "log1p": "log1p_", "logical_and": "logical_and_",
+    "logical_not": "logical_not_", "logical_or": "logical_or_",
+    "logical_xor": "logical_xor_", "logit": "logit_",
+    "masked_fill": "masked_fill_", "mod": "mod_", "multiply": "multiply_",
+    "nan_to_num": "nan_to_num_", "neg": "neg_", "not_equal": "not_equal_",
+    "pow": "pow_", "put_along_axis": "put_along_axis_",
+    "reciprocal": "reciprocal_", "remainder": "remainder_",
+    "renorm": "renorm_", "reshape": "reshape_", "round": "round_",
+    "rsqrt": "rsqrt_", "scale": "scale_", "scatter": "scatter_",
+    "sigmoid": "sigmoid_", "sin": "sin_", "sinh": "sinh_",
+    "sqrt": "sqrt_", "square": "square_", "squeeze": "squeeze_",
+    "subtract": "subtract_", "add": "add_", "t": "t_", "tan": "tan_",
+    "tanh": "tanh_", "transpose": "transpose_", "tril": "tril_",
+    "triu": "triu_", "trunc": "trunc_", "unsqueeze": "unsqueeze_",
+    "cast": "cast_", "index_add": "index_add_",
+    "index_fill": "index_fill_", "index_put": "index_put_",
+    "bitwise_and": "bitwise_and_", "bitwise_not": "bitwise_not_",
+    "bitwise_or": "bitwise_or_", "bitwise_xor": "bitwise_xor_",
+    "addmm": "addmm_", "polygamma": "polygamma_",
+}
+
+
+def _install(ns: dict) -> dict:
+    """Create every inplace variant whose base op exists in ``ns``; return
+    {name: fn}. Called from ops/__init__ after the base surface is built."""
+    created = {}
+    for base_name, ip_name in _INPLACE_OF.items():
+        base = ns.get(base_name)
+        if base is None:
+            continue
+        created[ip_name] = _make_inplace(ip_name, base)
+    created.update({n: globals()[n] for n in __all__})
+    for n in created:
+        if n not in __all__:
+            __all__.append(n)
+    globals().update(created)
+    return created
+
+
+# ---- fills (no out-of-place base) ---------------------------------------
+
+def zero_(x, name=None):
+    """Fill with zeros in place (reference: tensor/math.py zero_)."""
+    _guard_leaf(x, "zero_")
+    x._in_place_update(jnp.zeros_like(x._value))
+    return x
+
+
+def fill_(x, value, name=None):
+    """Fill with a scalar in place (reference: tensor/math.py fill_)."""
+    _guard_leaf(x, "fill_")
+    v = value.item() if isinstance(value, Tensor) else value
+    x._in_place_update(jnp.full_like(x._value, v))
+    return x
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """Fill a diagonal in place (reference: tensor/manipulation.py
+    fill_diagonal_). ``offset`` selects super-/sub-diagonals; ``wrap``
+    continues the diagonal past tall-matrix blocks like numpy."""
+    _guard_leaf(x, "fill_diagonal_")
+    arr = np.asarray(x._value).copy()
+    if offset == 0:
+        np.fill_diagonal(arr, value, wrap=wrap)
+    else:
+        if arr.ndim != 2:
+            raise ValueError("fill_diagonal_ with offset expects a 2-D tensor")
+        m, n = arr.shape
+        i = np.arange(max(m, n))
+        r, c = i + max(-offset, 0), i + max(offset, 0)
+        keep = (r < m) & (c < n)
+        arr[r[keep], c[keep]] = value
+    x._in_place_update(jnp.asarray(arr))
+    return x
+
+
+def where_(condition, x, y, name=None):
+    """In-place where: ``x`` adopts where(condition, x, y) (reference:
+    tensor/search.py where_ — 'the output Tensor will be inplaced with
+    input x')."""
+    from .manipulation import where
+    _guard_leaf(x, "where_")
+    return _adopt(x, where(condition, x, y))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Fill with Cauchy samples in place (reference: tensor/random cauchy_)."""
+    from .random import next_key
+    import jax
+    _guard_leaf(x, "cauchy_")
+    u = jax.random.uniform(next_key(), tuple(x.shape), x.dtype,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    x._in_place_update(loc + scale * jnp.tan(jnp.pi * (u - 0.5)))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """Fill with Geometric(probs) samples in place (reference:
+    tensor/random geometric_)."""
+    from .random import next_key
+    import jax
+    _guard_leaf(x, "geometric_")
+    p = probs.item() if isinstance(probs, Tensor) else float(probs)
+    u = jax.random.uniform(next_key(), tuple(x.shape), x.dtype,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    x._in_place_update(jnp.ceil(jnp.log(u) / jnp.log1p(-p)))
+    return x

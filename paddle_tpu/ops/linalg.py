@@ -19,7 +19,7 @@ __all__ = [
     "triangular_solve", "cholesky_solve", "lu", "matrix_power", "matrix_rank",
     "det", "slogdet", "eig", "eigh", "eigvals", "eigvalsh", "lstsq",
     "multi_dot", "kron", "corrcoef", "cov", "histogram", "bincount",
-    "einsum", "matrix_transpose",
+    "einsum", "matrix_transpose", "cond", "householder_product",
 ]
 
 
@@ -391,3 +391,62 @@ def einsum(equation, *operands):
 def matrix_transpose(x, name=None):
     from .manipulation import swapaxes
     return swapaxes(_t(x), -1, -2)
+
+
+@defop("cond")
+def _cond(x, p):
+    if p in (None, 2, -2, "2", "-2"):
+        s = jnp.linalg.svd(x, compute_uv=False)
+        if p in (-2, "-2"):
+            return s[..., -1] / s[..., 0]
+        return s[..., 0] / s[..., -1]
+    if p == "fro":
+        nrm = jnp.sqrt(jnp.sum(x * x, axis=(-2, -1)))
+        nrm_inv = jnp.sqrt(jnp.sum(jnp.square(jnp.linalg.inv(x)),
+                                   axis=(-2, -1)))
+        return nrm * nrm_inv
+    if p == "nuc":
+        s = jnp.linalg.svd(x, compute_uv=False)
+        si = jnp.linalg.svd(jnp.linalg.inv(x), compute_uv=False)
+        return jnp.sum(s, -1) * jnp.sum(si, -1)
+    ord_ = float(p)
+    nrm = jnp.linalg.norm(x, ord=ord_, axis=(-2, -1))
+    nrm_inv = jnp.linalg.norm(jnp.linalg.inv(x), ord=ord_, axis=(-2, -1))
+    return nrm * nrm_inv
+
+
+def cond(x, p=None, name=None):
+    """Condition number w.r.t. the p-norm (reference: tensor/linalg.py
+    cond)."""
+    return _cond(_t(x), p=p)
+
+
+@defop("householder_product")
+def _householder_product(x, tau):
+    *batch, m, n = x.shape
+    k = tau.shape[-1]
+
+    def one(xm, tv):
+        q = jnp.eye(m, dtype=x.dtype)
+        for i in range(k):
+            v = jnp.where(jnp.arange(m) < i, 0.0, xm[:, i])
+            v = v.at[i].set(1.0)
+            q = q - tv[i] * (q @ v)[:, None] * v[None, :]
+        return q[:, :n]
+
+    if batch:
+        xf = x.reshape((-1, m, n))
+        tf = tau.reshape((-1, k))
+        out = jax.vmap(one)(xf, tf)
+        return out.reshape((*batch, m, n))
+    return one(x, tau)
+
+
+def householder_product(x, tau, name=None):
+    """Product of Householder reflectors (geqrf convention) — the first
+    n columns of Q (reference: tensor/linalg.py householder_product →
+    phi orgqr kernel)."""
+    xx, tt = _t(x), _t(tau)
+    if xx.shape[-2] < xx.shape[-1]:
+        raise ValueError("householder_product expects rows >= cols")
+    return _householder_product(xx, tt)

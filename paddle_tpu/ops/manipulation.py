@@ -23,6 +23,7 @@ __all__ = [
     "as_complex", "as_real", "view", "view_as", "crop", "strided_slice",
     "slice", "rot90", "tensordot", "broadcast_tensors", "atleast_1d",
     "atleast_2d", "atleast_3d", "index_put", "tolist", "numel", "shard_index",
+    "nonzero",
 ]
 
 
@@ -412,14 +413,17 @@ def where(condition, x=None, y=None, name=None):
 
 
 def nonzero(x, as_tuple=False):
-    """Dynamic-shape op: eager only (not jit-traceable), like reference
+    """Indices of non-zero elements, int64, [z, ndim] (or per-dim [z, 1]
+    tensors when as_tuple — reference tensor/search.py nonzero docstring).
+    Dynamic-shape op: eager only (not jit-traceable), like reference
     kernels that allocate by count."""
     import numpy as np
     arr = np.asarray(_v(x))
     nz = np.nonzero(arr)
     if as_tuple:
-        return tuple(Tensor(jnp.asarray(n)) for n in nz)
-    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+        return tuple(Tensor(jnp.asarray(n[:, None].astype("int64")))
+                     for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype("int64")))
 
 
 def masked_select(x, mask, name=None):

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 from ... import nn
 
-__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169", "densenet264",
            "densenet201"]
 
 _CFGS = {
@@ -12,6 +12,7 @@ _CFGS = {
     161: (96, 48, (6, 12, 36, 24)),
     169: (64, 32, (6, 12, 32, 32)),
     201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),  # reference densenet.py:254
 }
 
 
@@ -103,3 +104,4 @@ densenet121 = _make(121)
 densenet161 = _make(161)
 densenet169 = _make(169)
 densenet201 = _make(201)
+densenet264 = _make(264)

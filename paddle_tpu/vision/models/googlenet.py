@@ -1,0 +1,124 @@
+"""GoogLeNet / Inception-v1 (reference:
+python/paddle/vision/models/googlenet.py). Three-head output
+[out, aux1, aux2] like the reference; NCHW convs XLA maps to the MXU."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, flatten, squeeze
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class _Conv(nn.Layer):
+    """reference googlenet.py ConvLayer: bias-free conv, NO activation —
+    the only relu in the reference is after each Inception concat and
+    after the first aux fc."""
+
+    def __init__(self, in_ch, out_ch, k, stride=1):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, k, stride=stride,
+                              padding=(k - 1) // 2, bias_attr=False)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class _Inception(nn.Layer):
+    """reference googlenet.py Inception: 1x1 / 3x3 / 5x5 / pool-proj
+    branches concatenated on channels."""
+
+    def __init__(self, in_ch, f1, f3r, f3, f5r, f5, proj):
+        super().__init__()
+        self.b1 = _Conv(in_ch, f1, 1)
+        self.b3r = _Conv(in_ch, f3r, 1)
+        self.b3 = _Conv(f3r, f3, 3)
+        self.b5r = _Conv(in_ch, f5r, 1)
+        self.b5 = _Conv(f5r, f5, 5)
+        self.pool = nn.MaxPool2D(3, stride=1, padding=1)
+        self.proj = _Conv(in_ch, proj, 1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        cat = concat([self.b1(x), self.b3(self.b3r(x)),
+                      self.b5(self.b5r(x)), self.proj(self.pool(x))],
+                     axis=1)
+        return self.relu(cat)
+
+
+class GoogLeNet(nn.Layer):
+    """reference googlenet.py GoogLeNet — returns [out, out1, out2]
+    (main head + two auxiliary heads off inception 4a/4d)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self._conv = _Conv(3, 64, 7, 2)
+        self._pool = nn.MaxPool2D(3, stride=2)
+        self._conv_1 = _Conv(64, 64, 1)
+        self._conv_2 = _Conv(64, 192, 3)
+
+        self._ince3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self._ince3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self._ince4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self._ince4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self._ince4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self._ince4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self._ince4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self._ince5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self._ince5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+
+        if with_pool:
+            self._pool_5 = nn.AdaptiveAvgPool2D(1)
+            self._pool_o1 = nn.AvgPool2D(5, stride=3)
+            self._pool_o2 = nn.AvgPool2D(5, stride=3)
+
+        if num_classes > 0:
+            self._drop = nn.Dropout(0.4)
+            self._fc_out = nn.Linear(1024, num_classes)
+            self._conv_o1 = _Conv(512, 128, 1)
+            self._fc_o1 = nn.Linear(1152, 1024)
+            self._drop_o1 = nn.Dropout(0.7)
+            self._out1 = nn.Linear(1024, num_classes)
+            self._conv_o2 = _Conv(528, 128, 1)
+            self._fc_o2 = nn.Linear(1152, 1024)
+            self._drop_o2 = nn.Dropout(0.7)
+            self._out2 = nn.Linear(1024, num_classes)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self._pool(self._conv(x))
+        x = self._pool(self._conv_2(self._conv_1(x)))
+        x = self._pool(self._ince3b(self._ince3a(x)))
+        ince4a = self._ince4a(x)
+        x = self._ince4c(self._ince4b(ince4a))
+        ince4d = self._ince4d(x)
+        x = self._pool(self._ince4e(ince4d))
+        out = self._ince5b(self._ince5a(x))
+
+        out1, out2 = ince4a, ince4d
+        if self.with_pool:
+            out = self._pool_5(out)
+            out1 = self._pool_o1(out1)
+            out2 = self._pool_o2(out2)
+
+        if self.num_classes > 0:
+            out = self._fc_out(squeeze(self._drop(out), axis=[2, 3]))
+
+            out1 = self._conv_o1(out1)
+            out1 = self._fc_o1(flatten(out1, 1))
+            out1 = self._out1(self._drop_o1(self.relu(out1)))
+
+            out2 = self._conv_o2(out2)
+            out2 = self._fc_o2(flatten(out2, 1))
+            out2 = self._out2(self._drop_o2(out2))
+        return [out, out1, out2]
+
+
+def googlenet(pretrained=False, **kwargs):
+    """reference googlenet.py googlenet builder."""
+    if pretrained:
+        raise ValueError("pretrained weights unavailable in this build")
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,185 @@
+"""Inception-v3 (reference: python/paddle/vision/models/inceptionv3.py).
+
+Factorized 7x7/asymmetric convs — each branch is a conv+BN+ReLU chain XLA
+fuses; channel concat is the only materializing op per block."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, reshape
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class _CBR(nn.Layer):
+    """ConvNormActivation (reference: vision/ops.py ConvNormActivation):
+    conv (no bias) + BatchNorm + ReLU."""
+
+    def __init__(self, in_ch, out_ch, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, k, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class _Stem(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = _CBR(3, 32, 3, stride=2)
+        self.conv2 = _CBR(32, 32, 3)
+        self.conv3 = _CBR(32, 64, 3, padding=1)
+        self.pool = nn.MaxPool2D(3, stride=2)
+        self.conv4 = _CBR(64, 80, 1)
+        self.conv5 = _CBR(80, 192, 3)
+
+    def forward(self, x):
+        x = self.pool(self.conv3(self.conv2(self.conv1(x))))
+        return self.pool(self.conv5(self.conv4(x)))
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_ch, pool_features):
+        super().__init__()
+        self.b1 = _CBR(in_ch, 64, 1)
+        self.b5_1 = _CBR(in_ch, 48, 1)
+        self.b5_2 = _CBR(48, 64, 5, padding=2)
+        self.b3_1 = _CBR(in_ch, 64, 1)
+        self.b3_2 = _CBR(64, 96, 3, padding=1)
+        self.b3_3 = _CBR(96, 96, 3, padding=1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _CBR(in_ch, pool_features, 1)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5_2(self.b5_1(x)),
+                       self.b3_3(self.b3_2(self.b3_1(x))),
+                       self.bp(self.pool(x))], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    """Grid reduction 35->17."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = _CBR(in_ch, 384, 3, stride=2)
+        self.bd_1 = _CBR(in_ch, 64, 1)
+        self.bd_2 = _CBR(64, 96, 3, padding=1)
+        self.bd_3 = _CBR(96, 96, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.bd_3(self.bd_2(self.bd_1(x))),
+                       self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    """Factorized 7x7 branches."""
+
+    def __init__(self, in_ch, c7):
+        super().__init__()
+        self.b1 = _CBR(in_ch, 192, 1)
+        self.b7_1 = _CBR(in_ch, c7, 1)
+        self.b7_2 = _CBR(c7, c7, (1, 7), padding=(0, 3))
+        self.b7_3 = _CBR(c7, 192, (7, 1), padding=(3, 0))
+        self.b7d_1 = _CBR(in_ch, c7, 1)
+        self.b7d_2 = _CBR(c7, c7, (7, 1), padding=(3, 0))
+        self.b7d_3 = _CBR(c7, c7, (1, 7), padding=(0, 3))
+        self.b7d_4 = _CBR(c7, c7, (7, 1), padding=(3, 0))
+        self.b7d_5 = _CBR(c7, 192, (1, 7), padding=(0, 3))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _CBR(in_ch, 192, 1)
+
+    def forward(self, x):
+        b7 = self.b7_3(self.b7_2(self.b7_1(x)))
+        b7d = self.b7d_5(self.b7d_4(self.b7d_3(self.b7d_2(self.b7d_1(x)))))
+        return concat([self.b1(x), b7, b7d, self.bp(self.pool(x))], axis=1)
+
+
+class _InceptionD(nn.Layer):
+    """Grid reduction 17->8."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3_1 = _CBR(in_ch, 192, 1)
+        self.b3_2 = _CBR(192, 320, 3, stride=2)
+        self.b7_1 = _CBR(in_ch, 192, 1)
+        self.b7_2 = _CBR(192, 192, (1, 7), padding=(0, 3))
+        self.b7_3 = _CBR(192, 192, (7, 1), padding=(3, 0))
+        self.b7_4 = _CBR(192, 192, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3_2(self.b3_1(x)),
+                       self.b7_4(self.b7_3(self.b7_2(self.b7_1(x)))),
+                       self.pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    """Expanded-filter-bank output blocks."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1 = _CBR(in_ch, 320, 1)
+        self.b3_1 = _CBR(in_ch, 384, 1)
+        self.b3_2a = _CBR(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = _CBR(384, 384, (3, 1), padding=(1, 0))
+        self.bd_1 = _CBR(in_ch, 448, 1)
+        self.bd_2 = _CBR(448, 384, 3, padding=1)
+        self.bd_3a = _CBR(384, 384, (1, 3), padding=(0, 1))
+        self.bd_3b = _CBR(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _CBR(in_ch, 192, 1)
+
+    def forward(self, x):
+        b3 = self.b3_1(x)
+        b3 = concat([self.b3_2a(b3), self.b3_2b(b3)], axis=1)
+        bd = self.bd_2(self.bd_1(x))
+        bd = concat([self.bd_3a(bd), self.bd_3b(bd)], axis=1)
+        return concat([self.b1(x), b3, bd, self.bp(self.pool(x))], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """reference inceptionv3.py InceptionV3: stem + 3xA + B + 4xC + D +
+    2xE, 2048-d head."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = _Stem()
+        blocks = []
+        for in_ch, pf in zip([192, 256, 288], [32, 64, 64]):
+            blocks.append(_InceptionA(in_ch, pf))
+        blocks.append(_InceptionB(288))
+        for in_ch, c7 in zip([768] * 4, [128, 160, 160, 192]):
+            blocks.append(_InceptionC(in_ch, c7))
+        blocks.append(_InceptionD(768))
+        blocks.append(_InceptionE(1280))
+        blocks.append(_InceptionE(2048))
+        self.blocks = nn.LayerList(blocks)
+        if with_pool:
+            self.avg_pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        for b in self.blocks:
+            x = b(x)
+        if self.with_pool:
+            x = self.avg_pool(x)
+        if self.num_classes > 0:
+            x = reshape(x, [-1, 2048])
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    """reference inceptionv3.py inception_v3 builder."""
+    if pretrained:
+        raise ValueError("pretrained weights unavailable in this build")
+    return InceptionV3(**kwargs)

@@ -5,26 +5,31 @@ from __future__ import annotations
 from ... import nn
 from ...nn.functional import channel_shuffle
 
-__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
-           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
-           "shufflenet_v2_x2_0"]
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
 
 _STAGE_OUT = {
     0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
     0.5: [24, 48, 96, 192, 1024],
     1.0: [24, 116, 232, 464, 1024],
     1.5: [24, 176, 352, 704, 1024],
-    2.0: [24, 244, 488, 976, 2048],
+    2.0: [24, 224, 488, 976, 2048],  # reference shufflenetv2.py:241
 }
 _STAGE_REPEATS = [4, 8, 4]
 
 
-def _conv_bn(in_ch, out_ch, k, stride=1, padding=0, groups=1, act=True):
+def _act_layer(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
+
+
+def _conv_bn(in_ch, out_ch, k, stride=1, padding=0, groups=1, act="relu"):
     layers = [nn.Conv2D(in_ch, out_ch, k, stride=stride, padding=padding,
                         groups=groups, bias_attr=False),
               nn.BatchNorm2D(out_ch)]
     if act:
-        layers.append(nn.ReLU())
+        layers.append(_act_layer(act))
     return nn.Sequential(*layers)
 
 
@@ -32,28 +37,28 @@ class _InvertedResidual(nn.Layer):
     """reference shufflenetv2.py InvertedResidual — split-transform-
     concat-shuffle (stride 1) or dual-branch downsample (stride 2)."""
 
-    def __init__(self, in_ch, out_ch, stride):
+    def __init__(self, in_ch, out_ch, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch_ch = out_ch // 2
         if stride == 1:
             self.branch2 = nn.Sequential(
-                _conv_bn(in_ch // 2, branch_ch, 1),
+                _conv_bn(in_ch // 2, branch_ch, 1, act=act),
                 _conv_bn(branch_ch, branch_ch, 3, stride=1, padding=1,
                          groups=branch_ch, act=False),
-                _conv_bn(branch_ch, branch_ch, 1),
+                _conv_bn(branch_ch, branch_ch, 1, act=act),
             )
         else:
             self.branch1 = nn.Sequential(
                 _conv_bn(in_ch, in_ch, 3, stride=2, padding=1,
                          groups=in_ch, act=False),
-                _conv_bn(in_ch, branch_ch, 1),
+                _conv_bn(in_ch, branch_ch, 1, act=act),
             )
             self.branch2 = nn.Sequential(
-                _conv_bn(in_ch, branch_ch, 1),
+                _conv_bn(in_ch, branch_ch, 1, act=act),
                 _conv_bn(branch_ch, branch_ch, 3, stride=2, padding=1,
                          groups=branch_ch, act=False),
-                _conv_bn(branch_ch, branch_ch, 1),
+                _conv_bn(branch_ch, branch_ch, 1, act=act),
             )
 
     def forward(self, x):
@@ -75,18 +80,19 @@ class ShuffleNetV2(nn.Layer):
         outs = _STAGE_OUT[scale]
         self.num_classes = num_classes
         self.with_pool = with_pool
-        self.conv1 = _conv_bn(3, outs[0], 3, stride=2, padding=1)
+        self.conv1 = _conv_bn(3, outs[0], 3, stride=2, padding=1, act=act)
         self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
         blocks = []
         in_ch = outs[0]
         for stage, repeats in enumerate(_STAGE_REPEATS):
             out_ch = outs[stage + 1]
-            blocks.append(_InvertedResidual(in_ch, out_ch, stride=2))
+            blocks.append(_InvertedResidual(in_ch, out_ch, stride=2, act=act))
             for _ in range(repeats - 1):
-                blocks.append(_InvertedResidual(out_ch, out_ch, stride=1))
+                blocks.append(_InvertedResidual(out_ch, out_ch, stride=1,
+                                                act=act))
             in_ch = out_ch
         self.blocks = nn.Sequential(*blocks)
-        self.conv_last = _conv_bn(in_ch, outs[-1], 1)
+        self.conv_last = _conv_bn(in_ch, outs[-1], 1, act=act)
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D(1)
         if num_classes > 0:
@@ -104,16 +110,18 @@ class ShuffleNetV2(nn.Layer):
         return x
 
 
-def _mk(scale):
+def _mk(scale, act="relu"):
     def builder(pretrained=False, **kwargs):
         if pretrained:
             raise ValueError("pretrained weights unavailable in this build")
-        return ShuffleNetV2(scale=scale, **kwargs)
+        return ShuffleNetV2(scale=scale, act=act, **kwargs)
     return builder
 
 
 shufflenet_v2_x0_25 = _mk(0.25)
+shufflenet_v2_x0_33 = _mk(0.33)
 shufflenet_v2_x0_5 = _mk(0.5)
 shufflenet_v2_x1_0 = _mk(1.0)
 shufflenet_v2_x1_5 = _mk(1.5)
 shufflenet_v2_x2_0 = _mk(2.0)
+shufflenet_v2_swish = _mk(1.0, act="swish")

@@ -152,3 +152,49 @@ class TestGeneration:
         assert np.asarray(s1._value).max() < 128
         assert not np.array_equal(np.asarray(s1._value),
                                   np.asarray(s2._value))
+
+
+class TestInceptionFamilies:
+    """GoogLeNet + InceptionV3 (reference: vision/models/googlenet.py,
+    inceptionv3.py)."""
+
+    def test_googlenet_three_heads(self):
+        from paddle_tpu.vision.models import googlenet
+        m = googlenet(num_classes=6)
+        m.eval()
+        outs = m(paddle.randn([1, 3, 192, 192]))
+        assert isinstance(outs, list) and len(outs) == 3
+        assert all(o.shape == [1, 6] for o in outs)
+
+    def test_inception_v3_forward(self):
+        from paddle_tpu.vision.models import inception_v3
+        m = inception_v3(num_classes=5)
+        m.eval()
+        out = m(paddle.randn([1, 3, 299, 299]))
+        assert out.shape == [1, 5]
+
+    def test_new_variants_construct(self):
+        from paddle_tpu.vision.models import (
+            resnext50_64x4d, shufflenet_v2_x0_33, shufflenet_v2_swish,
+            densenet264)
+        net = shufflenet_v2_x0_33(num_classes=4)
+        out = net(paddle.randn([1, 3, 64, 64]))
+        assert out.shape == [1, 4]
+        sw = shufflenet_v2_swish(num_classes=4)
+        out = sw(paddle.randn([1, 3, 64, 64]))
+        assert out.shape == [1, 4]
+        rx = resnext50_64x4d(num_classes=3)
+        out = rx(paddle.randn([1, 3, 64, 64]))
+        assert out.shape == [1, 3]
+        assert densenet264(num_classes=2) is not None
+
+    def test_vision_models_parity_vs_reference(self):
+        """Every builder in the reference vision.models __all__ exists."""
+        import re, pathlib
+        import paddle_tpu.vision.models as M
+        ref = pathlib.Path("/root/reference/python/paddle/vision/models/"
+                           "__init__.py").read_text()
+        names = set(re.findall(r"'([A-Za-z_][A-Za-z0-9_]*)'", ref))
+        names = {n for n in names if not n[0].isupper()}
+        missing = [n for n in sorted(names) if not hasattr(M, n)]
+        assert missing == [], missing

@@ -274,3 +274,210 @@ class TestClip:
         clip([p1, p2])
         total = np.sqrt((_np(p1.grad) ** 2).sum() + (_np(p2.grad) ** 2).sum())
         assert np.allclose(total, 1.0, atol=1e-5)
+
+
+class TestExtraFunctionals:
+    """Long-tail functionals (nn/functional/extra.py)."""
+
+    def test_sequence_mask_temporal_shift(self):
+        m = F.sequence_mask(paddle.to_tensor(np.array([2, 4])), maxlen=5)
+        np.testing.assert_allclose(
+            _np(m), [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+        x = paddle.to_tensor(np.random.randn(4, 8, 5, 5).astype("float32"))
+        ts = F.temporal_shift(x, seg_num=2)
+        assert ts.shape == [4, 8, 5, 5]
+        # shifted channels: first quarter comes from t+1
+        x5 = _np(x).reshape(2, 2, 8, 5, 5)
+        t5 = _np(ts).reshape(2, 2, 8, 5, 5)
+        np.testing.assert_allclose(t5[:, 0, :2], x5[:, 1, :2])
+        np.testing.assert_allclose(t5[:, 1, :2], 0.0)
+
+    def test_rrelu(self):
+        r = F.rrelu(paddle.to_tensor(np.array([-1., 1.], "float32")),
+                    training=False)
+        np.testing.assert_allclose(_np(r), [-(1 / 8 + 1 / 3) / 2, 1.0],
+                                   atol=1e-6)
+        r2 = F.rrelu(paddle.to_tensor(np.array([-1., 1.], "float32")),
+                     training=True)
+        assert -1 / 3 <= float(_np(r2)[0]) <= -1 / 8
+
+    def test_max_pool_mask_unpool_roundtrip(self):
+        x = paddle.to_tensor(np.random.randn(2, 3, 8, 8).astype("float32"))
+        out, mask = F.max_pool2d(x, 2, stride=2, return_mask=True)
+        assert out.shape == [2, 3, 4, 4] and mask.shape == [2, 3, 4, 4]
+        un = F.max_unpool2d(out, mask, 2, stride=2)
+        assert un.shape == [2, 3, 8, 8]
+        unn, xn = _np(un), _np(x)
+        nz = unn != 0
+        np.testing.assert_allclose(unn[nz], xn[nz])
+        # unpool preserves every pooled max
+        np.testing.assert_allclose(np.sort(unn[nz]).ravel(),
+                                   np.sort(_np(out).ravel()))
+
+    def test_margin_and_hinge_losses(self):
+        logits = paddle.to_tensor(
+            (np.random.rand(4, 10) * 2 - 1).astype("float32"),
+            stop_gradient=False)
+        lbl = paddle.to_tensor(np.array([1, 2, 3, 4]))
+        loss = F.margin_cross_entropy(logits, lbl)
+        loss.backward()
+        assert logits.grad is not None and np.isfinite(loss.item())
+        mm = F.multi_margin_loss(
+            paddle.to_tensor(np.random.randn(4, 5).astype("float32")), lbl[:4])
+        assert np.isfinite(mm.item())
+        a, b, c = [paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+                   for _ in range(3)]
+        tl = F.triplet_margin_with_distance_loss(a, b, c)
+        assert np.isfinite(tl.item())
+
+    def test_hsigmoid_loss(self):
+        x = paddle.to_tensor(np.random.randn(4, 16).astype("float32"),
+                             stop_gradient=False)
+        w = paddle.to_tensor(np.random.randn(9, 16).astype("float32"),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.zeros(9, "float32"))
+        loss = F.hsigmoid_loss(x, paddle.to_tensor(np.array([0, 3, 7, 9])),
+                               10, w, b)
+        assert loss.shape == [4, 1]
+        loss.sum().backward()
+        assert x.grad is not None and w.grad is not None
+
+    def test_rnnt_loss_vs_bruteforce(self):
+        """Forward-algorithm loss equals brute-force enumeration of every
+        monotone lattice path (T blanks + U labels, last symbol the final
+        blank at (T-1, U))."""
+        from itertools import combinations
+        T, U, V = 3, 2, 4
+        rng = np.random.default_rng(0)
+        logits = rng.standard_normal((1, T, U + 1, V)).astype("float32")
+        labels = np.array([[1, 2]])
+        lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        total = -np.inf
+        # last slot is the forced final blank; choose label slots among the
+        # first T+U-1 positions
+        for lab_pos in combinations(range(T + U - 1), U):
+            t = u = 0
+            s = 0.0
+            valid = True
+            for i in range(T + U - 1):
+                if i in lab_pos:
+                    s += lp[0, t, u, labels[0, u]]
+                    u += 1
+                else:
+                    if t >= T - 1:  # final blank is reserved for the end
+                        valid = False
+                        break
+                    s += lp[0, t, u, 0]
+                    t += 1
+            if valid:
+                s += lp[0, T - 1, U, 0]  # final blank
+                total = np.logaddexp(total, s)
+        got = F.rnnt_loss(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels),
+                          paddle.to_tensor(np.array([T])),
+                          paddle.to_tensor(np.array([U])),
+                          blank=0, reduction="none")
+        np.testing.assert_allclose(_np(got)[0], -total, atol=1e-4)
+
+    def test_class_center_sample_gather_tree(self):
+        lab = paddle.to_tensor(np.array([1, 5, 1, 9]))
+        rl, sc = F.class_center_sample(lab, 20, 6)
+        assert len(_np(sc)) == 6 and _np(rl).max() < 6
+        pos = set(np.asarray([1, 5, 9]))
+        assert pos.issubset(set(_np(sc).tolist()))
+        ids = paddle.to_tensor(np.array(
+            [[[2, 2]], [[3, 9]], [[0, 1]]]))
+        par = paddle.to_tensor(np.array(
+            [[[0, 0]], [[1, 0]], [[0, 0]]]))
+        gt = F.gather_tree(ids, par)
+        assert gt.shape == [3, 1, 2]
+
+    def test_sparse_attention_matches_dense_on_full_pattern(self):
+        S, D = 4, 8
+        q = paddle.to_tensor(np.random.randn(1, 1, S, D).astype("float32"))
+        k = paddle.to_tensor(np.random.randn(1, 1, S, D).astype("float32"))
+        v = paddle.to_tensor(np.random.randn(1, 1, S, D).astype("float32"))
+        # full CSR pattern == dense softmax attention
+        offs = paddle.to_tensor(
+            (np.arange(S + 1) * S)[None, None].astype("int32"))
+        cols = paddle.to_tensor(
+            np.tile(np.arange(S), S)[None, None].astype("int32"))
+        out = F.sparse_attention(q, k, v, offs, cols)
+        qn, kn, vn = _np(q), _np(k), _np(v)
+        sc = qn[0, 0] @ kn[0, 0].T / np.sqrt(D)
+        pr = np.exp(sc) / np.exp(sc).sum(-1, keepdims=True)
+        np.testing.assert_allclose(_np(out)[0, 0], pr @ vn[0, 0], atol=1e-3)
+
+    def test_inplace_activations(self):
+        t = paddle.to_tensor(np.array([-1., 2.], "float32"))
+        F.tanh_(t)
+        assert abs(_np(t)[1] - np.tanh(2)) < 1e-6
+        F.softmax_(t)
+        assert abs(_np(t).sum() - 1) < 1e-5
+        F.leaky_relu_(t)
+
+
+class TestExtraLayers:
+    """Long-tail layers (nn/layer/extra.py)."""
+
+    def test_simple_layers(self):
+        assert nn.ChannelShuffle(2)(paddle.to_tensor(
+            np.random.randn(1, 4, 3, 3).astype("float32"))).shape == [1, 4, 3, 3]
+        d = nn.PairwiseDistance()(
+            paddle.to_tensor(np.ones((2, 3), "float32")),
+            paddle.to_tensor(np.zeros((2, 3), "float32")))
+        np.testing.assert_allclose(_np(d), np.sqrt(3) * np.ones(2), atol=1e-4)
+        s = nn.Softmax2D()(paddle.to_tensor(
+            np.random.randn(1, 3, 2, 2).astype("float32")))
+        assert abs(_np(s)[0, :, 0, 0].sum() - 1) < 1e-5
+        assert nn.Unflatten(1, [2, 3])(paddle.to_tensor(
+            np.zeros((4, 6), "float32"))).shape == [4, 2, 3]
+
+    def test_loss_layers(self):
+        hs = nn.HSigmoidLoss(16, 10)
+        loss = hs(paddle.to_tensor(np.random.randn(4, 16).astype("float32")),
+                  paddle.to_tensor(np.array([0, 1, 2, 3])))
+        assert loss.shape == [4, 1]
+        lbl = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        mm = nn.MultiMarginLoss()(paddle.to_tensor(
+            np.random.randn(4, 5).astype("float32")), lbl)
+        assert np.isfinite(mm.item())
+        rt = nn.RNNTLoss()(
+            paddle.to_tensor(np.random.randn(2, 4, 4, 5).astype("float32")),
+            paddle.to_tensor(np.array([[1, 2, 3], [2, 4, 0]])),
+            paddle.to_tensor(np.array([4, 3])),
+            paddle.to_tensor(np.array([3, 2])))
+        assert np.isfinite(rt.item())
+
+    def test_beam_search_decoder(self):
+        class ToyCell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            def forward(self, inputs, states):
+                h = states[0] if isinstance(states, (list, tuple)) else states
+                nh = paddle.tanh(self.fc(h))
+                return nh, nh
+
+        emb = nn.Embedding(8, 8)
+        dec = nn.BeamSearchDecoder(ToyCell(), start_token=0, end_token=1,
+                                   beam_size=3, embedding_fn=emb,
+                                   output_fn=nn.Linear(8, 8))
+        h0 = paddle.to_tensor(np.zeros((2, 8), "float32"))
+        out, lp = nn.dynamic_decode(dec, inits=h0, max_step_num=6)
+        assert out.shape[0] == 2 and out.shape[2] == 3
+        # scores sorted descending per batch
+        lpn = _np(lp)
+        assert (np.diff(lpn, axis=1) <= 1e-5).all()
+
+    def test_nn_parity_vs_reference(self):
+        import re, pathlib
+        for mod, path in [(nn, "nn/__init__.py"),
+                          (F, "nn/functional/__init__.py")]:
+            ref = pathlib.Path(
+                f"/root/reference/python/paddle/{path}").read_text()
+            names = set(re.findall(r"^\s+'([A-Za-z_][A-Za-z0-9_]*)',\s*$",
+                                   ref, re.M))
+            missing = [x for x in sorted(names) if not hasattr(mod, x)]
+            assert missing == [], (path, missing)

@@ -603,6 +603,39 @@ CASES = [
     C("cumulative_trapezoid", lambda: (r(6, seed=1),), None, grad=False),
     C("shard_index", lambda: (ri(4, 1, seed=1, hi=20), 20, 2, 0), None,
       grad=False, bf16=False),
+    # ---- long-tail extras (ops/extras.py, round 2) ------------------------
+    C("addmm", lambda: (r(2, 4, seed=1), r(2, 3, seed=2), r(3, 4, seed=3)),
+      lambda c, a, b: c + a @ b, atol=1e-4),
+    C("cdist", lambda: (r(4, 3, seed=1), r(5, 3, seed=2)),
+      lambda x, y: np.sqrt((((x[:, None] - y[None]) ** 2).sum(-1)) + 1e-30),
+      atol=1e-4),
+    C("diagonal", lambda: (r(3, 4, seed=1),), np.diagonal),
+    C("trace", lambda: (r(3, 4, seed=1),), np.trace),
+    C("diag_embed", lambda: (r(4, seed=1),), np.diag),
+    C("diff", lambda: (r(6, seed=1),), np.diff),
+    C("sgn", lambda: (r(3, 3, seed=1, lo=0.2, hi=1.0),), np.sign),
+    C("renorm", lambda: (r(2, 3, seed=1), 2.0, 0, 1.0), None, name="renorm"),
+    C("polygamma", lambda: (rp(3, seed=1), 1), None, gtol=0.15,
+      name="polygamma"),
+    C("vander", lambda: (r(4, seed=1),), np.vander, grad=False),
+    C("take", lambda: (r(3, 4, seed=1), ri(3, seed=2, hi=11)),
+      lambda x, i: x.ravel()[i], name="take_flat"),
+    C("unfold", lambda: (r(9, seed=1), 0, 3, 2), None, name="tensor_unfold"),
+    C("as_strided", lambda: (r(6, seed=1), [2, 3], [3, 1]),
+      lambda x, sh, st: np.lib.stride_tricks.as_strided(
+          x, (2, 3), (3 * x.itemsize, x.itemsize)).copy(), grad=False),
+    C("scatter_nd", lambda: (ri(3, 1, seed=1, hi=4), r(3, seed=2), [4]),
+      None, grad=False, name="scatter_nd"),
+    C("linalg.cond", lambda: (spd(3, seed=1),),
+      lambda a: np.linalg.cond(a), atol=1e-2, gtol=0.2, bf16=False,
+      name="cond"),
+    C("linalg.householder_product",
+      lambda: (r(4, 2, seed=1), rp(2, seed=2)), None, bf16=False,
+      name="householder_product"),
+    C("nn.functional.sequence_mask", lambda: (ri(3, seed=1, lo=1, hi=5), 5),
+      None, grad=False, name="sequence_mask"),
+    C("nn.functional.temporal_shift", lambda: (r(4, 8, 3, 3, seed=1), 2),
+      None, name="temporal_shift"),
 ]
 
 
@@ -735,6 +768,8 @@ def test_bf16(case):
 
 # ops outside this harness's reach, each with a reason (reference
 # test/white_list analogues)
+
+
 EXEMPT = {
     # stateful / random (seeded tests in test_ops.py / test_nn.py)
     "dropout_apply", "bernoulli", "uniform", "gaussian", "randint",
@@ -758,6 +793,12 @@ EXEMPT = {
     "strided_slice", "slice", "eye", "arange", "linspace", "tril_indices",
     "triu_indices", "meshgrid", "unique", "unique_consecutive", "nonzero",
     "masked_select", "index_put", "dist", "accuracy_op",
+    # round-2 extras tested in test_ops.py / test_nn.py (multi-output,
+    # random, or index-pair contracts the single-output harness can't)
+    "cummin_ind", "cummin_val", "frexp_exp", "frexp_mant",
+    "hsigmoid_loss", "margin_cross_entropy", "max_pool_mask", "max_unpool",
+    "multi_margin_loss", "rnnt_loss", "rrelu_eval", "rrelu_train",
+    "sparse_attention",
 }
 
 

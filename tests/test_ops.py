@@ -323,3 +323,239 @@ class TestRandom:
         probs = paddle.to_tensor([0.0, 0.0, 1.0])
         s = paddle.multinomial(probs, 5, replacement=True)
         assert np.all(_np(s) == 2)
+
+
+class TestExtras:
+    """Long-tail ops (ops/extras.py) vs NumPy (reference: tensor/math.py
+    addmm/trace/diff, manipulation.py unfold/as_strided, linalg.py cdist)."""
+
+    def test_addmm(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((2, 3)).astype("float32")
+        b = rng.standard_normal((3, 4)).astype("float32")
+        c = rng.standard_normal((2, 4)).astype("float32")
+        out = paddle.addmm(paddle.to_tensor(c), paddle.to_tensor(a),
+                           paddle.to_tensor(b), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(_np(out), 0.5 * c + 2.0 * (a @ b),
+                                   atol=2e-2)
+
+    def test_cdist(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 3)).astype("float32")
+        y = rng.standard_normal((5, 3)).astype("float32")
+        out = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y))
+        ref = np.sqrt(((x[:, None, :] - y[None, :, :]) ** 2).sum(-1))
+        np.testing.assert_allclose(_np(out), ref, atol=1e-4)
+        out1 = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y), p=1.0)
+        ref1 = np.abs(x[:, None, :] - y[None, :, :]).sum(-1)
+        np.testing.assert_allclose(_np(out1), ref1, atol=1e-4)
+
+    def test_cummin(self):
+        v, i = paddle.cummin(paddle.to_tensor(
+            np.array([3., 1., 2., 0., 5.], dtype="float32")))
+        assert list(_np(v)) == [3, 1, 1, 0, 0]
+        assert list(_np(i)) == [0, 1, 1, 3, 3]
+
+    def test_diag_embed_diagonal_trace(self):
+        d = paddle.diag_embed(paddle.to_tensor(
+            np.array([1., 2., 3.], dtype="float32")))
+        np.testing.assert_allclose(_np(d), np.diag([1., 2., 3.]))
+        x = np.arange(12, dtype="float32").reshape(3, 4)
+        np.testing.assert_allclose(_np(paddle.diagonal(paddle.to_tensor(x))),
+                                   np.diagonal(x))
+        assert paddle.trace(paddle.to_tensor(x)).item() == np.trace(x)
+
+    def test_trace_grad(self):
+        x = paddle.to_tensor(np.random.randn(3, 3).astype("float32"),
+                             stop_gradient=False)
+        paddle.trace(x).backward()
+        np.testing.assert_allclose(_np(x.grad), np.eye(3))
+
+    def test_diff_frexp_sgn(self):
+        x = np.array([1., 3., 6.], dtype="float32")
+        np.testing.assert_allclose(
+            _np(paddle.diff(paddle.to_tensor(x))), np.diff(x))
+        m, e = paddle.frexp(paddle.to_tensor(np.array([8., 0.5], "float32")))
+        np.testing.assert_allclose(_np(m) * 2.0 ** _np(e), [8., 0.5])
+        np.testing.assert_allclose(
+            _np(paddle.sgn(paddle.to_tensor(np.array([-2., 0., 5.], "float32")))),
+            [-1., 0., 1.])
+
+    def test_take_unfold_unflatten_as_strided(self):
+        x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+        assert list(_np(paddle.take(x, paddle.to_tensor(
+            np.array([0, 5, 11]))))) == [0, 5, 11]
+        u = paddle.unfold(paddle.to_tensor(np.arange(9, dtype="float32")),
+                          0, 3, 2)
+        np.testing.assert_allclose(
+            _np(u), [[0, 1, 2], [2, 3, 4], [4, 5, 6], [6, 7, 8]])
+        uf = paddle.unflatten(paddle.to_tensor(
+            np.zeros((2, 12), "float32")), 1, [3, 4])
+        assert uf.shape == [2, 3, 4]
+        s = paddle.as_strided(paddle.to_tensor(np.arange(6, dtype="float32")),
+                              [2, 3], [3, 1])
+        np.testing.assert_allclose(_np(s), [[0, 1, 2], [3, 4, 5]])
+
+    def test_scatter_nd_nonzero_splits(self):
+        out = paddle.scatter_nd(
+            paddle.to_tensor(np.array([[1], [2], [1]])),
+            paddle.to_tensor(np.array([1., 2., 3.], "float32")), [4])
+        assert list(_np(out)) == [0, 4, 2, 0]
+        nz = paddle.nonzero(paddle.to_tensor(np.array([0, 3, 0, 7])))
+        assert _np(nz).ravel().tolist() == [1, 3]
+        vs = paddle.vsplit(paddle.to_tensor(np.zeros((4, 2), "float32")), 2)
+        assert len(vs) == 2 and vs[0].shape == [2, 2]
+        hs = paddle.hsplit(paddle.to_tensor(np.zeros((2, 6), "float32")), [2, 4])
+        assert [t.shape for t in hs] == [[2, 2], [2, 2], [2, 2]]
+
+    def test_renorm_polygamma_vander(self):
+        r = paddle.renorm(paddle.to_tensor(
+            np.ones((2, 3), "float32") * 3), 2.0, 0, 1.0)
+        assert abs(np.linalg.norm(_np(r)[0]) - 1.0) < 1e-3
+        from scipy.special import polygamma as spg
+        got = paddle.polygamma(paddle.to_tensor(
+            np.array([2.0], "float32")), 1)
+        np.testing.assert_allclose(_np(got), spg(1, [2.0]), atol=1e-4)
+        v = paddle.vander(paddle.to_tensor(np.array([1., 2., 3.], "float32")))
+        np.testing.assert_allclose(_np(v), np.vander([1., 2., 3.]))
+
+    def test_shape_rank_broadcast_shape(self):
+        x = paddle.to_tensor(np.zeros((3, 4), "float32"))
+        assert list(_np(paddle.shape(x))) == [3, 4]
+        assert paddle.rank(x).item() == 2
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+    def test_linalg_cond_householder(self):
+        rng = np.random.default_rng(2)
+        a = rng.standard_normal((4, 4)).astype("float32")
+        a = a @ a.T + 4 * np.eye(4, dtype="float32")
+        t = paddle.to_tensor(a)
+        for p_ in [None, "fro", 1, -2]:
+            got = paddle.linalg.cond(t, p_).item()
+            ref = np.linalg.cond(a, 2 if p_ is None else p_)
+            assert abs(got - ref) / abs(ref) < 1e-2, (p_, got, ref)
+        import scipy.linalg as sla
+        m = rng.standard_normal((5, 3))
+        (hq, tau), _ = sla.qr(m, mode="raw")
+        q_ref = sla.lapack.dorgqr(np.asfortranarray(hq[:, :3]), tau)[0]
+        got = paddle.linalg.householder_product(
+            paddle.to_tensor(hq.astype("float32")),
+            paddle.to_tensor(tau.astype("float32")))
+        np.testing.assert_allclose(_np(got), q_ref[:, :3], atol=1e-3)
+
+
+class TestInplace:
+    """Inplace variants (ops/inplace.py) — value semantics, autograd
+    adoption, and the reference's inplace-on-leaf guard."""
+
+    def test_value_semantics(self):
+        x = paddle.to_tensor(np.array([1., 4., 9.], "float32"))
+        y = x.sqrt_()
+        assert y is x
+        np.testing.assert_allclose(_np(x), [1, 2, 3])
+        x.add_(paddle.to_tensor(np.ones(3, "float32")))
+        np.testing.assert_allclose(_np(x), [2, 3, 4])
+        x.zero_()
+        assert _np(x).sum() == 0
+        x.fill_(7.0)
+        np.testing.assert_allclose(_np(x), 7)
+        m = paddle.to_tensor(np.zeros((3, 3), "float32"))
+        m.fill_diagonal_(2.0)
+        np.testing.assert_allclose(_np(m), 2 * np.eye(3))
+        r = paddle.to_tensor(np.arange(6, dtype="float32"))
+        r.reshape_([2, 3])
+        assert r.shape == [2, 3]
+
+    def test_autograd_through_inplace(self):
+        import math
+        w = paddle.to_tensor(np.array([0.5], "float32"), stop_gradient=False)
+        z = w * 3.0
+        z.tanh_()
+        z.backward()
+        ref = 3.0 * (1 - math.tanh(1.5) ** 2)
+        assert abs(w.grad.item() - ref) < 1e-3
+        # chain of two inplace mutations
+        v = paddle.to_tensor(np.array([0.5], "float32"), stop_gradient=False)
+        u = v * 1.0
+        u.sin_()
+        u.exp_()
+        u.backward()
+        refg = math.exp(math.sin(0.5)) * math.cos(0.5)
+        assert abs(v.grad.item() - refg) < 1e-3
+
+    def test_leaf_guard(self):
+        w = paddle.to_tensor(np.array([1.0], "float32"), stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            w.tanh_()
+        # stop_gradient leaves may mutate freely
+        s = paddle.to_tensor(np.array([1.0], "float32"))
+        s.tanh_()
+
+    def test_module_level_and_fills(self):
+        assert hasattr(paddle, "add_") and hasattr(paddle, "tanh_")
+        t = paddle.to_tensor(np.zeros((50,), "float32"))
+        t.cauchy_()
+        g = paddle.to_tensor(np.zeros((50,), "float32"))
+        g.geometric_(0.3)
+        assert _np(g).min() >= 1
+
+    def test_setitem_grad_after_shadow_fix(self):
+        w = paddle.to_tensor(np.array([1., 2., 3.], "float32"),
+                             stop_gradient=False)
+        a = w * 2.0
+        a[0] = 5.0
+        a.sum().backward()
+        np.testing.assert_allclose(_np(w.grad), [0., 2., 2.])
+
+
+class TestFrameworkShims:
+    """Framework compat surface (framework/core.py)."""
+
+    def test_dtype_info(self):
+        fi = paddle.finfo("float32")
+        assert fi.bits == 32 and fi.eps > 0 and fi.max > 1e38
+        bi = paddle.finfo("bfloat16")
+        assert bi.bits == 16
+        ii = paddle.iinfo("int32")
+        assert ii.max == 2 ** 31 - 1
+
+    def test_places_and_modes(self):
+        assert paddle.CPUPlace() == paddle.CPUPlace()
+        assert paddle.CUDAPlace(0) != paddle.CPUPlace()
+        assert paddle.in_dynamic_mode()
+        paddle.enable_static()
+        try:
+            assert not paddle.in_dynamic_mode()
+        finally:
+            paddle.disable_static()
+
+    def test_create_parameter_and_queries(self):
+        w = paddle.create_parameter([3, 4])
+        assert not w.stop_gradient and w.shape == [3, 4]
+        b = paddle.create_parameter([4], is_bias=True)
+        assert _np(b).sum() == 0
+        assert paddle.is_floating_point(paddle.to_tensor([1.0]))
+        assert paddle.is_integer(paddle.to_tensor([1]))
+        assert paddle.is_tensor(w)
+
+    def test_flops(self):
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                            nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+        assert paddle.flops(net, [1, 3, 8, 8]) > 0
+
+    def test_batch_and_rng_state(self):
+        r = paddle.batch(lambda: iter(range(5)), 2)
+        assert [len(b) for b in r()] == [2, 2, 1]
+        s = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(s)
+
+    def test_top_level_parity_vs_reference(self):
+        """Every name in the reference's top-level __all__ exists."""
+        import re, pathlib
+        ref = pathlib.Path(
+            "/root/reference/python/paddle/__init__.py").read_text()
+        names = set(re.findall(r"^\s+'([A-Za-z_][A-Za-z0-9_]*)',\s*$",
+                               ref, re.M))
+        missing = [x for x in sorted(names) if not hasattr(paddle, x)]
+        assert missing == [], missing
