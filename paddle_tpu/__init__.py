@@ -64,6 +64,8 @@ from . import incubate  # noqa: F401
 from . import text  # noqa: F401
 from . import utils  # noqa: F401
 from . import static  # noqa: F401
+from . import signal  # noqa: F401
+from . import sysconfig  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from . import callbacks  # noqa: F401
 from .framework.io import save, load  # noqa: F401

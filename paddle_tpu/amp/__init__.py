@@ -13,3 +13,18 @@ from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
 
 __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler",
            "is_auto_cast_enabled", "get_amp_dtype"]
+
+
+def is_float16_supported(device=None):
+    """fp16 compute support (reference: amp/auto_cast.py
+    is_float16_supported). TPUs compute fp16 via upcast; bf16 is native."""
+    import jax
+    return jax.default_backend() in ("gpu", "tpu", "cpu")
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the native TPU mixed-precision dtype."""
+    return True
+
+
+__all__ += ["is_float16_supported", "is_bfloat16_supported"]

@@ -4,6 +4,7 @@
 from ..core.autograd import grad, no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401
+from .functional import jacobian, hessian  # noqa: F401
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):
@@ -18,4 +19,4 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
 
 __all__ = ["grad", "no_grad", "enable_grad", "is_grad_enabled",
            "set_grad_enabled", "backward", "PyLayer", "PyLayerContext",
-           "saved_tensors_hooks"]
+           "saved_tensors_hooks", "jacobian", "hessian"]

@@ -3,7 +3,8 @@ re-exporting hapi callbacks)."""
 
 from .hapi.callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
-    ReduceLROnPlateau)
+    ReduceLROnPlateau, VisualDL, WandbCallback)
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "ReduceLROnPlateau"]
+           "EarlyStopping", "ReduceLROnPlateau", "VisualDL",
+           "WandbCallback"]

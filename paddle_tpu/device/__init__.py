@@ -149,3 +149,84 @@ class _CudaNamespace:
 
 
 cuda = _CudaNamespace()
+
+
+# ---- round-2 parity additions (reference: python/paddle/device/__init__.py)
+
+class IPUPlace:
+    """Accepted for API compat; no IPU backend on TPU builds."""
+
+
+class XPUPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+
+_current_streams = {}
+
+
+def current_stream(device=None):
+    """The current Stream for a device (reference: device current_stream).
+    XLA's async dispatch owns real streams; this handle exists for
+    ordering APIs (wait_event/record_event are host-side no-ops that
+    block_until_ready)."""
+    key = device or get_device()
+    if key not in _current_streams:
+        _current_streams[key] = Stream()
+    return _current_streams[key]
+
+
+def set_stream(stream):
+    key = get_device()
+    prev = _current_streams.get(key)
+    _current_streams[key] = stream
+    return prev
+
+
+class stream_guard:
+    """Context manager swapping the current stream (reference:
+    device stream_guard)."""
+
+    def __init__(self, stream):
+        self._stream = stream
+
+    def __enter__(self):
+        key = get_device()
+        self._had_prev = key in _current_streams
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        if self._had_prev:
+            set_stream(self._prev)
+        else:
+            _current_streams.pop(get_device(), None)
+        return False
+
+
+def get_cudnn_version():
+    """None: no cuDNN in a TPU build (reference returns int or None)."""
+    return None
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+__all__ += ["IPUPlace", "XPUPlace", "current_stream", "set_stream",
+            "stream_guard", "get_cudnn_version", "get_all_device_type",
+            "get_all_custom_device_type", "is_compiled_with_cinn",
+            "is_compiled_with_ipu"]

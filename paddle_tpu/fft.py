@@ -238,3 +238,29 @@ def fftfreq(n, d=1.0, dtype=None, name=None):
 def rfftfreq(n, d=1.0, dtype=None, name=None):
     """reference fft.py rfftfreq."""
     return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))
+
+
+def _mk_hermitian(name):
+    """hfft2/hfftn/ihfft2/ihfftn (reference fft.py fftn_c2r/fftn_r2c
+    Hermitian n-d paths). scipy.fft provides the semantics; host-side
+    eager like the reference CPU kernels (complex in/out is unsupported
+    on the TPU systolic path anyway)."""
+    import scipy.fft as sfft
+    sfn = getattr(sfft, name)
+    default_axes = (-2, -1) if name.endswith("2") else None
+
+    def api(x, s=None, axes=default_axes, norm="backward", name=None):
+        import numpy as np
+        arr = np.asarray(_t(x)._value)
+        out = sfn(arr, s=s, axes=axes, norm=norm)
+        return Tensor(jnp.asarray(out))
+    api.__name__ = name
+    api.__doc__ = f"reference python/paddle/fft.py {name}."
+    return api
+
+
+hfft2 = _mk_hermitian("hfft2")
+ihfft2 = _mk_hermitian("ihfft2")
+hfftn = _mk_hermitian("hfftn")
+ihfftn = _mk_hermitian("ihfftn")
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

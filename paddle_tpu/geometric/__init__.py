@@ -206,3 +206,71 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                                                                  row_np.dtype)
     counts = np.array(out_cnt, np.int32)
     return Tensor(jnp.asarray(neighbors)), Tensor(jnp.asarray(counts))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-biased neighbor sampling (reference:
+    geometric/sampling/neighbors.py weighted_sample_neighbors) —
+    probability proportional to edge weight, host-side like
+    sample_neighbors."""
+    row_np = np.asarray(_t(row)._value)
+    colptr_np = np.asarray(_t(colptr)._value)
+    w_np = np.asarray(_t(edge_weight)._value).astype(np.float64)
+    nodes = np.asarray(_t(input_nodes)._value)
+    eids_np = np.asarray(_t(eids)._value) if eids is not None else None
+    rng = _SAMPLE_RNG
+    out_nbr, out_cnt, out_eid = [], [], []
+    for v in nodes:
+        lo, hi = int(colptr_np[int(v)]), int(colptr_np[int(v) + 1])
+        nbrs, w = row_np[lo:hi], w_np[lo:hi]
+        edge_ids = (eids_np[lo:hi] if eids_np is not None
+                    else np.arange(lo, hi))
+        if 0 <= sample_size < len(nbrs):
+            p = w / w.sum() if w.sum() > 0 else None
+            idx = rng.choice(len(nbrs), size=sample_size, replace=False, p=p)
+            nbrs, edge_ids = nbrs[idx], edge_ids[idx]
+        out_nbr.append(nbrs)
+        out_cnt.append(len(nbrs))
+        out_eid.append(edge_ids)
+    neighbors = np.concatenate(out_nbr) if out_nbr else np.array(
+        [], row_np.dtype)
+    counts = Tensor(jnp.asarray(np.array(out_cnt, np.int32)))
+    if return_eids:
+        all_eids = np.concatenate(out_eid) if out_eid else np.array(
+            [], np.int64)
+        return (Tensor(jnp.asarray(neighbors)), counts,
+                Tensor(jnp.asarray(all_eids)))
+    return Tensor(jnp.asarray(neighbors)), counts
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Reindex a heterogeneous graph: neighbors/count are per-edge-type
+    lists sharing one node renumbering (reference:
+    geometric/reindex.py reindex_heter_graph)."""
+    xs = np.asarray(_t(x)._value)
+    nbr_list = [np.asarray(_t(n)._value) for n in neighbors]
+    cnt_list = [np.asarray(_t(c)._value) for c in count]
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    reindexed = []
+    for nbr in nbr_list:
+        out = np.empty(len(nbr), np.int64)
+        for i, v in enumerate(nbr):
+            vi = int(v)
+            if vi not in mapping:
+                mapping[vi] = len(mapping)
+            out[i] = mapping[vi]
+        reindexed.append(Tensor(jnp.asarray(out)))
+    inv = np.empty(len(mapping), np.int64)
+    for v, i in mapping.items():
+        inv[i] = v
+    edge_src = []
+    for nbr, cnt in zip(reindexed, cnt_list):
+        src = np.repeat(np.arange(len(cnt)), cnt)
+        edge_src.append(Tensor(jnp.asarray(src.astype(np.int64))))
+    return reindexed, edge_src, Tensor(jnp.asarray(inv))
+
+
+__all__ += ["weighted_sample_neighbors", "reindex_heter_graph"]

@@ -271,3 +271,67 @@ class ReduceLROnPlateau(Callback):
                         print(f"ReduceLROnPlateau: lr {old:.2e} -> {new:.2e}")
                 self.cooldown_counter = self.cooldown
                 self.wait = 0
+
+
+class VisualDL(Callback):
+    """VisualDL scalar logger (reference: hapi/callbacks.py VisualDL).
+    The visualdl package is not bundled; falls back to a JSONL scalar log
+    readable by any dashboard."""
+
+    def __init__(self, log_dir):
+        self.log_dir = log_dir
+        self.epoch = 0
+        self._steps = {}
+
+    def _write(self, tag, step, values):
+        import json
+        import os
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, "scalars.jsonl")
+        with open(path, "a") as f:
+            import numbers
+            rec = {"tag": tag, "step": step}
+            rec.update({k: float(v) for k, v in values.items()
+                        if isinstance(v, numbers.Number)})
+            f.write(json.dumps(rec) + "\n")
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.epoch = epoch
+        self._write("train/epoch", epoch, logs or {})
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", self.epoch, logs or {})
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logger (reference: hapi/callbacks.py
+    WandbCallback). Requires the wandb package; raises with guidance if
+    missing (zero-egress TPU pods typically stub it)."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the `wandb` package, which is not "
+                "installed in this environment") from e
+        self._wandb = wandb
+        self._kwargs = dict(project=project, entity=entity, name=name,
+                            dir=dir, mode=mode, job_type=job_type, **kwargs)
+        self.run = None
+
+    def on_train_begin(self, logs=None):
+        self.run = self._wandb.init(**{k: v for k, v in
+                                       self._kwargs.items() if v})
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.run:
+            self.run.log({f"train/{k}": v for k, v in (logs or {}).items()})
+
+    def on_train_end(self, logs=None):
+        if self.run:
+            self.run.finish()
+
+
+__all__ += ["VisualDL", "WandbCallback"]

@@ -3,6 +3,64 @@ ops under nn/functional, MoE models, extra optimizers)."""
 
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import autograd  # noqa: F401
+from . import asp  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from ..geometric import (  # noqa: F401  (reference: incubate graph ops moved to geometric)
+    segment_sum, segment_mean, segment_max, segment_min,
+)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+from ..geometric import sample_neighbors as graph_sample_neighbors  # noqa: F401
+from ..geometric import reindex_graph as graph_reindex  # noqa: F401
 
-__all__ = ["nn", "optimizer", "LookAhead", "ModelAverage"]
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, *a, **k):
+    """Multi-hop sampler built on repeated one-hop sampling (reference:
+    incubate/operators/graph_khop_sampler.py)."""
+    from ..geometric import sample_neighbors
+    nodes = input_nodes
+    edges = []
+    for size in sample_sizes:
+        out_n, out_c = sample_neighbors(row, colptr, nodes, sample_size=size)
+        edges.append((out_n, out_c))
+        nodes = out_n
+    return edges, nodes
+
+
+def identity_loss(x, reduction="none"):
+    """reference incubate identity_loss — marks a tensor as a loss for
+    IPU graphs; on TPU it reduces per `reduction`."""
+    from ..ops.reduction import mean, sum as _sum
+    if reduction in (0, "sum"):
+        return _sum(x)
+    if reduction in (1, "mean"):
+        return mean(x)
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (reference:
+    incubate/operators/softmax_mask_fuse.py — a CUDA fusion; XLA fuses the
+    add into the softmax automatically)."""
+    from ..nn.functional import softmax
+    return softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Fused causal-masked softmax (reference:
+    softmax_mask_fuse_upper_triangle.py)."""
+    import jax.numpy as jnp
+    from ..core.dispatch import defop as _defop
+    from ..core.tensor import Tensor as _T
+    from ..nn.functional import softmax
+    from ..ops.creation import tril  # noqa: F401  (registered op)
+    s = x.shape[-1]
+    mask = jnp.where(jnp.tril(jnp.ones((s, s), bool)), 0.0, -1e9)
+    return softmax(x + _T(mask.astype("float32")), axis=-1)
+
+
+__all__ = ["nn", "optimizer", "autograd", "asp", "LookAhead", "ModelAverage",
+           "segment_sum", "segment_mean", "segment_max", "segment_min",
+           "graph_send_recv", "graph_sample_neighbors", "graph_reindex",
+           "graph_khop_sampler", "identity_loss", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle"]

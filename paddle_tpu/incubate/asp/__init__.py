@@ -1,0 +1,101 @@
+"""paddle_tpu.incubate.asp — 2:4 structured sparsity (reference:
+python/paddle/incubate/asp/ — utils.py mask calculation, asp.py
+decorate/prune_model workflow).
+
+TPU note: sparse-MXU acceleration does not exist; masks are applied as
+elementwise multiplies XLA fuses into the surrounding matmul producers,
+preserving the training-with-sparsity semantics."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers"]
+
+_EXCLUDED: set = set()
+_MASKS: dict = {}
+
+
+def calculate_density(x) -> float:
+    """Fraction of non-zeros (reference: asp/utils.py calculate_density)."""
+    arr = np.asarray(x._value if hasattr(x, "_value") else x)
+    return float((arr != 0).sum()) / max(arr.size, 1)
+
+
+def _mask_n_of_m(w: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the top-n magnitudes of every m consecutive weights
+    (reference: asp/utils.py get_mask_1d / get_mask_2d_best). Returns
+    None when the weight can't be grouped into m-blocks."""
+    if w.size % m != 0:
+        return None
+    flat = w.reshape(-1, m)
+    idx = np.argsort(np.abs(flat), axis=1)[:, m - n:]
+    mask = np.zeros_like(flat)
+    np.put_along_axis(mask, idx, 1.0, axis=1)
+    return mask.reshape(w.shape)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """reference asp.py set_excluded_layers."""
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m magnitude masks to every multipliable weight (reference:
+    asp.py prune_model). Weights not groupable into m-blocks are skipped
+    (and NOT reported as pruned). Returns {param_name: mask}."""
+    if mask_algo not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
+        raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    masks = {}
+    for name, p in model.named_parameters():
+        if p.ndim < 2 or name in _EXCLUDED or "bias" in name:
+            continue
+        w = np.asarray(p._value)
+        mask = _mask_n_of_m(w, n, m)
+        if mask is None:
+            continue
+        p._in_place_update(jnp.asarray(w * mask))
+        masks[name] = mask
+        _MASKS[id(p)] = jnp.asarray(mask)
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so masked weights stay masked after each step
+    (reference: asp.py decorate -> OptimizerWithSparsityGuarantee)."""
+
+    class _ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+        def step(self):
+            self._inner.step()
+            for p in getattr(self._inner, "_parameter_list", []):
+                mask = _MASKS.get(id(p))
+                if mask is not None:
+                    p._in_place_update(p._value * mask)
+
+    return _ASPOptimizer(optimizer)
+
+
+_SUPPORTED_LAYERS = {}
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register a custom layer type as prunable (reference: asp
+    supported_layer_list.py add_supported_layer)."""
+    name = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _SUPPORTED_LAYERS[name] = pruning_func
+
+
+__all__.append("add_supported_layer")

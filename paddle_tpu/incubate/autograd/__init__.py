@@ -1,0 +1,20 @@
+"""paddle_tpu.incubate.autograd — forward-mode & functional autodiff
+(reference: python/paddle/incubate/autograd/__init__.py)."""
+
+from ...autograd.functional import (  # noqa: F401
+    jvp, vjp, Jacobian, Hessian, forward_grad,
+)
+from ...core.autograd import grad  # noqa: F401
+
+
+def enable_prim():
+    """No-op: the reference lowers to primitive ops for higher-order AD;
+    here jax's composable transforms already provide it."""
+
+
+def disable_prim():
+    """No-op counterpart of enable_prim."""
+
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "forward_grad", "grad"]

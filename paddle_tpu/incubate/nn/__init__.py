@@ -1,5 +1,13 @@
 """paddle_tpu.incubate.nn (reference: python/paddle/incubate/nn/)."""
 
 from . import functional  # noqa: F401
+from .layers import (  # noqa: F401
+    FusedLinear, FusedDropoutAdd, FusedBiasDropoutResidualLayerNorm,
+    FusedMultiHeadAttention, FusedFeedForward,
+    FusedTransformerEncoderLayer, FusedMultiTransformer, FusedEcMoe,
+)
 
-__all__ = ["functional"]
+__all__ = ["functional", "FusedLinear", "FusedDropoutAdd",
+           "FusedBiasDropoutResidualLayerNorm", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer",
+           "FusedMultiTransformer", "FusedEcMoe"]

@@ -16,10 +16,22 @@ import jax.numpy as jnp
 from ....core.dispatch import apply_op
 from ....core.tensor import Tensor
 
+from .fused_transformer import (  # noqa: F401
+    fused_matmul_bias, fused_linear_activation, fused_dropout_add,
+    fused_bias_dropout_residual_layer_norm, fused_feedforward,
+    fused_multi_head_attention, fused_multi_transformer, fused_ec_moe,
+    variable_length_memory_efficient_attention,
+)
+
 __all__ = ["fused_rms_norm", "fused_layer_norm",
            "fused_rotary_position_embedding", "swiglu", "fused_linear",
            "fused_bias_act", "masked_multihead_attention",
-           "memory_efficient_attention"]
+           "memory_efficient_attention",
+           "fused_matmul_bias", "fused_linear_activation",
+           "fused_dropout_add", "fused_bias_dropout_residual_layer_norm",
+           "fused_feedforward", "fused_multi_head_attention",
+           "fused_multi_transformer", "fused_ec_moe",
+           "variable_length_memory_efficient_attention"]
 
 
 def _t(x):

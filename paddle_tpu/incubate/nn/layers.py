@@ -1,0 +1,281 @@
+"""Fused nn Layers (reference: python/paddle/incubate/nn/layer/
+fused_linear.py, fused_transformer.py FusedMultiHeadAttention/
+FusedFeedForward/FusedTransformerEncoderLayer/FusedMultiTransformer,
+fused_dropout_add.py, fused_ec_moe.py) — module wrappers over the fused
+functionals; XLA fuses each forward into the regions the reference's
+hand-written CUDA kernels cover."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ...core.tensor import Parameter
+from ...nn.layer.layers import Layer
+from ...nn import initializer as I
+from . import functional as FF
+
+__all__ = [
+    "FusedLinear", "FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm",
+    "FusedMultiHeadAttention", "FusedFeedForward",
+    "FusedTransformerEncoderLayer", "FusedMultiTransformer", "FusedEcMoe",
+]
+
+
+def _xavier(shape):
+    return Parameter(I.XavierUniform()(shape, jnp.float32))
+
+
+def _zeros(shape):
+    return Parameter(jnp.zeros(shape, jnp.float32))
+
+
+def _ones(shape):
+    return Parameter(jnp.ones(shape, jnp.float32))
+
+
+class FusedLinear(Layer):
+    """reference fused_linear.py FusedLinear — linear via the
+    fused_matmul_bias epilogue."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = _xavier(shape)
+        self.bias = None if bias_attr is False else _zeros([out_features])
+
+    def forward(self, x):
+        return FF.fused_matmul_bias(x, self.weight, self.bias,
+                                    transpose_y=self.transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    """reference fused_dropout_add.py FusedDropoutAdd."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p, self.mode = p, mode
+
+    def forward(self, x, y):
+        return FF.fused_dropout_add(x, y, self.p, self.training, self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference fused_transformer.py FusedBiasDropoutResidualLayerNorm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = _zeros([embed_dim])
+        self.ln_scale = _ones([embed_dim])
+        self.ln_bias = _zeros([embed_dim])
+
+    def forward(self, x, residual):
+        return FF.fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            self.dropout_rate, self.epsilon, training=self.training)
+
+
+class FusedMultiHeadAttention(Layer):
+    """reference fused_transformer.py FusedMultiHeadAttention — qkv packed
+    [3, num_heads, head_dim, embed_dim] like the reference kernel."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        self.qkv_weight = _xavier([3, num_heads, self.head_dim, embed_dim])
+        self.qkv_bias = _zeros([3, num_heads, self.head_dim])
+        self.linear_weight = _xavier([embed_dim, embed_dim])
+        self.linear_bias = _zeros([embed_dim])
+        self.pre_ln_scale = _ones([embed_dim])
+        self.pre_ln_bias = _zeros([embed_dim])
+        self.ln_scale = _ones([embed_dim])
+        self.ln_bias = _zeros([embed_dim])
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return FF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """reference fused_transformer.py FusedFeedForward."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (act_dropout_rate if act_dropout_rate
+                                 is not None else dropout_rate)
+        self.epsilon = epsilon
+        self.linear1_weight = _xavier([d_model, dim_feedforward])
+        self.linear1_bias = _zeros([dim_feedforward])
+        self.linear2_weight = _xavier([dim_feedforward, d_model])
+        self.linear2_bias = _zeros([d_model])
+        self.ln1_scale = _ones([d_model])
+        self.ln1_bias = _zeros([d_model])
+        self.ln2_scale = _ones([d_model])
+        self.ln2_bias = _zeros([d_model])
+
+    def forward(self, src, cache=None):
+        return FF.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            self.linear1_bias, self.linear2_bias, self.ln1_scale,
+            self.ln1_bias, self.ln2_scale, self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate, activation=self.activation,
+            ln1_epsilon=self.epsilon, ln2_epsilon=self.epsilon,
+            pre_layer_norm=self.normalize_before, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """reference fused_transformer.py FusedTransformerEncoderLayer —
+    fused MHA + fused FFN."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout_rate = (attn_dropout_rate if attn_dropout_rate
+                             is not None else dropout_rate)
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """reference fused_transformer.py FusedMultiTransformer — the N-layer
+    serving fast path."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None,
+                 epsilon=1e-5, num_layers=-1, nranks=1, trans_qkvw=True,
+                 ring_id=-1, name=None):
+        super().__init__()
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) if qkv_weight_attrs else 1
+        assert embed_dim % num_heads == 0
+        head_dim = embed_dim // num_heads
+        self.num_layers = num_layers
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.epsilon = epsilon
+        from ...nn.layer.layers import ParameterList
+        self.ln_scales = ParameterList(
+            [_ones([embed_dim]) for _ in range(num_layers)])
+        self.ln_biases = ParameterList(
+            [_zeros([embed_dim]) for _ in range(num_layers)])
+        self.qkv_weights = ParameterList(
+            [_xavier([3, num_heads, head_dim, embed_dim])
+             for _ in range(num_layers)])
+        self.qkv_biases = ParameterList(
+            [_zeros([3, num_heads, head_dim]) for _ in range(num_layers)])
+        self.linear_weights = ParameterList(
+            [_xavier([embed_dim, embed_dim]) for _ in range(num_layers)])
+        self.linear_biases = ParameterList(
+            [_zeros([embed_dim]) for _ in range(num_layers)])
+        self.ffn_ln_scales = ParameterList(
+            [_ones([embed_dim]) for _ in range(num_layers)])
+        self.ffn_ln_biases = ParameterList(
+            [_zeros([embed_dim]) for _ in range(num_layers)])
+        self.ffn1_weights = ParameterList(
+            [_xavier([embed_dim, dim_feedforward])
+             for _ in range(num_layers)])
+        self.ffn1_biases = ParameterList(
+            [_zeros([dim_feedforward]) for _ in range(num_layers)])
+        self.ffn2_weights = ParameterList(
+            [_xavier([dim_feedforward, embed_dim])
+             for _ in range(num_layers)])
+        self.ffn2_biases = ParameterList(
+            [_zeros([embed_dim]) for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        return FF.fused_multi_transformer(
+            src, list(self.ln_scales), list(self.ln_biases),
+            list(self.qkv_weights), list(self.qkv_biases),
+            list(self.linear_weights), list(self.linear_biases),
+            list(self.ffn_ln_scales), list(self.ffn_ln_biases),
+            list(self.ffn1_weights), list(self.ffn1_biases),
+            list(self.ffn2_weights), list(self.ffn2_biases),
+            pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
+            cache_kvs=caches, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate, activation=self.activation,
+            training=self.training)
+
+
+class FusedEcMoe(Layer):
+    """reference fused_ec_moe.py FusedEcMoe."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError(f"unsupported act_type {act_type!r}")
+        self.act_type = act_type
+        self.gate_weight = _xavier([hidden_size, num_experts])
+        self.gate_bias = _zeros([num_experts])
+        self.bmm1_weight = _xavier([num_experts, hidden_size, inter_size])
+        self.bmm1_bias = _zeros([num_experts, 1, inter_size])
+        self.bmm2_weight = _xavier([num_experts, inter_size, hidden_size])
+        self.bmm2_bias = _zeros([num_experts, 1, hidden_size])
+
+    def forward(self, x, gate=None):
+        return FF.fused_ec_moe(
+            x, self.gate_weight, self.gate_bias, self.bmm1_weight,
+            self.bmm1_bias.reshape([self.bmm1_bias.shape[0], -1]),
+            self.bmm2_weight,
+            self.bmm2_bias.reshape([self.bmm2_bias.shape[0], -1]),
+            self.act_type)

@@ -120,3 +120,7 @@ class GradientMergeOptimizer:
         # between merge boundaries would drop accumulation
         if self._count % self.k_steps == 0:
             self.inner_optimizer.clear_grad(set_to_zero)
+
+
+from ..optimizer.lbfgs import LBFGS  # noqa: F401,E402  (reference: incubate/optimizer/lbfgs.py)
+__all__.append("LBFGS")

@@ -10,6 +10,7 @@ pass pipeline (fusions, memory optim) is XLA's job here."""
 from __future__ import annotations
 
 import numpy as np
+from enum import Enum
 
 from ..core.tensor import Tensor
 
@@ -133,3 +134,90 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """reference paddle_infer.create_predictor."""
     return Predictor(config)
+
+
+class DataType(Enum):
+    """reference paddle_infer DataType enum."""
+    FLOAT32 = 0
+    FLOAT16 = 1
+    INT64 = 2
+    INT32 = 3
+    UINT8 = 4
+    INT8 = 5
+    BOOL = 6
+    BFLOAT16 = 7
+
+
+class PlaceType(Enum):
+    """reference paddle_infer PlaceType enum."""
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+    TPU = 4
+
+
+class PrecisionType(Enum):
+    """reference AnalysisConfig::Precision."""
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+class XpuConfig:
+    """Accepted for API compat (reference xpu_config.h); ignored on TPU."""
+
+
+class PredictorPool:
+    """Pool of predictors sharing one compiled program (reference:
+    paddle_infer::services::PredictorPool)."""
+
+    def __init__(self, config, size=1):
+        self._preds = [create_predictor(config) for _ in range(size)]
+
+    def retrive(self, idx):  # reference spells it 'retrive'
+        return self._preds[idx]
+
+    retrieve = retrive
+
+
+def get_version():
+    from .. import __version__
+    return __version__
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # no TensorRT in a TPU build
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def get_num_bytes_of_data_type(dtype):
+    sizes = {DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.INT64: 8,
+             DataType.INT32: 4, DataType.UINT8: 1, DataType.INT8: 1,
+             DataType.BOOL: 1, DataType.BFLOAT16: 2}
+    return sizes.get(dtype, 4)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision,
+                               backend=None, **kwargs):
+    """Re-export a saved model with bf16/fp16 params (reference:
+    inference convert_to_mixed_precision). Works on jit.save artifacts."""
+    raise NotImplementedError(
+        "convert_to_mixed_precision: pass dtype='bfloat16' to jit.save "
+        "instead — TPU artifacts store precision at export time")
+
+
+def _get_phi_kernel_name(op_name):
+    return op_name  # one registry; phi-compat naming is the op name itself
+
+
+__all__ += ["DataType", "PlaceType", "PrecisionType", "XpuConfig",
+            "PredictorPool", "get_version", "get_trt_compile_version",
+            "get_trt_runtime_version", "get_num_bytes_of_data_type",
+            "convert_to_mixed_precision"]

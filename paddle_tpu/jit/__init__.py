@@ -8,3 +8,27 @@ from .save_load import save, load, TranslatedLayer  # noqa: F401
 
 __all__ = ["to_static", "not_to_static", "ignore_module", "enable_to_static",
            "TrainStep", "InputSpec", "StaticFunction", "save", "load"]
+
+
+_code_level = 0
+_verbosity = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Log transformed code at the given level (reference: jit/dy2static
+    set_code_level). Our tracer has no AST rewriting stage, so this sets
+    jax's jaxpr logging verbosity knob instead."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference jit set_verbosity — controls transform logging."""
+    global _verbosity
+    _verbosity = level
+    import logging
+    logging.getLogger("jax").setLevel(
+        logging.DEBUG if level >= 3 else logging.WARNING)
+
+
+__all__ += ["set_code_level", "set_verbosity"]

@@ -6,7 +6,7 @@ from .ops.linalg import (  # noqa: F401
     svd, inv, pinv, solve, triangular_solve, cholesky_solve, lu,
     matrix_power, matrix_rank, det, slogdet, eig, eigh, eigvals, eigvalsh,
     lstsq, multi_dot, kron, corrcoef, cov, histogram, bincount, einsum,
-    matrix_transpose, cond, householder_product,
+    matrix_transpose, cond, householder_product, lu_unpack, pca_lowrank,
 )
 
 __all__ = [
@@ -15,5 +15,5 @@ __all__ = [
     "triangular_solve", "cholesky_solve", "lu", "matrix_power",
     "matrix_rank", "det", "slogdet", "eig", "eigh", "eigvals", "eigvalsh",
     "lstsq", "multi_dot", "kron", "corrcoef", "cov", "histogram",
-    "bincount", "einsum", "matrix_transpose", "cond", "householder_product",
+    "bincount", "einsum", "matrix_transpose", "cond", "householder_product", "lu_unpack", "pca_lowrank",
 ]

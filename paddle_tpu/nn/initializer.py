@@ -169,3 +169,42 @@ class Dirac(Initializer):
                 idx = (g * (oc // self.groups) + i, i) + tuple(centers)
                 out = out.at[idx].set(1.0)
         return out
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init for transposed convs (reference:
+    nn/initializer/Bilinear)."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D shape")
+        c_out, c_in, kh, kw = shape
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        cw = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        og = np.ogrid[:kh, :kw]
+        filt = (1 - abs(og[0] / f_h - ch)) * (1 - abs(og[1] / f_w - cw))
+        w = np.zeros(shape, dtype="float32")
+        for i in range(c_out):
+            for j in range(c_in):
+                w[i, j] = filt
+        return jnp.asarray(w, convert_dtype(dtype))
+
+
+_GLOBAL_INIT = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Process-wide default initializers consulted by layers when no
+    explicit attr is given (reference: nn/initializer/set_global_initializer).
+    Pass None to reset."""
+    _GLOBAL_INIT["weight"] = weight_init
+    _GLOBAL_INIT["bias"] = bias_init
+
+
+def _global_initializer(kind):
+    return _GLOBAL_INIT.get(kind)
+
+
+__all__ += ["Bilinear", "set_global_initializer"]

@@ -49,3 +49,120 @@ def vector_to_parameters(vec, parameters, name=None):
         n = p.size
         p._in_place_update(v[off:off + n].reshape(p._value.shape).astype(p._value.dtype))
         off += n
+
+
+# ---- weight reparameterizations -----------------------------------------
+
+def _norm_except_dim(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as g * v / ||v|| (reference:
+    python/paddle/nn/utils/weight_norm_hook.py weight_norm). The
+    decomposed g/v become the trainable parameters; a forward pre-hook
+    recomputes the weight, so autograd flows to g and v."""
+    from ..core.tensor import Parameter
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1  # norm over all dims -> scalar g
+    v = Parameter(w._value, trainable=True)
+    if dim == -1:
+        g0 = jnp.sqrt(jnp.sum(w._value * w._value))
+        g = Parameter(g0.reshape(1), trainable=True)
+    else:
+        g = Parameter(_norm_except_dim(w._value, dim).reshape(-1),
+                      trainable=True)
+    # remove the original parameter; keep a plain attribute slot
+    if name in layer._parameters:
+        del layer._parameters[name]
+    layer.add_parameter(name + "_v", v)
+    layer.add_parameter(name + "_g", g)
+
+    def _compute(layer_, inputs=None):
+        vv = getattr(layer_, name + "_v")
+        gg = getattr(layer_, name + "_g")
+        if dim == -1:
+            from ..ops.reduction import sum as _sum
+            norm = (vv * vv).sum().sqrt()
+            w_new = vv * (gg / norm)
+        else:
+            from ..ops import linalg as _  # noqa: F401
+            axes = tuple(i for i in range(vv.ndim) if i != dim)
+            sq = (vv * vv).sum(axis=list(axes), keepdim=True).sqrt()
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            w_new = vv / sq * gg.reshape(shape)
+        object.__setattr__(layer_, name, w_new)
+
+    _compute(layer)
+    handle = layer.register_forward_pre_hook(
+        lambda l, inp: _compute(l, inp))
+    layer._weight_norm_handles = getattr(layer, "_weight_norm_handles", {})
+    layer._weight_norm_handles[name] = (handle, dim)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a single weight parameter (reference:
+    weight_norm_hook.py remove_weight_norm)."""
+    from ..core.tensor import Parameter
+    handles = getattr(layer, "_weight_norm_handles", {})
+    if name not in handles:
+        raise ValueError(f"no weight_norm on parameter {name!r}")
+    handle, dim = handles.pop(name)
+    handle.remove()
+    w = getattr(layer, name)  # current recomputed weight
+    del layer._parameters[name + "_v"]
+    del layer._parameters[name + "_g"]
+    layer.add_parameter(name, Parameter(w._value, trainable=True))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Normalize a weight by its largest singular value, estimated with
+    power iteration (reference: python/paddle/nn/utils/spectral_norm_hook.py
+    spectral_norm)."""
+    import numpy as np
+    from ..core.tensor import Parameter
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 1 if type(layer).__name__.endswith(
+            ("Conv2DTranspose", "Conv1DTranspose", "Conv3DTranspose",
+             "Linear")) else 0
+    v0 = w._value
+    mat = jnp.moveaxis(v0, dim, 0).reshape(v0.shape[dim], -1)
+    h, w_dim = mat.shape
+    rng = np.random.default_rng(0)
+    u = jnp.asarray(rng.standard_normal(h).astype(np.float32))
+    u = u / (jnp.linalg.norm(u) + eps)
+
+    orig = Parameter(v0, trainable=True)
+    if name in layer._parameters:
+        del layer._parameters[name]
+    layer.add_parameter(name + "_orig", orig)
+    state = {"u": u}
+
+    def _compute(layer_, inputs=None):
+        wv = getattr(layer_, name + "_orig")
+        m = jnp.moveaxis(wv._value, dim, 0).reshape(wv._value.shape[dim], -1)
+        u_ = state["u"]
+        for _ in range(n_power_iterations):
+            v_ = m.T @ u_
+            v_ = v_ / (jnp.linalg.norm(v_) + eps)
+            u_ = m @ v_
+            u_ = u_ / (jnp.linalg.norm(u_) + eps)
+        state["u"] = u_
+        sigma = jnp.dot(u_, m @ v_)
+        from ..core.tensor import Tensor as _T
+        w_sn = wv / float(sigma)
+        object.__setattr__(layer_, name, w_sn)
+
+    _compute(layer)
+    layer.register_forward_pre_hook(lambda l, inp: _compute(l, inp))
+    return layer
+
+
+__all__ += ["weight_norm", "remove_weight_norm", "spectral_norm"]

@@ -20,6 +20,7 @@ __all__ = [
     "det", "slogdet", "eig", "eigh", "eigvals", "eigvalsh", "lstsq",
     "multi_dot", "kron", "corrcoef", "cov", "histogram", "bincount",
     "einsum", "matrix_transpose", "cond", "householder_product",
+    "lu_unpack", "pca_lowrank",
 ]
 
 
@@ -450,3 +451,42 @@ def householder_product(x, tau, name=None):
     if xx.shape[-2] < xx.shape[-1]:
         raise ValueError("householder_product expects rows >= cols")
     return _householder_product(xx, tt)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu_factor output into P, L, U (reference: tensor/linalg.py
+    lu_unpack → phi lu_unpack kernel). y is the 1-based pivot vector
+    from ``lu``."""
+    lu_v = _t(x)._value
+    piv = _t(y)._value.astype(jnp.int32) - 1  # back to 0-based
+    m, n = lu_v.shape[-2], lu_v.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu_v[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_v.dtype)
+    U = jnp.triu(lu_v[..., :k, :])
+    # pivots -> permutation matrix: apply the recorded row swaps to an
+    # identity, independently per batch element
+    import numpy as np
+    pv = np.asarray(piv)
+    batch_shape = pv.shape[:-1]
+    pv2 = pv.reshape(-1, pv.shape[-1])
+    eyes = np.empty((pv2.shape[0], m, m), dtype=np.asarray(lu_v).dtype)
+    for b in range(pv2.shape[0]):
+        perm = np.arange(m)
+        for i in range(pv2.shape[1]):
+            j = int(pv2[b, i])
+            perm[[i, j]] = perm[[j, i]]
+        eyes[b] = np.eye(m, dtype=eyes.dtype)[perm].T
+    P = jnp.asarray(eyes.reshape(batch_shape + (m, m)))
+    outs = []
+    outs.append(Tensor(P) if unpack_pivots else None)
+    if unpack_ludata:
+        outs += [Tensor(L), Tensor(U)]
+    else:
+        outs += [None, None]
+    return tuple(outs)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized low-rank PCA (reference: tensor/linalg.py pca_lowrank)."""
+    from ..sparse import pca_lowrank as _impl
+    return _impl(_t(x), q=q, center=center, niter=niter)

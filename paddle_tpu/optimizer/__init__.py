@@ -2,6 +2,7 @@
 
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizers import (  # noqa: F401
     SGD, Momentum, Adam, AdamW, Adagrad, Adadelta, Adamax, RMSProp, Lamb,
     NAdam, RAdam, ASGD, Rprop,
@@ -9,4 +10,4 @@ from .optimizers import (  # noqa: F401
 
 __all__ = ["lr", "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
            "Adadelta", "Adamax", "RMSProp", "Lamb", "NAdam", "RAdam", "ASGD",
-           "Rprop"]
+           "Rprop", "LBFGS"]

@@ -1,0 +1,177 @@
+"""L-BFGS optimizer (reference: python/paddle/optimizer/lbfgs.py — the
+closure-driven quasi-Newton with optional strong-Wolfe line search).
+
+Host-driven loop like the reference: each iteration re-evaluates the
+closure (forward+backward through the eager engine); the two-loop
+recursion runs on flattened fp32 vectors that XLA keeps on device."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+class LBFGS(Optimizer):
+    """reference lbfgs.py LBFGS(learning_rate, max_iter, max_eval,
+    tolerance_grad, tolerance_change, history_size, line_search_fn)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        if max_eval is None:
+            max_eval = max_iter * 5 // 4
+        self.max_iter = max_iter
+        self.max_eval = max_eval
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        self.line_search_fn = line_search_fn
+        self._s_hist: list = []
+        self._y_hist: list = []
+        self._prev_flat_grad = None
+        self._n_evals = 0
+
+    # -- flat parameter/grad views ----------------------------------------
+    def _flat_params(self):
+        return jnp.concatenate(
+            [p._value.astype(jnp.float32).reshape(-1)
+             for p in self._parameter_list])
+
+    def _flat_grads(self):
+        gs = []
+        for p in self._parameter_list:
+            if p.grad is None:
+                gs.append(jnp.zeros(int(np.prod(p.shape)), jnp.float32))
+            else:
+                gs.append(p.grad._value.astype(jnp.float32).reshape(-1))
+        return jnp.concatenate(gs)
+
+    def _set_flat_params(self, flat):
+        ofs = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p.shape))
+            p._in_place_update(
+                flat[ofs:ofs + n].reshape(p._value.shape).astype(
+                    p._value.dtype))
+            ofs += n
+
+    # -- two-loop recursion -------------------------------------------------
+    def _direction(self, flat_grad):
+        q = flat_grad
+        m = len(self._s_hist)
+        alphas = []
+        for s, y in zip(reversed(self._s_hist), reversed(self._y_hist)):
+            rho = 1.0 / (jnp.dot(y, s) + 1e-10)
+            a = rho * jnp.dot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if m:
+            s, y = self._s_hist[-1], self._y_hist[-1]
+            q = q * (jnp.dot(s, y) / (jnp.dot(y, y) + 1e-10))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    def _eval(self, closure, flat):
+        self._set_flat_params(flat)
+        self.clear_grad()
+        loss = closure()
+        self._n_evals += 1
+        return float(loss), self._flat_grads()
+
+    def step(self, closure=None):
+        """One L-BFGS optimization step; ``closure`` re-evaluates the
+        model and returns the loss (required, like the reference)."""
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        self._n_evals = 0
+        loss = closure()
+        loss_val = float(loss)
+        flat = self._flat_params()
+        flat_grad = self._flat_grads()
+        lr = self._lr_value()
+
+        for it in range(self.max_iter):
+            if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
+                break
+            d = self._direction(flat_grad)
+            gtd = float(jnp.dot(flat_grad, d))
+            if gtd > -1e-12:  # not a descent direction: reset memory
+                self._s_hist.clear()
+                self._y_hist.clear()
+                d = -flat_grad
+                gtd = float(jnp.dot(flat_grad, d))
+
+            t = lr if (self._s_hist or it > 0) else min(
+                1.0, 1.0 / max(float(jnp.abs(flat_grad).sum()), 1e-10)) * lr
+
+            if self.line_search_fn == "strong_wolfe":
+                t, new_loss, new_grad = self._strong_wolfe(
+                    closure, flat, d, t, loss_val, flat_grad, gtd)
+            else:
+                new_flat = flat + t * d
+                new_loss, new_grad = self._eval(closure, new_flat)
+
+            new_flat = flat + t * d
+            s = new_flat - flat
+            y = new_grad - flat_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                if len(self._s_hist) > self.history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+
+            if abs(new_loss - loss_val) < self.tolerance_change or \
+                    float(jnp.abs(s).max()) < self.tolerance_change:
+                flat, flat_grad, loss_val = new_flat, new_grad, new_loss
+                break
+            flat, flat_grad, loss_val = new_flat, new_grad, new_loss
+            if self._n_evals >= self.max_eval:
+                break
+
+        self._set_flat_params(flat)
+        self._prev_flat_grad = flat_grad
+        if hasattr(self._lr, "step"):
+            self._lr.step()
+        return Tensor(jnp.asarray(loss_val))
+
+    def _lr_value(self):
+        return self.get_lr()
+
+    def _strong_wolfe(self, closure, flat, d, t, f0, g0, gtd0,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        """Strong-Wolfe backtracking/zoom (reference lbfgs.py
+        _strong_wolfe, simplified bisection zoom)."""
+        t_lo, t_hi = 0.0, None
+        f_lo, g_lo = f0, g0
+        best = None
+        for _ in range(max_ls):
+            f_t, g_t = self._eval(closure, flat + t * d)
+            if best is None:
+                best = (t, f_t, g_t)
+            gtd_t = float(jnp.dot(g_t, d))
+            if f_t > f0 + c1 * t * gtd0 or (t_lo > 0 and f_t >= f_lo):
+                t_hi = t
+            elif abs(gtd_t) <= -c2 * gtd0:
+                return t, f_t, g_t
+            elif gtd_t >= 0:
+                t_hi = t
+            else:
+                t_lo, f_lo, g_lo = t, f_t, g_t
+            best = min(best, (t, f_t, g_t), key=lambda r: r[1])
+            t = (t_lo + t_hi) / 2.0 if t_hi is not None else t * 2.0
+            if t_hi is not None and t_hi - t_lo < 1e-9:
+                break
+        return best

@@ -335,3 +335,55 @@ class Profiler:
                                "min_ms": v[2], "max_ms": v[3]}
                            for k, v in agg.items()},
                 "op_counts": dict(self._op_counts)}
+
+
+class SortedKeys(Enum):
+    """Sort keys for summary tables (reference: profiler/profiler_statistic.py
+    SortedKeys)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(Enum):
+    """Summary table views (reference: profiler/profiler.py SummaryView)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name: str, worker_name: str | None = None):
+    """Protobuf-dump exporter (reference: profiler.py export_protobuf).
+    The TPU build's interchange format is the chrome trace; this emits the
+    same span payload serialized with pickle (protobuf schema owned by the
+    reference's C++ tracer doesn't exist here) under .pb naming for
+    tooling parity."""
+    import os
+    import pickle
+    import socket
+    import time
+
+    def handle(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        worker = worker_name or f"host_{socket.gethostname()}"
+        path = os.path.join(dir_name,
+                            f"{worker}_{time.strftime('%Y%m%d%H%M%S')}.pb")
+        with open(path, "wb") as f:
+            pickle.dump([s.__dict__ for s in prof._spans], f)
+        return path
+
+    return handle
+
+
+__all__ += ["SortedKeys", "SummaryView", "export_protobuf"]

@@ -1,0 +1,133 @@
+"""paddle_tpu.signal — STFT / ISTFT (reference: python/paddle/signal.py
+stft:163, istft:324 → phi frame/overlap_add + fft kernels).
+
+Composed from the fft module's backend-aware transforms (DFT-as-matmul on
+TPU, jnp.fft elsewhere) so gradients flow on every backend."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from . import fft as _fft
+
+__all__ = ["stft", "istft"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Sliding frames along an axis (reference: signal.py frame → phi
+    frame kernel)."""
+    xx = _t(x)
+    v = xx._value
+    n = v.shape[axis]
+    num = (n - frame_length) // hop_length + 1
+    starts = jnp.arange(num) * hop_length
+    win = jnp.arange(frame_length)
+    idx = (starts[:, None] + win[None, :]).reshape(-1)
+    out = jnp.take(v, idx, axis=axis)
+    if axis == -1 or axis == v.ndim - 1:
+        out = out.reshape(v.shape[:-1] + (num, frame_length))
+        out = jnp.swapaxes(out, -1, -2)  # paddle: [..., frame_length, num]
+    else:
+        raise NotImplementedError("frame supports axis=-1")
+    return Tensor(out)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference: signal.py overlap_add)."""
+    xx = _t(x)
+    v = xx._value  # [..., frame_length, frames]
+    fl, num = v.shape[-2], v.shape[-1]
+    n = (num - 1) * hop_length + fl
+    out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+    for i in range(num):  # bounded python loop, unrolled by XLA
+        out = out.at[..., i * hop_length:i * hop_length + fl].add(
+            v[..., :, i])
+    return Tensor(out)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference: signal.py stft). Returns
+    [..., n_fft//2+1 (or n_fft), frames] complex."""
+    xx = _t(x)
+    v = xx._value
+    if hop_length is None:
+        hop_length = n_fft // 4
+    if win_length is None:
+        win_length = n_fft
+    if window is not None:
+        w = _t(window)._value
+    else:
+        w = jnp.ones((win_length,), jnp.float32)
+    # center-pad window to n_fft
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = n_fft // 2
+        v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    framed = frame(Tensor(v), n_fft, hop_length)            # [..., n_fft, F]
+    fv = framed._value * w[..., :, None]
+    fv = jnp.swapaxes(fv, -1, -2)                           # [..., F, n_fft]
+    spec = _fft.rfft(Tensor(fv), axis=-1) if onesided else \
+        _fft.fft(Tensor(fv), axis=-1)
+    sv = jnp.swapaxes(spec._value, -1, -2)                  # [..., bins, F]
+    if normalized:
+        sv = sv / jnp.sqrt(jnp.asarray(float(n_fft)))
+    return Tensor(sv)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT by overlap-add with window-envelope normalization
+    (reference: signal.py istft)."""
+    xx = _t(x)
+    sv = xx._value  # [..., bins, F]
+    if hop_length is None:
+        hop_length = n_fft // 4
+    if win_length is None:
+        win_length = n_fft
+    if window is not None:
+        w = _t(window)._value.astype(jnp.float32)
+    else:
+        w = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    if normalized:
+        sv = sv * jnp.sqrt(jnp.asarray(float(n_fft)))
+    sv = jnp.swapaxes(sv, -1, -2)  # [..., F, bins]
+    if onesided:
+        if return_complex:
+            raise ValueError(
+                "return_complex=True requires onesided=False (reference "
+                "istft contract)")
+        frames = _fft.irfft(Tensor(sv), n=n_fft, axis=-1)._value
+    else:
+        frames = _fft.ifft(Tensor(sv), axis=-1)._value
+        if not return_complex:
+            frames = jnp.real(frames)
+    frames = frames * w  # synthesis window
+    frames = jnp.swapaxes(frames, -1, -2)  # [..., n_fft, F]
+    out = overlap_add(Tensor(frames), hop_length)._value
+    # window envelope for COLA normalization
+    num = frames.shape[-1]
+    env = overlap_add(
+        Tensor(jnp.broadcast_to((w * w)[:, None], (n_fft, num))),
+        hop_length)._value
+    out = out / jnp.maximum(env, 1e-10)
+    if center:
+        pad = n_fft // 2
+        out = out[..., pad:out.shape[-1] - pad]
+    if length is not None:
+        out = out[..., :length]
+    return Tensor(out)

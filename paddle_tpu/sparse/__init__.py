@@ -276,3 +276,119 @@ def masked_matmul(x, y, mask, name=None):
     cols = jnp.take(yd.T, idx[:, 1], axis=0)        # [nnz, k]
     vals = jnp.sum(rows * cols, axis=-1)
     return SparseCooTensor(jsparse.BCOO((vals, idx), shape=m.shape))
+
+
+# round-2 long tail (reference sparse/unary.py remainder + binary addmm/mv)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+sinh = _unary("sinh", jnp.sinh)
+tan = _unary("tan", jnp.tan)
+expm1 = _unary("expm1", jnp.expm1)
+log1p = _unary("log1p", jnp.log1p)
+square = _unary("square", jnp.square)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """reference sparse/unary.py cast — cast indices and/or values."""
+    from ..core.dtype import convert_dtype
+    if isinstance(x, SparseCooTensor):
+        m = x._m
+        data = m.data.astype(convert_dtype(value_dtype)) \
+            if value_dtype else m.data
+        idx = m.indices.astype(convert_dtype(index_dtype)) \
+            if index_dtype else m.indices
+        return SparseCooTensor(jsparse.BCOO((data, idx), shape=m.shape))
+    m = x._m
+    data = m.data.astype(convert_dtype(value_dtype)) if value_dtype else m.data
+    idx, ptr = m.indices, m.indptr
+    if index_dtype:
+        idt = convert_dtype(index_dtype)
+        idx, ptr = idx.astype(idt), ptr.astype(idt)
+    return SparseCsrTensor(jsparse.BCSR((data, idx, ptr), shape=m.shape))
+
+
+def reshape(x, shape, name=None):
+    """reference sparse/unary.py reshape — via dense roundtrip (XLA fuses
+    the gather/scatter pair)."""
+    dense = x.to_dense()
+    new = jnp.reshape(dense._value, shape)
+    if isinstance(x, SparseCsrTensor):
+        return sparse_csr_tensor_from_dense(Tensor(new))
+    return SparseCooTensor(jsparse.BCOO.fromdense(new))
+
+
+def sparse_csr_tensor_from_dense(t):
+    return SparseCsrTensor(jsparse.BCSR.fromdense(t._value))
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix × dense vector (reference sparse/binary.py mv)."""
+    return Tensor(x._m @ _v(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y) with sparse x (reference sparse/binary.py
+    addmm)."""
+    return Tensor(beta * _v(input) + alpha * (x._m @ _v(y)))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA via randomized range finding (reference:
+    sparse/unary.py pca_lowrank / tensor/linalg.py pca_lowrank)."""
+    import numpy as np
+    a = x.to_dense()._value if isinstance(
+        x, (SparseCooTensor, SparseCsrTensor)) else _v(x)
+    m, n = a.shape[-2], a.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - a.mean(axis=-2, keepdims=True)
+    rng = np.random.default_rng(0)
+    # oversample then truncate (Halko et al.), re-orthonormalizing every
+    # power iteration for numerical range accuracy
+    p_over = min(n, q + 4)
+    omega = jnp.asarray(rng.standard_normal((n, p_over)).astype(a.dtype))
+    y = a @ omega
+    for _ in range(max(niter, 1)):
+        y, _ = jnp.linalg.qr(a @ (a.T @ y))
+    qmat, _ = jnp.linalg.qr(y)
+    b = qmat.T @ a
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ u_b
+    return Tensor(u[:, :q]), Tensor(s[:q]), Tensor(vt[:q].T)
+
+
+__all__ += ["asin", "asinh", "atan", "atanh", "sinh", "tan", "expm1",
+            "log1p", "square", "deg2rad", "rad2deg", "isnan", "cast",
+            "reshape", "mv", "addmm", "pca_lowrank"]
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """reference sparse/unary.py sum — dense-reduce of stored values."""
+    d = x.to_dense()._value if isinstance(
+        x, (SparseCooTensor, SparseCsrTensor)) else _v(x)
+    out = jnp.sum(d, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """reference sparse/unary.py slice — via dense path."""
+    import builtins
+    d = x.to_dense()._value
+    idx = [builtins.slice(None)] * d.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(int(st), int(en))
+    out = d[tuple(idx)]
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.fromdense(out))
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+__all__ += ["sum", "slice"]

@@ -1,0 +1,17 @@
+"""paddle_tpu.sysconfig (reference: python/paddle/sysconfig.py —
+get_include/get_lib for building custom extensions)."""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory containing this package's headers (the custom C++ op
+    extension API lives beside utils/cpp_extension)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "include")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "lib")

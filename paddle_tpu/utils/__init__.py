@@ -2,5 +2,7 @@
 unique_name, deprecated helpers)."""
 
 from . import cpp_extension  # noqa: F401
+from .helpers import deprecated, require_version, run_check, try_import  # noqa: F401
 
-__all__ = ["cpp_extension"]
+__all__ = ["cpp_extension", "deprecated", "require_version", "run_check",
+           "try_import"]

@@ -1,0 +1,77 @@
+"""General utilities (reference: python/paddle/utils/ — deprecated
+decorator, install_check.run_check, lazy_import try_import,
+require_version)."""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+__all__ = ["deprecated", "require_version", "run_check", "try_import"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Mark an API deprecated; warns on call (reference:
+    utils/deprecated.py)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version (reference:
+    utils/__init__.py require_version)."""
+    from .. import __version__
+
+    def as_tuple(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = as_tuple(__version__)
+    if as_tuple(min_version) > cur:
+        raise Exception(
+            f"version {__version__} < required minimum {min_version}")
+    if max_version is not None and as_tuple(max_version) < cur:
+        raise Exception(
+            f"version {__version__} > allowed maximum {max_version}")
+    return True
+
+
+def run_check(verbose=True):
+    """Smoke-check the install: run a tiny matmul on the default device
+    (reference: utils/install_check.py run_check)."""
+    import numpy as np
+    import paddle_tpu as p
+    a = p.to_tensor(np.ones((2, 2), dtype="float32"))
+    out = (a @ a).numpy()
+    assert np.allclose(out, 2 * np.ones((2, 2)))
+    if verbose:
+        import jax
+        print(f"paddle_tpu is installed successfully! "
+              f"backend={jax.default_backend()}, "
+              f"devices={len(jax.devices())}")
+    return True
+
+
+def try_import(module_name, err_msg=None):
+    """Import a module or raise a helpful error (reference:
+    utils/lazy_import.py try_import)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"Failed to import {module_name}: {e}. "
+            f"Install it to use this feature.") from e

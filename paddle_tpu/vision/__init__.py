@@ -6,3 +6,42 @@ from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 
 __all__ = ["models", "transforms", "datasets", "ops"]
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """reference vision/image.py set_image_backend ('pil' | 'cv2' |
+    'tensor')."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"invalid image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file per the active backend (reference:
+    vision/image.py image_load)."""
+    backend = backend or _image_backend
+    if backend == "cv2":
+        import numpy as np
+        from ..utils.helpers import try_import
+        cv2 = try_import("cv2", "cv2 backend requires opencv-python")
+        return cv2.imread(path)
+    from ..utils.helpers import try_import
+    Image = try_import("PIL.Image", "pil backend requires Pillow")
+    img = Image.open(path)
+    if backend == "tensor":
+        import numpy as np
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(np.asarray(img)))
+    return img
+
+
+__all__ += ["set_image_backend", "get_image_backend", "image_load"]

@@ -1,0 +1,256 @@
+"""Round-2 API-tail coverage: functional autodiff, LBFGS, weight/spectral
+norm, signal, fft Hermitian, sparse tail, asp, incubate graph ops, shims
+(reference: the corresponding python/paddle modules)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestFunctionalAutodiff:
+    def test_jacobian_hessian_graph_forms(self):
+        x = paddle.to_tensor(np.array([1., 2., 3.], "float32"),
+                             stop_gradient=False)
+        y = x * x
+        J = paddle.autograd.jacobian(y, x)
+        np.testing.assert_allclose(_np(J), np.diag([2., 4., 6.]), atol=1e-5)
+        x2 = paddle.to_tensor(np.array([1., 2.], "float32"),
+                              stop_gradient=False)
+        z = (x2 * x2 * x2).sum()
+        H = paddle.autograd.hessian(z, x2)
+        np.testing.assert_allclose(_np(H), np.diag([6., 12.]), atol=1e-4)
+
+    def test_incubate_jvp_vjp(self):
+        import paddle_tpu.incubate.autograd as ia
+        f = lambda t: paddle.tanh(t)
+        x = paddle.to_tensor(np.array([0.5], "float32"))
+        v = paddle.to_tensor(np.array([1.0], "float32"))
+        _, tan = ia.jvp(f, x, v)
+        _, cot = ia.vjp(f, x, v)
+        ref = 1 - np.tanh(0.5) ** 2
+        assert abs(_np(tan)[0] - ref) < 1e-6
+        assert abs(_np(cot)[0] - ref) < 1e-6
+        Jc = ia.Jacobian(lambda t: t * t,
+                         paddle.to_tensor(np.array([1., 2.], "float32")))
+        np.testing.assert_allclose(_np(Jc[:]), np.diag([2., 4.]), atol=1e-5)
+
+
+class TestLBFGS:
+    def test_least_squares_convergence(self):
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((6, 3)).astype("float32")
+        b = rng.standard_normal(6).astype("float32")
+        x = paddle.to_tensor(np.zeros(3, "float32"), stop_gradient=False)
+        opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=30,
+                                     line_search_fn="strong_wolfe",
+                                     parameters=[x])
+
+        def closure():
+            r = paddle.to_tensor(A) @ x - paddle.to_tensor(b)
+            loss = (r * r).sum()
+            loss.backward()
+            return loss
+
+        opt.step(closure)
+        x_star = np.linalg.lstsq(A, b, rcond=None)[0]
+        np.testing.assert_allclose(_np(x), x_star, atol=1e-3)
+
+
+class TestWeightReparam:
+    def test_weight_norm_roundtrip(self):
+        from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+        lin = nn.Linear(4, 3)
+        w0 = _np(lin.weight).copy()
+        weight_norm(lin, "weight", dim=1)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        ref = _np(x) @ w0 + _np(lin.bias)
+        np.testing.assert_allclose(_np(lin(x)), ref, atol=1e-4)
+        loss = (lin(x) ** 2).sum()
+        loss.backward()
+        assert lin.weight_g.grad is not None and lin.weight_v.grad is not None
+        remove_weight_norm(lin, "weight")
+        np.testing.assert_allclose(_np(lin(x)), ref, atol=1e-4)
+
+    def test_spectral_norm_sigma_one(self):
+        from paddle_tpu.nn.utils import spectral_norm
+        lin = nn.Linear(8, 6)
+        spectral_norm(lin, "weight", n_power_iterations=20)
+        lin(paddle.to_tensor(np.random.randn(1, 8).astype("float32")))
+        sv = np.linalg.svd(_np(lin.weight), compute_uv=False)[0]
+        assert abs(sv - 1.0) < 0.05
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        from paddle_tpu import signal as S
+        x = np.sin(np.linspace(0, 40 * np.pi, 1024)).astype("float32")
+        w = np.hanning(256).astype("float32")
+        spec = S.stft(paddle.to_tensor(x), 256, hop_length=64,
+                      window=paddle.to_tensor(w))
+        assert spec.shape == [129, 17]
+        rec = S.istft(spec, 256, hop_length=64, window=paddle.to_tensor(w),
+                      length=1024)
+        assert np.abs(_np(rec) - x)[128:-128].max() < 1e-3
+
+
+class TestFftHermitian:
+    def test_hfft2_matches_scipy(self):
+        import scipy.fft as sfft
+        x = (np.random.randn(4, 5) + 1j * np.random.randn(4, 5)).astype(
+            "complex64")
+        np.testing.assert_allclose(_np(paddle.fft.hfft2(paddle.to_tensor(x))),
+                                   sfft.hfft2(x), atol=1e-3)
+        xr = np.random.randn(4, 6).astype("float32")
+        np.testing.assert_allclose(
+            _np(paddle.fft.ihfft2(paddle.to_tensor(xr))),
+            sfft.ihfft2(xr), atol=1e-5)
+
+
+class TestLinalgTail:
+    def test_lu_unpack_reconstructs(self):
+        A = np.random.randn(4, 4).astype("float32")
+        lu_t, piv = paddle.linalg.lu(paddle.to_tensor(A))
+        P, L, U = paddle.linalg.lu_unpack(lu_t, piv)
+        np.testing.assert_allclose(_np(P) @ _np(L) @ _np(U), A, atol=1e-4)
+
+    def test_pca_lowrank_top_singulars(self):
+        X = np.random.randn(20, 5).astype("float32")
+        u, s, v = paddle.linalg.pca_lowrank(paddle.to_tensor(X), q=3)
+        Xc = X - X.mean(0)
+        s_ref = np.linalg.svd(Xc, compute_uv=False)[:3]
+        np.testing.assert_allclose(_np(s), s_ref, rtol=1e-3)
+
+
+class TestSparseTail:
+    def test_unary_binary_tail(self):
+        import paddle_tpu.sparse as sp
+        d = np.array([[0., .5], [.2, 0.]], "float32")
+        coo = sp.sparse_coo_tensor(
+            paddle.to_tensor(np.array([[0, 1], [1, 0]])),
+            paddle.to_tensor(np.array([.5, .2], "float32")), [2, 2])
+        np.testing.assert_allclose(_np(sp.asin(coo).to_dense()),
+                                   np.arcsin(d), atol=1e-6)
+        np.testing.assert_allclose(
+            _np(sp.mv(coo, paddle.to_tensor(np.ones(2, "float32")))),
+            d @ [1, 1])
+        am = sp.addmm(paddle.to_tensor(np.ones((2, 2), "float32")), coo,
+                      paddle.to_tensor(np.eye(2, dtype="float32")),
+                      beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(_np(am), 0.5 + 2 * d)
+        assert abs(sp.sum(coo).item() - 0.7) < 1e-6
+        assert sp.slice(coo, [0], [0], [1]).shape == [1, 2]
+
+
+class TestASP:
+    def test_prune_and_decorate(self):
+        import paddle_tpu.incubate as inc
+        net = nn.Linear(8, 8)
+        inc.asp.prune_model(net)
+        assert abs(inc.asp.calculate_density(net.weight) - 0.5) < 0.01
+        opt = inc.asp.decorate(
+            paddle.optimizer.SGD(0.1, parameters=net.parameters()))
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        loss = (net(x) ** 2).sum()
+        loss.backward()
+        opt.step()
+        assert abs(inc.asp.calculate_density(net.weight) - 0.5) < 0.01
+
+
+class TestIncubateGraphAndMisc:
+    def test_softmax_mask_fuse_upper_triangle(self):
+        import paddle_tpu.incubate as inc
+        out = inc.softmax_mask_fuse_upper_triangle(paddle.to_tensor(
+            np.random.randn(1, 1, 4, 4).astype("float32")))
+        arr = _np(out)[0, 0]
+        assert abs(arr[0, 0] - 1.0) < 1e-5 and arr[0, 1] < 1e-6
+        np.testing.assert_allclose(arr.sum(-1), np.ones(4), atol=1e-5)
+
+    def test_segment_reexports(self):
+        import paddle_tpu.incubate as inc
+        out = inc.segment_sum(
+            paddle.to_tensor(np.array([1., 2., 3.], "float32")),
+            paddle.to_tensor(np.array([0, 0, 1])))
+        np.testing.assert_allclose(_np(out), [3., 3.])
+
+    def test_utils_and_shims(self):
+        import paddle_tpu.utils as U
+        assert U.require_version("0.0.1")
+        with pytest.raises(ImportError):
+            U.try_import("definitely_not_a_module_xyz")
+        assert paddle.amp.is_bfloat16_supported()
+        paddle.jit.set_verbosity(0)
+        from paddle_tpu.profiler import SortedKeys, SummaryView
+        assert SortedKeys.CPUTotal is not None
+        from paddle_tpu.inference import DataType, get_num_bytes_of_data_type
+        assert get_num_bytes_of_data_type(DataType.BFLOAT16) == 2
+        s = paddle.device.current_stream()
+        with paddle.device.stream_guard(s):
+            pass
+
+
+class TestFusedNN:
+    """incubate.nn fused layers + functionals (reference:
+    incubate/nn/layer/fused_transformer.py)."""
+
+    def test_fused_matmul_bias(self):
+        import paddle_tpu.incubate.nn.functional as FF
+        x = paddle.to_tensor(np.random.randn(2, 6, 16).astype("float32"))
+        w = np.random.randn(16, 8).astype("float32")
+        b = np.random.randn(8).astype("float32")
+        got = FF.fused_matmul_bias(x, paddle.to_tensor(w),
+                                   paddle.to_tensor(b))
+        np.testing.assert_allclose(_np(got), _np(x) @ w + b, atol=1e-4)
+
+    def test_fused_mha_matches_manual(self):
+        import paddle_tpu.incubate.nn as inn
+        B, S, D, H = 2, 6, 16, 4
+        x = paddle.to_tensor(np.random.randn(B, S, D).astype("float32"))
+        mha = inn.FusedMultiHeadAttention(D, H, dropout_rate=0.0,
+                                          attn_dropout_rate=0.0)
+        mha.eval()
+        out = mha(x)
+        qkv = np.einsum("bse,nhde->bsnhd", _np(x), _np(mha.qkv_weight)) \
+            + _np(mha.qkv_bias)[None, None]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        sc = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(D // H)
+        pr = np.exp(sc - sc.max(-1, keepdims=True))
+        pr /= pr.sum(-1, keepdims=True)
+        ctx = np.einsum("bhst,bthd->bshd", pr, v).reshape(B, S, D)
+        res = _np(x) + ctx @ _np(mha.linear_weight) + _np(mha.linear_bias)
+        mean = res.mean(-1, keepdims=True)
+        var = res.var(-1, keepdims=True)
+        ref = (res - mean) / np.sqrt(var + 1e-5) * _np(mha.ln_scale) \
+            + _np(mha.ln_bias)
+        np.testing.assert_allclose(_np(out), ref, atol=1e-3)
+
+    def test_fused_ffn_encoder_multitransformer_ecmoe(self):
+        import paddle_tpu.incubate.nn as inn
+        B, S, D, H = 2, 5, 16, 4
+        x = paddle.to_tensor(np.random.randn(B, S, D).astype("float32"))
+        ffn = inn.FusedFeedForward(D, 32, dropout_rate=0.0)
+        out = ffn(x)
+        loss = (out * out).sum()
+        loss.backward()
+        assert ffn.linear1_weight.grad is not None
+        enc = inn.FusedTransformerEncoderLayer(D, H, 32, dropout_rate=0.0)
+        enc.eval()
+        assert enc(x).shape == [B, S, D]
+        mt = inn.FusedMultiTransformer(D, H, 32, num_layers=2)
+        mt.eval()
+        assert mt(x).shape == [B, S, D]
+        moe = inn.FusedEcMoe(D, 32, 4, "gelu")
+        assert moe(x).shape == [B, S, D]
+
+    def test_varlen_attention_masks(self):
+        import paddle_tpu.incubate.nn.functional as FF
+        q = paddle.to_tensor(np.random.randn(2, 2, 4, 8).astype("float32"))
+        out = FF.variable_length_memory_efficient_attention(
+            q, q, q, paddle.to_tensor(np.array([2, 4])),
+            paddle.to_tensor(np.array([2, 4])))
+        np.testing.assert_allclose(_np(out)[0, :, 2:], 0.0)
