@@ -66,6 +66,8 @@ from . import utils  # noqa: F401
 from . import static  # noqa: F401
 from . import signal  # noqa: F401
 from . import sysconfig  # noqa: F401
+from . import onnx  # noqa: F401
+from . import reader  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from . import callbacks  # noqa: F401
 from .framework.io import save, load  # noqa: F401

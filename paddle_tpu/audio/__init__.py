@@ -8,10 +8,13 @@ matrices (fft._dft_mats) — two MXU matmuls and a square-add, no complex
 dtype needed (the XLA TPU backend has neither FFT nor complex support)."""
 
 from . import functional  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import load, save, info  # noqa: F401
 from .features import (LogMelSpectrogram, MFCC, MelSpectrogram,  # noqa: F401
                        Spectrogram)
 
 __all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
+           "LogMelSpectrogram", "MFCC", "backends", "datasets", "load", "save", "info"]
 
 from . import features  # noqa: F401,E402

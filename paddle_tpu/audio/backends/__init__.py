@@ -1,0 +1,107 @@
+"""paddle_tpu.audio.backends — waveform IO (reference:
+python/paddle/audio/backends/ wave_backend.py + soundfile backend).
+
+The default backend is the stdlib ``wave`` module (16-bit PCM WAV);
+soundfile is used when installed."""
+
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["list_available_backends", "get_current_backend", "set_backend",
+           "load", "save", "info", "AudioInfo"]
+
+_BACKEND = "wave"
+
+
+def list_available_backends():
+    out = ["wave"]
+    try:
+        import soundfile  # noqa: F401
+        out.append("soundfile")
+    except ImportError:
+        pass
+    return out
+
+
+def get_current_backend():
+    return _BACKEND
+
+
+def set_backend(backend_name):
+    global _BACKEND
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable "
+            f"(have {list_available_backends()})")
+    _BACKEND = backend_name
+
+
+class AudioInfo:
+    """reference backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    """reference wave_backend.py info."""
+    if _BACKEND == "soundfile":
+        import soundfile as sf
+        i = sf.info(filepath)
+        return AudioInfo(i.samplerate, i.frames, i.channels, 16, i.subtype)
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8, "PCM_S")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Waveform tensor + sample rate (reference wave_backend.py load)."""
+    if _BACKEND == "soundfile":
+        import soundfile as sf
+        data, sr = sf.read(filepath, dtype="float32")
+        arr = data.T if data.ndim > 1 else data[None]
+    else:
+        with _wave.open(filepath, "rb") as f:
+            sr = f.getframerate()
+            n = f.getnframes()
+            ch = f.getnchannels()
+            width = f.getsampwidth()
+            raw = f.readframes(n)
+        dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+        arr = np.frombuffer(raw, dt).reshape(-1, ch).T.astype(np.float32)
+        if normalize:
+            arr = arr / float(2 ** (8 * width - 1))
+    if frame_offset:
+        arr = arr[:, frame_offset:]
+    if num_frames >= 0:
+        arr = arr[:, :num_frames]
+    if not channels_first:
+        arr = arr.T
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    """reference wave_backend.py save — 16-bit PCM WAV."""
+    arr = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if not channels_first:
+        arr = arr.T
+    pcm = np.clip(arr * (2 ** 15 - 1), -2 ** 15, 2 ** 15 - 1).astype(
+        np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(pcm.shape[0])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.T.tobytes())

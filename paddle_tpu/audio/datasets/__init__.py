@@ -1,0 +1,118 @@
+"""paddle_tpu.audio.datasets (reference: python/paddle/audio/datasets/ —
+AudioClassificationDataset base + ESC50 + TESS). Local-folder readers:
+this build has no network egress."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...io import Dataset
+from .. import features as _features
+from .. import backends as _backends
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+DATA_HOME = os.environ.get("PADDLE_TPU_DATA_HOME",
+                           os.path.expanduser("~/.cache/paddle_tpu"))
+
+
+class AudioClassificationDataset(Dataset):
+    """reference audio/datasets/dataset.py AudioClassificationDataset."""
+
+    _feat_types = ("raw", "melspectrogram", "mfcc", "logmelspectrogram",
+                   "spectrogram")
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        if feat_type not in self._feat_types:
+            raise ValueError(f"feat_type must be one of {self._feat_types}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+
+    def _convert_to_record(self, idx):
+        import paddle_tpu as p
+        waveform, sr = _backends.load(self.files[idx])
+        wav = waveform[0]  # mono
+        if self.feat_type == "raw":
+            feat = wav
+        else:
+            cls = {"melspectrogram": _features.MelSpectrogram,
+                   "logmelspectrogram": _features.LogMelSpectrogram,
+                   "mfcc": _features.MFCC,
+                   "spectrogram": _features.Spectrogram}[self.feat_type]
+            cfg = dict(self.feat_config)
+            if "sr" in cls.__init__.__code__.co_varnames:
+                cfg.setdefault("sr", sr)
+            feat = cls(**cfg)(wav.unsqueeze(0))[0]
+        return np.asarray(feat._value), np.int64(self.labels[idx])
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class _FolderAudioSet(AudioClassificationDataset):
+    NAME = ""
+    META = ""
+
+    def __init__(self, mode="train", feat_type="raw", archive=None,
+                 **kwargs):
+        root = os.path.join(DATA_HOME, self.NAME)
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"{type(self).__name__} not found at {root}; this build "
+                "has no network access — extract the dataset there")
+        files, labels = self._load_meta(root, mode)
+        super().__init__(files, labels, feat_type, **kwargs)
+
+
+class ESC50(_FolderAudioSet):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py) —
+    5-fold split from meta/esc50.csv."""
+
+    NAME = "esc50"
+
+    def _load_meta(self, root, mode):
+        import csv
+        meta = os.path.join(root, "meta", "esc50.csv")
+        files, labels = [], []
+        with open(meta) as f:
+            for row in csv.DictReader(f):
+                fold = int(row["fold"])
+                is_test = fold == 5
+                if (mode == "train") != is_test:
+                    files.append(os.path.join(root, "audio",
+                                              row["filename"]))
+                    labels.append(int(row["target"]))
+        return files, labels
+
+
+class TESS(_FolderAudioSet):
+    """TESS emotional speech (reference audio/datasets/tess.py) — labels
+    from the <who>_<word>_<emotion>.wav naming scheme."""
+
+    NAME = "tess"
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad"]
+
+    def _load_meta(self, root, mode):
+        files, labels = [], []
+        for dirpath, _, fnames in sorted(os.walk(root)):
+            for fn in sorted(fnames):
+                if not fn.lower().endswith(".wav"):
+                    continue
+                emotion = fn.rsplit("_", 1)[-1][:-4].lower()
+                if emotion in self.EMOTIONS:
+                    files.append(os.path.join(dirpath, fn))
+                    labels.append(self.EMOTIONS.index(emotion))
+        n_train = int(len(files) * 0.8)
+        if mode == "train":
+            return files[:n_train], labels[:n_train]
+        return files[n_train:], labels[n_train:]

@@ -78,7 +78,8 @@ def jacobian(ys, xs, batch_axis=None):
 def hessian(ys, xs, batch_axis=None):
     """Dense Hessian of a scalar ``ys`` w.r.t. ``xs`` (reference:
     autograd/autodiff.py hessian): one create_graph vjp, then a jacobian
-    of each first-order gradient."""
+    of each first-order gradient. For a list of inputs, returns the full
+    block matrix rows[i][j] = d²y / dx_i dx_j — cross blocks included."""
     xs_l = _flat_list(xs)
     single_x = not isinstance(xs, (list, tuple))
     if int(np.prod(ys.shape)) != 1:
@@ -86,13 +87,17 @@ def hessian(ys, xs, batch_axis=None):
     g1 = _ag.grad([ys], xs_l, create_graph=True, retain_graph=True,
                   allow_unused=True)
     rows = []
-    for g, x in zip(g1, xs_l):
+    for g, xi in zip(g1, xs_l):
         if g is None:
-            n = int(np.prod(x.shape))
-            rows.append(Tensor(jnp.zeros((n, n), x._value.dtype)))
+            n = int(np.prod(xi.shape))
+            rows.append([Tensor(jnp.zeros((n, int(np.prod(xj.shape))),
+                                          xi._value.dtype))
+                         for xj in xs_l])
         else:
-            rows.append(jacobian(g, x))
-    return rows[0] if single_x else rows
+            rows.append(jacobian(g, xs_l))
+    if single_x:
+        return rows[0][0]
+    return rows
 
 
 # ---- function-transform forms (incubate.autograd) ------------------------
@@ -163,17 +168,25 @@ class Jacobian:
     def _materialize(self):
         if self._mat is None:
             arrays = [x._value for x in self._xs]
-            jacs = jax.jacrev(self._wrap_single_out(),
-                              argnums=tuple(range(len(arrays))))(*arrays)
-            if not isinstance(jacs, (tuple, list)):
-                jacs = (jacs,)
             if self._is_batched:
+                # vmap over the leading batch axis so each sample's
+                # Jacobian is computed independently — no cross-batch
+                # zero blocks to slice out
+                jacs = jax.vmap(jax.jacrev(
+                    self._wrap_single_out(),
+                    argnums=tuple(range(len(arrays)))))(*arrays)
+                if not isinstance(jacs, (tuple, list)):
+                    jacs = (jacs,)
                 b = arrays[0].shape[0]
-                blocks = [j.reshape(b, -1,
-                                    int(np.prod(a.shape[1:])))
+                blocks = [j.reshape(b, -1, int(np.prod(a.shape[1:])))
                           for j, a in zip(jacs, arrays)]
                 self._mat = jnp.concatenate(blocks, axis=-1)
             else:
+                jacs = jax.jacrev(self._wrap_single_out(),
+                                  argnums=tuple(range(len(arrays))))(
+                    *arrays)
+                if not isinstance(jacs, (tuple, list)):
+                    jacs = (jacs,)
                 out_n = int(np.prod(jacs[0].shape)) // int(
                     np.prod(arrays[0].shape))
                 blocks = [j.reshape(out_n, -1) for j in jacs]

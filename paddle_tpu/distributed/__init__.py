@@ -53,3 +53,12 @@ __all__ = [
     "group_sharded_parallel", "save_group_sharded_model", "shard_layer",
     "shard_optimizer", "save_state_dict", "load_state_dict",
 ]
+
+from . import sharding  # noqa: E402,F401
+from . import passes  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+from .io_ns import save_persistables, load_persistables  # noqa: E402,F401
+import sys as _sys
+from . import io_ns as _io_ns
+_sys.modules[__name__ + ".io"] = _io_ns
+io = _io_ns

@@ -1,0 +1,30 @@
+"""paddle_tpu.distributed.io (reference: python/paddle/distributed/io.py
+— distributed persistables save/load)."""
+
+from __future__ import annotations
+
+__all__ = ["save_persistables", "load_persistables",
+           "is_persistable"]
+
+
+def is_persistable(var):
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference distributed/io.py save_persistables — saves every
+    persistable tensor the program references."""
+    import os
+    import paddle_tpu as p
+    os.makedirs(dirname, exist_ok=True)
+    ext = main_program.external_vars() if main_program is not None and \
+        callable(getattr(main_program, "external_vars", None)) else {}
+    state = {k: v for k, v in ext.items() if is_persistable(v)} or ext
+    p.save(state, os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    import os
+    import paddle_tpu as p
+    return p.load(os.path.join(dirname,
+                               filename or "persistables.pdparams"))

@@ -1,0 +1,67 @@
+"""paddle_tpu.distributed.passes (reference:
+python/paddle/distributed/passes/ — new_pass + auto-parallel program
+passes). On TPU the pass pipeline's work (amp casting, recompute,
+sharding insertion, gradient merge) runs at trace time inside
+DistTrainStep; these pass objects configure that path."""
+
+from __future__ import annotations
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+_PASS_REGISTRY = {
+    # name -> the trace-time mechanism that implements it
+    "auto_parallel_amp": "amp.auto_cast around the traced step",
+    "auto_parallel_fp16": "bf16 parameter storage + master weights",
+    "auto_parallel_recompute": "fleet.recompute / jax.checkpoint",
+    "auto_parallel_sharding": "ZeRO stages via fleet.sharding specs",
+    "auto_parallel_gradient_merge": "incubate GradientMergeOptimizer",
+    "auto_parallel_pipeline": "spmd_pipeline 1F1B schedule",
+    "fuse_optimizer": "XLA fuses the optimizer update automatically",
+    "fused_attention": "kernels.flash_attention Pallas kernel",
+    "fused_feedforward": "incubate.nn.functional.fused_feedforward",
+}
+
+
+class PassContext:
+    def __init__(self):
+        self.attrs = {}
+
+
+class _Pass:
+    def __init__(self, name, attrs=None):
+        self.name = name
+        self.attrs = attrs or {}
+        self.mechanism = _PASS_REGISTRY[name]
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        """Program surgery is a no-op here: the mechanism is applied at
+        trace time by DistTrainStep (see self.mechanism)."""
+        return context or PassContext()
+
+    def __repr__(self):
+        return f"Pass({self.name} -> {self.mechanism})"
+
+
+def new_pass(name, attrs=None):
+    """reference passes/pass_base.py new_pass."""
+    if name not in _PASS_REGISTRY:
+        raise ValueError(
+            f"unknown pass {name!r}; available: {sorted(_PASS_REGISTRY)}")
+    return _Pass(name, attrs)
+
+
+class PassManager:
+    """reference pass_base.py PassManager."""
+
+    def __init__(self, passes):
+        self._passes = list(passes)
+
+    def apply(self, main_programs, startup_programs=None):
+        ctx = PassContext()
+        for p in self._passes:
+            ctx = p.apply(main_programs, startup_programs, ctx)
+        return ctx
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]

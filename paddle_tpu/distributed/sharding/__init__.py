@@ -1,0 +1,45 @@
+"""paddle_tpu.distributed.sharding (reference:
+python/paddle/distributed/sharding/group_sharded.py
+group_sharded_parallel:33 / save_group_sharded_model:184)."""
+
+from __future__ import annotations
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """Wrap model+optimizer for ZeRO os/os_g/p_g_os (reference
+    group_sharded.py group_sharded_parallel). On TPU the stages map to
+    GSPMD shardings applied by the fleet wrappers."""
+    from ..fleet.sharding import (GroupShardedStage2, GroupShardedStage3,
+                                  DygraphShardingOptimizer)
+    if level == "os":
+        opt = DygraphShardingOptimizer(optimizer)
+        return model, opt, scaler
+    if level == "os_g":
+        wrapped = GroupShardedStage2(model, optimizer, group=group)
+        return wrapped, optimizer, scaler
+    if level == "p_g_os":
+        wrapped = GroupShardedStage3(model, optimizer, group=group,
+                                     segment_size=segment_size)
+        return wrapped, optimizer, scaler
+    raise ValueError("level must be one of 'os' | 'os_g' | 'p_g_os'")
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """reference group_sharded.py save_group_sharded_model — gathers the
+    sharded state and saves a full checkpoint."""
+    import os
+    import paddle_tpu as p
+    os.makedirs(output, exist_ok=True) if not os.path.splitext(output)[1] \
+        else None
+    base = output if os.path.splitext(output)[1] else os.path.join(
+        output, "model")
+    inner = getattr(model, "_layer", model)
+    p.save(inner.state_dict(), base + ".pdparams")
+    if optimizer is not None:
+        p.save(optimizer.state_dict(), base + ".pdopt")

@@ -1,0 +1,27 @@
+"""paddle_tpu.distributed.utils (reference:
+python/paddle/distributed/utils/ — log_utils, launch helpers)."""
+
+from __future__ import annotations
+
+__all__ = ["get_logger", "global_scatter", "global_gather"]
+
+
+def get_logger(level="INFO", name="paddle_tpu.distributed"):
+    from ..fleet import get_logger as _gl
+    return _gl(level, name)
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """MoE all-to-all dispatch (reference:
+    distributed/utils/moe_utils.py global_scatter → global_scatter op)."""
+    from ..fleet.moe import _dispatch_tokens  # noqa: F401
+    raise NotImplementedError(
+        "global_scatter: use distributed.fleet.moe.MoELayer — on TPU the "
+        "dispatch is a compiled all-to-all inside the traced step, not an "
+        "eager op")
+
+
+def global_gather(x, local_count, global_count, group=None):
+    raise NotImplementedError(
+        "global_gather: use distributed.fleet.moe.MoELayer (compiled "
+        "all-to-all combine)")

@@ -229,7 +229,12 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
                     else np.arange(lo, hi))
         if 0 <= sample_size < len(nbrs):
             p = w / w.sum() if w.sum() > 0 else None
-            idx = rng.choice(len(nbrs), size=sample_size, replace=False, p=p)
+            # without replacement only as many positive-weight neighbors
+            # can be drawn as exist — legal graphs with zero-weight edges
+            # must not abort the whole call
+            n_drawable = int((w > 0).sum()) if p is not None else len(nbrs)
+            size = min(sample_size, n_drawable)
+            idx = rng.choice(len(nbrs), size=size, replace=False, p=p)
             nbrs, edge_ids = nbrs[idx], edge_ids[idx]
         out_nbr.append(nbrs)
         out_cnt.append(len(nbrs))

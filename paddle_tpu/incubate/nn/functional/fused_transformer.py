@@ -33,6 +33,28 @@ def _maybe(x):
     return _t(x) if x is not None else None
 
 
+def _ln(h, scale, bias, eps):
+    """Shared fused-region layernorm epilogue."""
+    mean = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.var(h, axis=-1, keepdims=True)
+    out = (h - mean) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out.astype(h.dtype)
+
+
+def _dropout(h, key, p, mode):
+    """Shared fused-region dropout."""
+    if key is None or p == 0:
+        return h
+    keep = jax.random.bernoulli(key, 1.0 - p, h.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, h / (1.0 - p), 0.0).astype(h.dtype)
+    return jnp.where(keep, h, 0.0).astype(h.dtype)
+
+
 @defop("fused_matmul_bias")
 def _fused_matmul_bias(x, y, bias, transpose_x, transpose_y):
     if transpose_x:
@@ -77,12 +99,7 @@ def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
 
 @defop("fused_dropout_add_train")
 def _fda(x, y, key, p, mode):
-    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
-    if mode == "upscale_in_train":
-        xd = jnp.where(keep, x / (1.0 - p), 0.0)
-    else:
-        xd = jnp.where(keep, x, 0.0)
-    return xd.astype(x.dtype) + y
+    return _dropout(x, key, p, mode) + y
 
 
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
@@ -110,58 +127,27 @@ def fused_bias_dropout_residual_layer_norm(
 @defop("fused_bias_dropout_residual_ln")
 def _fbdrln(x, residual, bias, ln_scale, ln_bias, key, p, eps, mode):
     h = x if bias is None else x + bias
-    if key is not None and p > 0:
-        keep = jax.random.bernoulli(key, 1.0 - p, h.shape)
-        if mode == "upscale_in_train":
-            h = jnp.where(keep, h / (1.0 - p), 0.0).astype(x.dtype)
-        else:
-            h = jnp.where(keep, h, 0.0).astype(x.dtype)
-    h = h + residual
-    mean = jnp.mean(h, axis=-1, keepdims=True)
-    var = jnp.var(h, axis=-1, keepdims=True)
-    out = (h - mean) * jax.lax.rsqrt(var + eps)
-    if ln_scale is not None:
-        out = out * ln_scale
-    if ln_bias is not None:
-        out = out + ln_bias
-    return out.astype(x.dtype)
+    h = _dropout(h, key, p, mode) + residual
+    return _ln(h, ln_scale, ln_bias, eps)
 
 
 @defop("fused_feedforward")
 def _fffn(x, w1, w2, b1, b2, s1, bb1, s2, bb2, k1, k2, p1, p2, act,
           eps1, eps2, pre_ln, mode):
-    def ln(h, scale, bias, eps):
-        mean = jnp.mean(h, axis=-1, keepdims=True)
-        var = jnp.var(h, axis=-1, keepdims=True)
-        out = (h - mean) * jax.lax.rsqrt(var + eps)
-        if scale is not None:
-            out = out * scale
-        if bias is not None:
-            out = out + bias
-        return out.astype(h.dtype)
-
-    def drop(h, key, p):
-        if key is None or p == 0:
-            return h
-        keep = jax.random.bernoulli(key, 1.0 - p, h.shape)
-        if mode == "upscale_in_train":
-            return jnp.where(keep, h / (1.0 - p), 0.0).astype(h.dtype)
-        return jnp.where(keep, h, 0.0).astype(h.dtype)
-
     residual = x
     if pre_ln:
-        x = ln(x, s1, bb1, eps1)
+        x = _ln(x, s1, bb1, eps1)
     h = x @ w1
     if b1 is not None:
         h = h + b1
     h = jax.nn.relu(h) if act == "relu" else jax.nn.gelu(h)
-    h = drop(h, k1, p1)
+    h = _dropout(h, k1, p1, mode)
     h = h @ w2
     if b2 is not None:
         h = h + b2
-    h = residual + drop(h, k2, p2)
+    h = residual + _dropout(h, k2, p2, mode)
     if not pre_ln:
-        h = ln(h, s2, bb2, eps2)
+        h = _ln(h, s2, bb2, eps2)
     return h
 
 
@@ -192,27 +178,9 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
 def _fmha(x, qkv_w, lin_w, pls, plb, ls, lb, qkv_b, lin_b, mask,
           k_attn, k_out, p_attn, p_out, pre_ln, eps1, eps2,
           add_residual, mode):
-    def ln(h, scale, bias, eps):
-        mean = jnp.mean(h, axis=-1, keepdims=True)
-        var = jnp.var(h, axis=-1, keepdims=True)
-        out = (h - mean) * jax.lax.rsqrt(var + eps)
-        if scale is not None:
-            out = out * scale
-        if bias is not None:
-            out = out + bias
-        return out.astype(h.dtype)
-
-    def drop(h, key, p):
-        if key is None or p == 0:
-            return h
-        keep = jax.random.bernoulli(key, 1.0 - p, h.shape)
-        if mode == "upscale_in_train":
-            return jnp.where(keep, h / (1.0 - p), 0.0).astype(h.dtype)
-        return jnp.where(keep, h, 0.0).astype(h.dtype)
-
     residual = x
     if pre_ln:
-        x = ln(x, pls, plb, eps1)
+        x = _ln(x, pls, plb, eps1)
     b, s, e = x.shape
     three, h, hd, _ = qkv_w.shape
     qkv = jnp.einsum("bse,nhde->bsnhd", x, qkv_w)  # n=3
@@ -224,16 +192,16 @@ def _fmha(x, qkv_w, lin_w, pls, plb, ls, lb, qkv_b, lin_b, mask,
     if mask is not None:
         scores = scores + mask
     probs = jax.nn.softmax(scores, axis=-1)
-    probs = drop(probs, k_attn, p_attn)
+    probs = _dropout(probs, k_attn, p_attn, mode)
     ctx = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(b, s, h * hd)
     out = ctx @ lin_w
     if lin_b is not None:
         out = out + lin_b
-    out = drop(out, k_out, p_out)
+    out = _dropout(out, k_out, p_out, mode)
     if add_residual:
         out = residual + out
     if not pre_ln:
-        out = ln(out, ls, lb, eps2)
+        out = _ln(out, ls, lb, eps2)
     return out
 
 
@@ -300,10 +268,16 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     h = _t(x)
     n_layers = len(qkv_weights)
     for i in range(n_layers):
+        ln_s = ln_scales[i]
+        ln_b = ln_biases[i] if ln_biases else None
         h = fused_multi_head_attention(
             h, qkv_weights[i], linear_weights[i],
-            pre_layer_norm=pre_layer_norm, pre_ln_scale=ln_scales[i],
-            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            pre_layer_norm=pre_layer_norm,
+            pre_ln_scale=ln_s if pre_layer_norm else None,
+            pre_ln_bias=ln_b if pre_layer_norm else None,
+            ln_scale=None if pre_layer_norm else ln_s,
+            ln_bias=None if pre_layer_norm else ln_b,
+            pre_ln_epsilon=epsilon, ln_epsilon=epsilon,
             qkv_bias=qkv_biases[i] if qkv_biases else None,
             linear_bias=linear_biases[i] if linear_biases else None,
             attn_mask=attn_mask, dropout_rate=dropout_rate,
@@ -316,6 +290,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                 ffn_ln_biases[i] if ffn_ln_biases else None),
             ln2_scale=ffn_ln_scales[i], ln2_bias=(
                 ffn_ln_biases[i] if ffn_ln_biases else None),
+            ln1_epsilon=epsilon, ln2_epsilon=epsilon,
             dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
             activation=activation, pre_layer_norm=pre_layer_norm,
             training=training, mode=mode)
@@ -347,7 +322,8 @@ def fused_ec_moe(x, gate_weight, gate_bias, expert_weights1, expert_biases1,
 
 
 @defop("varlen_mem_efficient_attention")
-def _vma(q, k, v, seq_lens, kv_lens, mask, scale, causal):
+def _vma(q, k, v, seq_lens, kv_lens, mask, scale, causal,
+         pre_cache_length):
     b, h, s, d = q.shape
     t = k.shape[2]
     sc = scale if scale is not None else 1.0 / jnp.sqrt(
@@ -357,7 +333,9 @@ def _vma(q, k, v, seq_lens, kv_lens, mask, scale, causal):
     k_valid = jnp.arange(t)[None, :] < kv_lens.reshape(-1)[:, None]
     valid = q_valid[:, None, :, None] & k_valid[:, None, None, :]
     if causal:
-        valid = valid & (jnp.arange(s)[:, None]
+        # query position i sits at absolute position i + pre_cache_length:
+        # it may attend to every cached-prefix key plus keys up to itself
+        valid = valid & (jnp.arange(s)[:, None] + pre_cache_length
                          >= jnp.arange(t)[None, :])[None, None]
     if mask is not None:
         scores = scores + mask
@@ -372,8 +350,11 @@ def variable_length_memory_efficient_attention(
         causal=False, pre_cache_length=0):
     """Attention over per-sample valid lengths (reference:
     variable_length_memory_efficient_attention — cutlass kernel; here
-    length masks compose into the softmax and XLA fuses)."""
+    length masks compose into the softmax and XLA fuses).
+    pre_cache_length offsets the causal diagonal for prefix-cache
+    decoding."""
 
     return _vma(_t(query), _t(key), _t(value), _t(seq_lens),
                 _t(kv_seq_lens), _maybe(mask), scale=scale,
-                causal=bool(causal))
+                causal=bool(causal),
+                pre_cache_length=int(pre_cache_length))

@@ -65,8 +65,10 @@ def weight_norm(layer, name="weight", dim=0):
     recomputes the weight, so autograd flows to g and v."""
     from ..core.tensor import Parameter
     w = getattr(layer, name)
-    if dim is None:
-        dim = -1  # norm over all dims -> scalar g
+    if dim is not None:
+        dim = dim % w.ndim  # dim=-1 means the LAST axis, not the sentinel
+    else:
+        dim = -1  # internal sentinel: norm over all dims -> scalar g
     v = Parameter(w._value, trainable=True)
     if dim == -1:
         g0 = jnp.sqrt(jnp.sum(w._value * w._value))
@@ -155,6 +157,10 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
             u_ = m @ v_
             u_ = u_ / (jnp.linalg.norm(u_) + eps)
         state["u"] = u_
+        # derive v from the (possibly un-iterated) current u so
+        # n_power_iterations=0 uses the persisted vector like the reference
+        v_ = m.T @ u_
+        v_ = v_ / (jnp.linalg.norm(v_) + eps)
         sigma = jnp.dot(u_, m @ v_)
         from ..core.tensor import Tensor as _T
         w_sn = wv / float(sigma)

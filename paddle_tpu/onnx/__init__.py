@@ -1,0 +1,21 @@
+"""paddle_tpu.onnx (reference: python/paddle/onnx/export.py — thin
+delegation to paddle2onnx). TPU artifacts are StableHLO, which ONNX
+tooling cannot consume directly; export raises with the supported path
+unless paddle2onnx-compatible tooling is installed."""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """reference onnx/export.py export."""
+    try:
+        import paddle2onnx  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "ONNX export requires paddle2onnx, which is not installed in "
+            "this TPU build. The supported deployment artifact is "
+            "paddle.jit.save's StableHLO bundle (servable with "
+            "paddle.inference.create_predictor); convert to ONNX offline "
+            "from the StableHLO if needed.") from None

@@ -1,0 +1,124 @@
+"""paddle_tpu.reader — legacy decorator-based reader pipelines
+(reference: python/paddle/reader/decorator.py map_readers/buffered/
+compose/chain/shuffle/firstn/cache/xmap_readers)."""
+
+from __future__ import annotations
+
+import itertools
+import random as _random
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """reference decorator.py cache — memoize the full stream."""
+    all_data = []
+    filled = []
+
+    def impl():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        return iter(all_data)
+    return impl
+
+
+def map_readers(func, *readers):
+    def impl():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return impl
+
+
+def shuffle(reader, buf_size):
+    def impl():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return impl
+
+
+def chain(*readers):
+    def impl():
+        return itertools.chain(*[r() for r in readers])
+    return impl
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def impl():
+        iters = [r() for r in readers]
+        for items in (zip(*iters) if check_alignment
+                      else itertools.zip_longest(*iters)):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+    return impl
+
+
+def buffered(reader, size):
+    """reference decorator.py buffered — background-thread prefetch."""
+    import queue
+    import threading
+
+    def impl():
+        q = queue.Queue(maxsize=size)
+        end = object()
+
+        def fill():
+            for item in reader():
+                q.put(item)
+            q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            yield item
+    return impl
+
+
+def firstn(reader, n):
+    def impl():
+        return itertools.islice(reader(), n)
+    return impl
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """reference decorator.py xmap_readers — thread-pool map over the
+    stream."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def impl():
+        with ThreadPoolExecutor(process_num) as pool:
+            it = reader()
+            futures = []
+            for item in it:
+                futures.append(pool.submit(mapper, item))
+                if len(futures) >= buffer_size:
+                    yield futures.pop(0).result()
+            for f in futures:
+                yield f.result()
+    return impl
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Degenerates to chain(): fork-based readers deadlock under a live
+    TPU client (see io.DataLoader's same warning)."""
+    return chain(*readers)

@@ -23,7 +23,19 @@ from ..core.tensor import Tensor
 
 __all__ = ["Program", "program_guard", "default_main_program",
            "default_startup_program", "data", "Executor", "InputSpec",
-           "name_scope"]
+           "name_scope", "nn",
+           "BuildStrategy", "ExecutionStrategy", "CompiledProgram",
+           "IpuCompiledProgram", "IpuStrategy", "ExponentialMovingAverage",
+           "Print", "Variable", "WeightNormParamAttr", "accuracy", "auc",
+           "append_backward", "cpu_places", "cuda_places", "xpu_places",
+           "create_global_var", "ctr_metric_bundle",
+           "deserialize_persistables", "deserialize_program",
+           "device_guard", "global_scope", "gradients", "ipu_shard_guard",
+           "load", "load_from_file", "load_inference_model",
+           "load_program_state", "normalize_program", "py_func", "save",
+           "save_inference_model", "save_to_file", "scope_guard",
+           "serialize_persistables", "serialize_program", "set_ipu_shard",
+           "set_program_state", "create_parameter"]
 
 from ..jit.api import InputSpec  # noqa: E402,F401  (shared spec type)
 
@@ -246,3 +258,8 @@ class Executor:
                     env[oid] = o
             return [env[fid] for fid in fetch_ids]
         return pure
+
+
+from . import nn  # noqa: E402,F401
+from .compat import *  # noqa: E402,F401,F403
+from ..framework.core import create_parameter  # noqa: E402,F401

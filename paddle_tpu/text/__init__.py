@@ -197,3 +197,136 @@ class Imikolov(Dataset):
 
     def __len__(self):
         return len(self.data)
+
+
+class Movielens(Dataset):
+    """reference text/datasets/movielens.py — ML-1M ratings. Parses the
+    ratings.dat/movies.dat/users.dat '::'-separated format from an
+    extracted local directory."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        if data_file is None:
+            raise ValueError(
+                "no network egress in this build: pass data_file= pointing "
+                "at an extracted ml-1m directory")
+        import os
+        rng = np.random.RandomState(rand_seed)
+        users, movies = {}, {}
+        with open(os.path.join(data_file, "users.dat"),
+                  encoding="latin1") as f:
+            for line in f:
+                uid, gender, age, job, _ = line.strip().split("::")
+                users[int(uid)] = (0 if gender == "M" else 1, int(age),
+                                   int(job))
+        with open(os.path.join(data_file, "movies.dat"),
+                  encoding="latin1") as f:
+            for line in f:
+                mid, title, cats = line.strip().split("::")
+                movies[int(mid)] = (title, cats.split("|"))
+        self.records = []
+        with open(os.path.join(data_file, "ratings.dat"),
+                  encoding="latin1") as f:
+            for line in f:
+                uid, mid, rating, _ = line.strip().split("::")
+                uid, mid = int(uid), int(mid)
+                if uid in users and mid in movies:
+                    is_test = rng.rand() < test_ratio
+                    if (mode == "test") == is_test:
+                        self.records.append(
+                            (uid, *users[uid], mid, float(rating)))
+
+    def __getitem__(self, i):
+        return self.records[i]
+
+    def __len__(self):
+        return len(self.records)
+
+
+class _ParallelCorpus(Dataset):
+    """Shared WMT14/WMT16 shape: tokenized parallel src/trg with <s>,
+    <e>, <unk> (reference text/datasets/wmt14.py / wmt16.py)."""
+
+    def __init__(self, src_file=None, trg_file=None, src_dict_size=10000,
+                 trg_dict_size=10000, lang="en", mode="train"):
+        if src_file is None or trg_file is None:
+            raise ValueError(
+                "no network egress in this build: pass src_file=/trg_file= "
+                "pointing at local tokenized parallel text")
+        self.src_lines = [line.split() for line in
+                          open(src_file, encoding="utf8")]
+        self.trg_lines = [line.split() for line in
+                          open(trg_file, encoding="utf8")]
+        if len(self.src_lines) != len(self.trg_lines):
+            raise ValueError("src/trg line counts differ")
+        self.src_dict = self._build_dict(self.src_lines, src_dict_size)
+        self.trg_dict = self._build_dict(self.trg_lines, trg_dict_size)
+
+    @staticmethod
+    def _build_dict(lines, size):
+        from collections import Counter
+        cnt = Counter(w for line in lines for w in line)
+        vocab = ["<s>", "<e>", "<unk>"] + [w for w, _ in
+                                           cnt.most_common(size - 3)]
+        return {w: i for i, w in enumerate(vocab)}
+
+    def _ids(self, words, d):
+        unk = d["<unk>"]
+        return ([d["<s>"]] + [d.get(w, unk) for w in words] + [d["<e>"]])
+
+    def __getitem__(self, i):
+        src = self._ids(self.src_lines[i], self.src_dict)
+        trg = self._ids(self.trg_lines[i], self.trg_dict)
+        return (np.asarray(src, np.int64), np.asarray(trg[:-1], np.int64),
+                np.asarray(trg[1:], np.int64))
+
+    def __len__(self):
+        return len(self.src_lines)
+
+
+class WMT14(_ParallelCorpus):
+    """reference text/datasets/wmt14.py WMT14."""
+
+
+class WMT16(_ParallelCorpus):
+    """reference text/datasets/wmt16.py WMT16."""
+
+
+class Conll05st(Dataset):
+    """reference text/datasets/conll05.py Conll05st — SRL dataset; reads
+    the reference's preprocessed props/words format from local files."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train"):
+        if data_file is None:
+            raise ValueError(
+                "no network egress in this build: pass data_file= pointing "
+                "at local conll05st sentence/props files")
+        raise NotImplementedError(
+            "Conll05st requires the preprocessed SRL archives; provide "
+            "them locally and parse with the reference's layout")
+
+    def __getitem__(self, i):
+        raise IndexError
+
+    def __len__(self):
+        return 0
+
+
+__all__ += ["Movielens", "WMT14", "WMT16", "Conll05st"]
+
+
+# text.datasets namespace alias (reference: paddle.text.datasets.*)
+import types as _types
+
+datasets = _types.ModuleType("paddle_tpu.text.datasets")
+datasets.__doc__ = ("paddle_tpu.text.datasets (reference: "
+                    "python/paddle/text/datasets/).")
+for _n in ["UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT14", "WMT16",
+           "Conll05st"]:
+    setattr(datasets, _n, globals()[_n])
+datasets.__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT14",
+                    "WMT16", "Conll05st"]
+import sys as _sys
+
+_sys.modules["paddle_tpu.text.datasets"] = datasets

@@ -99,3 +99,143 @@ class Cifar10(_CifarBase):
 
 class Cifar100(_CifarBase):
     NAME = "cifar100"
+
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                   ".tiff", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """Generic class-per-subfolder dataset (reference:
+    vision/datasets/folder.py DatasetFolder): root/class_x/xxx.ext."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or _IMG_EXTENSIONS
+        classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        if not classes:
+            raise (RuntimeError if is_valid_file else
+                   FileNotFoundError)(f"no class folders found in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fname.lower().endswith(tuple(extensions)))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+        self.targets = [s[1] for s in self.samples]
+
+    @staticmethod
+    def _default_loader(path):
+        from . import image_load
+        img = image_load(path)
+        return np.asarray(img)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Unlabeled recursive image folder (reference:
+    vision/datasets/folder.py ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        extensions = extensions or _IMG_EXTENSIONS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference: vision/datasets/flowers.py). Needs
+    the archives on disk — this build has no network access."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        root = os.path.join(DATA_HOME, "flowers")
+        data_file = data_file or os.path.join(root, "102flowers.tgz")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"Flowers archives not found under {root}; this build has "
+                "no network access — place 102flowers.tgz, "
+                "imagelabels.mat and setid.mat there, or use FakeData")
+        raise NotImplementedError(
+            "Flowers archive parsing requires scipy.io.loadmat on the "
+            "downloaded files; supply extracted folders to DatasetFolder "
+            "instead")
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference: vision/datasets/voc2012.py).
+    Reads an extracted VOCdevkit tree from disk."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        root = data_file or os.path.join(DATA_HOME, "voc2012",
+                                         "VOCdevkit", "VOC2012")
+        seg_dir = os.path.join(root, "ImageSets", "Segmentation")
+        list_file = os.path.join(
+            seg_dir, {"train": "train.txt", "valid": "val.txt",
+                      "test": "val.txt"}.get(mode, "train.txt"))
+        if not os.path.exists(list_file):
+            raise FileNotFoundError(
+                f"VOC2012 not found at {root}; this build has no network "
+                "access — extract VOCtrainval there or use FakeData")
+        with open(list_file) as f:
+            ids = [line.strip() for line in f if line.strip()]
+        self.images = [os.path.join(root, "JPEGImages", f"{i}.jpg")
+                       for i in ids]
+        self.masks = [os.path.join(root, "SegmentationClass", f"{i}.png")
+                      for i in ids]
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        from . import image_load
+        img = np.asarray(image_load(self.images[idx]))
+        mask = np.asarray(image_load(self.masks[idx]))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.images)
+
+
+__all__ += ["DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]

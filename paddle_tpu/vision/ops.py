@@ -273,3 +273,15 @@ def box_coder(prior_box, prior_box_var, target_box,
     pv = _t(prior_box_var) if prior_box_var is not None else None
     return _box_coder(_t(prior_box), pv, _t(target_box),
                       code_type=code_type, normalized=box_normalized)
+
+
+from .ops_extra import (  # noqa: F401,E402
+    deform_conv2d, DeformConv2D, psroi_pool, PSRoIPool, RoIPool, RoIAlign,
+    prior_box, matrix_nms, generate_proposals, distribute_fpn_proposals,
+    yolo_box, yolo_loss, read_file, decode_jpeg,
+)
+
+__all__ += ["deform_conv2d", "DeformConv2D", "psroi_pool", "PSRoIPool",
+            "RoIPool", "RoIAlign", "prior_box", "matrix_nms",
+            "generate_proposals", "distribute_fpn_proposals", "yolo_box",
+            "yolo_loss", "read_file", "decode_jpeg"]

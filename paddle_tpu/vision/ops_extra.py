@@ -1,0 +1,731 @@
+"""Detection ops — the vision.ops tail (reference:
+python/paddle/vision/ops.py yolo_loss/yolo_box/prior_box/deform_conv2d/
+distribute_fpn_proposals/generate_proposals/psroi_pool/matrix_nms/
+read_file/decode_jpeg → phi detection kernels).
+
+TPU-native split: dense per-pixel math (deform_conv2d, yolo_box,
+yolo_loss, prior_box, psroi_pool) is pure-jnp under ``defop`` so it
+compiles onto the VPU/MXU; selection-shaped ops with data-dependent
+output sizes (generate_proposals, matrix_nms, distribute_fpn_proposals)
+run host-side like the reference CPU kernels."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import defop
+from ..core.tensor import Tensor
+
+__all__ = [
+    "deform_conv2d", "DeformConv2D", "psroi_pool", "PSRoIPool", "RoIPool",
+    "RoIAlign", "prior_box", "matrix_nms", "generate_proposals",
+    "distribute_fpn_proposals", "yolo_box", "yolo_loss", "read_file",
+    "decode_jpeg",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _maybe(x):
+    return _t(x) if x is not None else None
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+# ---- deformable convolution ---------------------------------------------
+
+@defop("deform_conv2d")
+def _deform_conv2d(x, offset, weight, bias, mask, stride, padding,
+                   dilation, deformable_groups, groups):
+    n, cin, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    hout = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    wout = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    k = kh * kw
+
+    # base sampling grid: for each output cell and kernel tap
+    oy = jnp.arange(hout) * sh - ph
+    ox = jnp.arange(wout) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # [Ho,1,kh,1]
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # [1,Wo,1,kw]
+    base_y = jnp.broadcast_to(base_y, (hout, wout, kh, kw))
+    base_x = jnp.broadcast_to(base_x, (hout, wout, kh, kw))
+
+    # offsets: [N, 2*dg*k, Ho, Wo] ordered (dg, k, {y,x}) like the
+    # reference kernel
+    off = offset.reshape(n, deformable_groups, k, 2, hout, wout)
+    off_y = jnp.transpose(off[:, :, :, 0], (0, 1, 3, 4, 2)).reshape(
+        n, deformable_groups, hout, wout, kh, kw)
+    off_x = jnp.transpose(off[:, :, :, 1], (0, 1, 3, 4, 2)).reshape(
+        n, deformable_groups, hout, wout, kh, kw)
+    py = base_y[None, None] + off_y  # [N, dg, Ho, Wo, kh, kw]
+    px = base_x[None, None] + off_x
+
+    # bilinear sample each input channel at its deformable group's taps
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy = py - y0
+    wx = px - x0
+
+    def gather(img_dg, yy, xx):
+        # img_dg: [N, dg, c_per_dg, H, W]; yy/xx: [N, dg, Ho, Wo, kh, kw]
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0)
+                 & (xx <= w - 1)).astype(x.dtype)
+        flat = img_dg.reshape(n, deformable_groups, -1, h * w)
+        idx = (yc * w + xc).reshape(n, deformable_groups, 1, -1)
+        out = jnp.take_along_axis(
+            flat, jnp.broadcast_to(idx, (n, deformable_groups,
+                                         flat.shape[2], idx.shape[-1])),
+            axis=-1)
+        out = out.reshape(n, deformable_groups, flat.shape[2], hout, wout,
+                          kh, kw)
+        return out * valid[:, :, None]
+
+    c_per_dg = cin // deformable_groups
+    img_dg = x.reshape(n, deformable_groups, c_per_dg, h, w)
+    v00 = gather(img_dg, y0, x0)
+    v01 = gather(img_dg, y0, x0 + 1)
+    v10 = gather(img_dg, y0 + 1, x0)
+    v11 = gather(img_dg, y0 + 1, x0 + 1)
+    wy_, wx_ = wy[:, :, None], wx[:, :, None]
+    sampled = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+               + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+    # [N, dg, c_per_dg, Ho, Wo, kh, kw] -> [N, Cin, Ho, Wo, kh, kw]
+    sampled = sampled.reshape(n, cin, hout, wout, kh, kw)
+    if mask is not None:
+        m = mask.reshape(n, deformable_groups, k, hout, wout)
+        m = jnp.transpose(m, (0, 1, 3, 4, 2)).reshape(
+            n, deformable_groups, hout, wout, kh, kw)
+        m = jnp.repeat(m, c_per_dg, axis=1)
+        sampled = sampled * m
+
+    # grouped correlation with the kernel
+    cg_in = cin // groups
+    cg_out = cout // groups
+    sampled_g = sampled.reshape(n, groups, cg_in, hout, wout, kh, kw)
+    weight_g = weight.reshape(groups, cg_out, cin_g, kh, kw)
+    out = jnp.einsum("ngihwyx,goiyx->ngohw", sampled_g, weight_g)
+    out = out.reshape(n, cout, hout, wout)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1 (mask=None) / v2 (with mask) (reference:
+    vision/ops.py deform_conv2d → phi deformable_conv kernel)."""
+    return _deform_conv2d(_t(x), _t(offset), _t(weight), _maybe(bias),
+                          _maybe(mask), stride=_pair(stride),
+                          padding=_pair(padding), dilation=_pair(dilation),
+                          deformable_groups=deformable_groups,
+                          groups=groups)
+
+
+class DeformConv2D:
+    """reference vision/ops.py DeformConv2D layer."""
+
+    def __new__(cls, *args, **kwargs):
+        # real Layer subclass built lazily to avoid import cycles
+        return _make_deform_layer()(*args, **kwargs)
+
+
+def _make_deform_layer():
+    from .. import nn
+    from ..nn import initializer as I
+    from ..core.tensor import Parameter
+
+    class _DeformConv2D(nn.Layer):
+        def __init__(self, in_channels, out_channels, kernel_size,
+                     stride=1, padding=0, dilation=1, deformable_groups=1,
+                     groups=1, weight_attr=None, bias_attr=None):
+            super().__init__()
+            kh, kw = _pair(kernel_size)
+            self._stride = _pair(stride)
+            self._padding = _pair(padding)
+            self._dilation = _pair(dilation)
+            self._deformable_groups = deformable_groups
+            self._groups = groups
+            self.weight = Parameter(I.XavierUniform()(
+                [out_channels, in_channels // groups, kh, kw], jnp.float32))
+            self.bias = (None if bias_attr is False
+                         else Parameter(jnp.zeros(out_channels,
+                                                  jnp.float32)))
+
+        def forward(self, x, offset, mask=None):
+            return deform_conv2d(x, offset, self.weight, self.bias,
+                                 self._stride, self._padding,
+                                 self._dilation, self._deformable_groups,
+                                 self._groups, mask)
+
+    return _DeformConv2D
+
+
+# ---- RoI layer wrappers --------------------------------------------------
+
+def _make_roi_layer(name, fn_name, extra=()):
+    from .. import nn
+
+    def __init__(self, output_size, spatial_scale=1.0, **kw):
+        nn.Layer.__init__(self)
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+        self._kw = kw
+
+    def forward(self, x, boxes, boxes_num):
+        from . import ops as _ops
+        fn = getattr(_ops, fn_name, None) or globals()[fn_name]
+        return fn(x, boxes, boxes_num, self._output_size,
+                  self._spatial_scale, **self._kw)
+
+    return type(name, (nn.Layer,), {"__init__": __init__,
+                                    "forward": forward,
+                                    "__doc__":
+                                    f"reference vision/ops.py {name}."})
+
+
+class RoIPool:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        return _make_roi_layer("RoIPool", "roi_pool")(
+            output_size, spatial_scale)
+
+
+class RoIAlign:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        return _make_roi_layer("RoIAlign", "roi_align")(
+            output_size, spatial_scale)
+
+
+class PSRoIPool:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        return _make_roi_layer("PSRoIPool", "psroi_pool")(
+            output_size, spatial_scale)
+
+
+@defop("psroi_pool")
+def _psroi_pool(x, boxes, output_size, spatial_scale, out_channels):
+    ph, pw = output_size
+    n_rois = boxes.shape[0]
+    _, c, h, w = x.shape
+
+    def one(roi):
+        x1 = roi[0] * spatial_scale
+        y1 = roi[1] * spatial_scale
+        x2 = roi[2] * spatial_scale
+        y2 = roi[3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / pw, rh / ph
+        outs = []
+        yy = jnp.arange(h)[:, None]
+        xx = jnp.arange(w)[None, :]
+        for iy in range(ph):
+            for ix in range(pw):
+                ys = y1 + iy * bin_h
+                xs = x1 + ix * bin_w
+                inside = ((yy >= jnp.floor(ys)) & (yy < jnp.ceil(ys + bin_h))
+                          & (xx >= jnp.floor(xs))
+                          & (xx < jnp.ceil(xs + bin_w)))
+                area = jnp.maximum(inside.sum(), 1)
+                # position-sensitive channel group for this bin
+                cidx = (iy * pw + ix)
+                chans = x[0, cidx * out_channels:(cidx + 1) * out_channels]
+                pooled = jnp.where(inside[None], chans, 0.0).sum(
+                    axis=(1, 2)) / area
+                outs.append(pooled)
+        out = jnp.stack(outs, axis=-1).reshape(out_channels, ph, pw)
+        return out
+
+    return jax.vmap(one)(boxes)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: vision/ops.py
+    psroi_pool — input channels = out_channels * ph * pw)."""
+    output_size = _pair(output_size)
+    ph, pw = output_size
+    c = _t(x).shape[1]
+    if c % (ph * pw) != 0:
+        raise ValueError("psroi_pool input channels must be divisible by "
+                         "output_size[0] * output_size[1]")
+    return _psroi_pool(_t(x), _t(boxes), output_size=output_size,
+                       spatial_scale=float(spatial_scale),
+                       out_channels=c // (ph * pw))
+
+
+# ---- SSD prior boxes -----------------------------------------------------
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes over the feature grid (reference: vision/ops.py
+    prior_box → phi prior_box kernel)."""
+    fh, fw = _t(input).shape[2:]
+    ih, iw = _t(image).shape[2:]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            boxes.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[ms_i]
+                boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[ms_i]
+                boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    num_priors = len(boxes)
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)
+    out = np.zeros((fh, fw, num_priors, 4), np.float32)
+    for i, (bw, bh) in enumerate(boxes):
+        out[:, :, i, 0] = (cxg - bw / 2.0) / iw
+        out[:, :, i, 1] = (cyg - bh / 2.0) / ih
+        out[:, :, i, 2] = (cxg + bw / 2.0) / iw
+        out[:, :, i, 3] = (cyg + bh / 2.0) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+# ---- NMS variants & proposals (host-side, dynamic shapes) ----------------
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS: soft decay by pairwise IoU (reference: vision/ops.py
+    matrix_nms → phi matrix_nms kernel; SOLOv2)."""
+    bb = np.asarray(_t(bboxes)._value)   # [N, M, 4]
+    sc = np.asarray(_t(scores)._value)   # [N, C, M]
+    n, c, m = sc.shape
+    all_out, all_idx, all_num = [], [], []
+    for b in range(n):
+        cand = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            keep = np.where(sc[b, cls] > score_threshold)[0]
+            for i in keep:
+                cand.append((sc[b, cls, i], cls, i))
+        cand.sort(reverse=True)
+        cand = cand[:nms_top_k]
+        if not cand:
+            all_out.append(np.zeros((0, 6), np.float32))
+            all_idx.append(np.zeros((0,), np.int64))
+            all_num.append(0)
+            continue
+        cls_arr = np.array([cc[1] for cc in cand])
+        idx_arr = np.array([cc[2] for cc in cand])
+        sc_arr = np.array([cc[0] for cc in cand], np.float32)
+        box_arr = bb[b][idx_arr]
+        # pairwise IoU among the sorted candidates
+        x1 = np.maximum(box_arr[:, None, 0], box_arr[None, :, 0])
+        y1 = np.maximum(box_arr[:, None, 1], box_arr[None, :, 1])
+        x2 = np.minimum(box_arr[:, None, 2], box_arr[None, :, 2])
+        y2 = np.minimum(box_arr[:, None, 3], box_arr[None, :, 3])
+        ext = 0.0 if normalized else 1.0
+        inter = np.clip(x2 - x1 + ext, 0, None) * np.clip(
+            y2 - y1 + ext, 0, None)
+        area = ((box_arr[:, 2] - box_arr[:, 0] + ext)
+                * (box_arr[:, 3] - box_arr[:, 1] + ext))
+        iou = inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                 1e-10)
+        same = cls_arr[:, None] == cls_arr[None, :]
+        iou = np.where(same, iou, 0.0)
+        iou = np.triu(iou, 1)  # decay only by higher-scored boxes
+        iou_cmax = iou.max(axis=0)
+        if use_gaussian:
+            decay = np.exp(-(iou ** 2 - iou_cmax[None, :] ** 2)
+                           / gaussian_sigma).min(axis=0)
+        else:
+            decay = ((1 - iou) / np.maximum(1 - iou_cmax[None, :],
+                                            1e-10)).min(axis=0)
+        dec_sc = sc_arr * decay
+        sel = np.where(dec_sc >= post_threshold)[0]
+        order = sel[np.argsort(-dec_sc[sel])][:keep_top_k]
+        out = np.concatenate([cls_arr[order, None].astype(np.float32),
+                              dec_sc[order, None], box_arr[order]], axis=1)
+        all_out.append(out.astype(np.float32))
+        all_idx.append((b * m + idx_arr[order]).astype(np.int64))
+        all_num.append(len(order))
+    out = Tensor(jnp.asarray(np.concatenate(all_out, axis=0)
+                             if all_out else np.zeros((0, 6), np.float32)))
+    rois_num = Tensor(jnp.asarray(np.asarray(all_num, np.int32)))
+    index = Tensor(jnp.asarray(np.concatenate(all_idx)
+                               if all_idx else np.zeros(0, np.int64)))
+    rets = [out]
+    if return_index:
+        rets.append(index)
+    if return_rois_num:
+        rets.append(rois_num)
+    return tuple(rets) if len(rets) > 1 else out
+
+
+def _decode_deltas(anchors, deltas, variances, pixel_offset=True):
+    off = 1.0 if pixel_offset else 0.0
+    aw = anchors[:, 2] - anchors[:, 0] + off
+    ah = anchors[:, 3] - anchors[:, 1] + off
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    dx, dy, dw, dh = [deltas[:, i] * variances[:, i] for i in range(4)]
+    cx = dx * aw + acx
+    cy = dy * ah + acy
+    w = np.exp(np.minimum(dw, 10.0)) * aw
+    h = np.exp(np.minimum(dh, 10.0)) * ah
+    return np.stack([cx - w / 2, cy - h / 2,
+                     cx + w / 2 - off, cy + h / 2 - off], axis=1)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference: vision/ops.py
+    generate_proposals → phi generate_proposals_v2 kernel): decode deltas
+    on anchors, clip, filter small, NMS per image."""
+    sc = np.asarray(_t(scores)._value)        # [N, A, H, W]
+    bd = np.asarray(_t(bbox_deltas)._value)   # [N, 4A, H, W]
+    ims = np.asarray(_t(img_size)._value)     # [N, 2]
+    an = np.asarray(_t(anchors)._value).reshape(-1, 4)
+    vr = np.asarray(_t(variances)._value).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    rois, roi_probs, rois_num = [], [], []
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)            # [H*W*A]
+        d = bd[b].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d = s[order], d[order]
+        anc, var = an[order], vr[order]
+        props = _decode_deltas(anc, d, var, pixel_offset)
+        ih, iw = float(ims[b][0]), float(ims[b][1])
+        off = 1.0 if pixel_offset else 0.0
+        props[:, 0] = np.clip(props[:, 0], 0, iw - off)
+        props[:, 1] = np.clip(props[:, 1], 0, ih - off)
+        props[:, 2] = np.clip(props[:, 2], 0, iw - off)
+        props[:, 3] = np.clip(props[:, 3], 0, ih - off)
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        keep = np.where((ws >= min_size) & (hs >= min_size))[0]
+        props, s = props[keep], s[keep]
+        # greedy NMS
+        order2 = np.argsort(-s)
+        selected = []
+        while len(order2) and len(selected) < post_nms_top_n:
+            i = order2[0]
+            selected.append(i)
+            if len(order2) == 1:
+                break
+            rest = order2[1:]
+            xx1 = np.maximum(props[i, 0], props[rest, 0])
+            yy1 = np.maximum(props[i, 1], props[rest, 1])
+            xx2 = np.minimum(props[i, 2], props[rest, 2])
+            yy2 = np.minimum(props[i, 3], props[rest, 3])
+            inter = np.clip(xx2 - xx1 + off, 0, None) * np.clip(
+                yy2 - yy1 + off, 0, None)
+            area_i = (props[i, 2] - props[i, 0] + off) * (
+                props[i, 3] - props[i, 1] + off)
+            area_r = (props[rest, 2] - props[rest, 0] + off) * (
+                props[rest, 3] - props[rest, 1] + off)
+            iou = inter / np.maximum(area_i + area_r - inter, 1e-10)
+            order2 = rest[iou <= nms_thresh]
+        rois.append(props[selected])
+        roi_probs.append(s[selected, None])
+        rois_num.append(len(selected))
+    rois_t = Tensor(jnp.asarray(np.concatenate(rois).astype(np.float32)))
+    probs_t = Tensor(jnp.asarray(
+        np.concatenate(roi_probs).astype(np.float32)))
+    if return_rois_num:
+        return rois_t, probs_t, Tensor(jnp.asarray(
+            np.asarray(rois_num, np.int32)))
+    return rois_t, probs_t
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by scale (reference: vision/ops.py
+    distribute_fpn_proposals)."""
+    rois = np.asarray(_t(fpn_rois)._value)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.clip((rois[:, 2] - rois[:, 0] + off), 0, None)
+                    * np.clip((rois[:, 3] - rois[:, 1] + off), 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, restore_parts = [], []
+    num_per_level = []
+    for level in range(min_level, max_level + 1):
+        idx = np.where(lvl == level)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        restore_parts.append(idx)
+        num_per_level.append(
+            Tensor(jnp.asarray(np.asarray([len(idx)], np.int32))))
+    concat_order = np.concatenate(restore_parts) if restore_parts else \
+        np.zeros(0, np.int64)
+    restore = np.argsort(concat_order)
+    restore_t = Tensor(jnp.asarray(restore.astype(np.int32)[:, None]))
+    if rois_num is not None:
+        return multi_rois, restore_t, num_per_level
+    return multi_rois, restore_t
+
+
+# ---- YOLO ----------------------------------------------------------------
+
+@defop("yolo_box")
+def _yolo_box(x, img_size, anchors, class_num, conf_thresh,
+              downsample_ratio, clip_bbox, scale_x_y, iou_aware,
+              iou_aware_factor):
+    n, c, h, w = x.shape
+    s = len(anchors) // 2
+    an = jnp.asarray(anchors, x.dtype).reshape(s, 2)
+    if iou_aware:
+        ioup = jax.nn.sigmoid(x[:, :s].reshape(n, s, 1, h, w))
+        x = x[:, s:]
+    x = x.reshape(n, s, 5 + class_num, h, w)
+    gx = (jnp.arange(w, dtype=x.dtype))[None, None, None, :]
+    gy = (jnp.arange(h, dtype=x.dtype))[None, None, :, None]
+    bx = (jax.nn.sigmoid(x[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) / 2 + gx) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) / 2 + gy) / h
+    input_w = downsample_ratio * w
+    input_h = downsample_ratio * h
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4:5])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) * ioup ** iou_aware_factor
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf
+    conf_mask = (conf > conf_thresh).astype(x.dtype)
+    imh = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    imw = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * conf_mask[:, :, 0, ...,
+                                                             None]
+    boxes = boxes.reshape(n, -1, 4)
+    scores = (probs * conf_mask).transpose(0, 1, 3, 4, 2).reshape(
+        n, -1, class_num)
+    return boxes, scores
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head outputs to boxes + scores (reference:
+    vision/ops.py yolo_box → phi yolo_box kernel)."""
+    return _yolo_box(_t(x), _t(img_size), anchors=tuple(anchors),
+                     class_num=int(class_num),
+                     conf_thresh=float(conf_thresh),
+                     downsample_ratio=int(downsample_ratio),
+                     clip_bbox=bool(clip_bbox), scale_x_y=float(scale_x_y),
+                     iou_aware=bool(iou_aware),
+                     iou_aware_factor=float(iou_aware_factor))
+
+
+@defop("yolo_loss")
+def _yolo_loss(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+               class_num, ignore_thresh, downsample_ratio,
+               use_label_smooth, scale_x_y):
+    n, c, h, w = x.shape
+    s = len(anchor_mask)
+    an_all = jnp.asarray(anchors, x.dtype).reshape(-1, 2)
+    an = an_all[jnp.asarray(anchor_mask)]
+    input_size = downsample_ratio * h
+    x = x.reshape(n, s, 5 + class_num, h, w)
+    pred_xy_logit = x[:, :, 0:2]
+    pred_wh = x[:, :, 2:4]
+    pred_obj_logit = x[:, :, 4]
+    pred_cls_logit = x[:, :, 5:]
+
+    # decoded predicted boxes (normalized) for the ignore mask
+    gx = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    px = (jax.nn.sigmoid(pred_xy_logit[:, :, 0]) + gx) / w
+    py = (jax.nn.sigmoid(pred_xy_logit[:, :, 1]) + gy) / h
+    pw = jnp.exp(pred_wh[:, :, 0]) * an[None, :, 0, None, None] / input_size
+    ph = jnp.exp(pred_wh[:, :, 1]) * an[None, :, 1, None, None] / input_size
+
+    b = gt_box.shape[1]
+    gtx, gty, gtw, gth = [gt_box[..., i] for i in range(4)]  # [N, B], norm
+
+    # best-anchor matching per gt over ALL anchors (shape-only IoU)
+    gw_abs = gtw[:, :, None] * input_size
+    gh_abs = gth[:, :, None] * input_size
+    inter = (jnp.minimum(gw_abs, an_all[None, None, :, 0])
+             * jnp.minimum(gh_abs, an_all[None, None, :, 1]))
+    union = (gw_abs * gh_abs
+             + an_all[None, None, :, 0] * an_all[None, None, :, 1] - inter)
+    an_iou = inter / jnp.maximum(union, 1e-10)
+    best_an = jnp.argmax(an_iou, axis=-1)                    # [N, B]
+
+    # responsibility mask on this scale's grid
+    gi = jnp.clip((gtx * w).astype(jnp.int32), 0, w - 1)     # [N, B]
+    gj = jnp.clip((gty * h).astype(jnp.int32), 0, h - 1)
+    valid = (gtw > 0) & (gth > 0)
+    mask_list = jnp.asarray(anchor_mask)
+    # for each gt: which local anchor slot (or -1)
+    local_slot = jnp.argmax(
+        (best_an[:, :, None] == mask_list[None, None, :]).astype(jnp.int32),
+        axis=-1)
+    has_slot = jnp.any(best_an[:, :, None] == mask_list[None, None, :],
+                       axis=-1) & valid
+
+    obj_target = jnp.zeros((n, s, h, w), x.dtype)
+    tx = jnp.zeros((n, s, h, w), x.dtype)
+    ty = jnp.zeros((n, s, h, w), x.dtype)
+    tw = jnp.zeros((n, s, h, w), x.dtype)
+    th = jnp.zeros((n, s, h, w), x.dtype)
+    tcls = jnp.zeros((n, s, class_num, h, w), x.dtype)
+    tscale = jnp.zeros((n, s, h, w), x.dtype)
+    bidx = jnp.repeat(jnp.arange(n)[:, None], b, 1)
+    sel = (bidx, local_slot, gj, gi)
+    gscore = gt_score if gt_score is not None else jnp.ones_like(gtx)
+    upd = jnp.where(has_slot, gscore, 0.0)
+    obj_target = obj_target.at[sel].max(upd)
+    tx = tx.at[sel].set(jnp.where(has_slot, gtx * w - gi, 0.0))
+    ty = ty.at[sel].set(jnp.where(has_slot, gty * h - gj, 0.0))
+    an_w = an[local_slot][..., 0] / input_size
+    an_h = an[local_slot][..., 1] / input_size
+    tw = tw.at[sel].set(jnp.where(
+        has_slot, jnp.log(jnp.maximum(gtw / jnp.maximum(an_w, 1e-9),
+                                      1e-9)), 0.0))
+    th = th.at[sel].set(jnp.where(
+        has_slot, jnp.log(jnp.maximum(gth / jnp.maximum(an_h, 1e-9),
+                                      1e-9)), 0.0))
+    tscale = tscale.at[sel].set(jnp.where(
+        has_slot, 2.0 - gtw * gth, 0.0))
+    cls_idx = jnp.clip(gt_label, 0, class_num - 1)
+    smooth_pos = (1.0 - 1.0 / class_num if use_label_smooth and
+                  class_num > 1 else 1.0)
+    tcls = tcls.at[(bidx, local_slot, cls_idx, gj, gi)].max(
+        jnp.where(has_slot, smooth_pos, 0.0))
+
+    # ignore mask: predicted boxes with IoU > thresh vs any gt
+    px1 = px - pw / 2
+    py1 = py - ph / 2
+    px2 = px + pw / 2
+    py2 = py + ph / 2
+    gx1 = (gtx - gtw / 2)[:, None, None, None, :]
+    gy1 = (gty - gth / 2)[:, None, None, None, :]
+    gx2 = (gtx + gtw / 2)[:, None, None, None, :]
+    gy2 = (gty + gth / 2)[:, None, None, None, :]
+    ix1 = jnp.maximum(px1[..., None], gx1)
+    iy1 = jnp.maximum(py1[..., None], gy1)
+    ix2 = jnp.minimum(px2[..., None], gx2)
+    iy2 = jnp.minimum(py2[..., None], gy2)
+    inter2 = jnp.clip(ix2 - ix1, 0, None) * jnp.clip(iy2 - iy1, 0, None)
+    area_p = (pw * ph)[..., None]
+    area_g = (gtw * gth)[:, None, None, None, :]
+    iou2 = inter2 / jnp.maximum(area_p + area_g - inter2, 1e-10)
+    iou2 = jnp.where(valid[:, None, None, None, :], iou2, 0.0)
+    best_iou = iou2.max(axis=-1)
+    noobj_mask = ((best_iou < ignore_thresh) & (obj_target <= 0)).astype(
+        x.dtype)
+
+    bce = lambda logit, target: jnp.maximum(logit, 0) - logit * target + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    obj_mask = (obj_target > 0).astype(x.dtype)
+    loss_xy = (tscale * obj_mask
+               * (bce(pred_xy_logit[:, :, 0], tx)
+                  + bce(pred_xy_logit[:, :, 1], ty))).sum(axis=(1, 2, 3))
+    loss_wh = (tscale * obj_mask
+               * (jnp.abs(pred_wh[:, :, 0] - tw)
+                  + jnp.abs(pred_wh[:, :, 1] - th))).sum(axis=(1, 2, 3))
+    loss_obj = (obj_target * bce(pred_obj_logit, jnp.ones_like(obj_target))
+                + noobj_mask * bce(pred_obj_logit,
+                                   jnp.zeros_like(obj_target))).sum(
+        axis=(1, 2, 3))
+    loss_cls = (obj_mask[:, :, None]
+                * bce(pred_cls_logit, tcls)).sum(axis=(1, 2, 3, 4))
+    return loss_xy + loss_wh + loss_obj + loss_cls
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference: vision/ops.py yolo_loss → phi
+    yolo_loss kernel): sigmoid-CE xy + L1 wh + objectness with
+    ignore-thresh + class BCE, per batch element."""
+    return _yolo_loss(_t(x), _t(gt_box), _v_int(gt_label),
+                      _maybe(gt_score), anchors=tuple(anchors),
+                      anchor_mask=tuple(anchor_mask),
+                      class_num=int(class_num),
+                      ignore_thresh=float(ignore_thresh),
+                      downsample_ratio=int(downsample_ratio),
+                      use_label_smooth=bool(use_label_smooth),
+                      scale_x_y=float(scale_x_y))
+
+
+def _v_int(x):
+    return Tensor(jnp.asarray(_t(x)._value.astype(jnp.int32)))
+
+
+# ---- file IO -------------------------------------------------------------
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference: vision/ops.py
+    read_file → CPU kernel)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference: vision/ops.py
+    decode_jpeg → nvjpeg kernel; PIL on host here)."""
+    import io
+    from ..utils.helpers import try_import
+    Image = try_import("PIL.Image", "decode_jpeg requires Pillow")
+    raw = bytes(np.asarray(_t(x)._value).astype(np.uint8).tobytes())
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -196,3 +196,20 @@ class Pad:
             if len(self.padding) == 2 else self.padding
         cfg = [(t, b), (l, r)] + [(0, 0)] * (img.ndim - 2)
         return np.pad(img, cfg, constant_values=self.fill)
+
+
+from .transforms_extra import (  # noqa: F401,E402
+    BaseTransform, hflip, vflip, crop, center_crop, pad, rotate, affine,
+    perspective, erase, to_grayscale, adjust_brightness, adjust_contrast,
+    adjust_saturation, adjust_hue, ColorJitter, ContrastTransform,
+    SaturationTransform, HueTransform, Grayscale, RandomAffine,
+    RandomErasing, RandomPerspective, RandomRotation,
+)
+
+__all__ += ["BaseTransform", "hflip", "vflip", "crop", "center_crop",
+            "pad", "rotate", "affine", "perspective", "erase",
+            "to_grayscale", "adjust_brightness", "adjust_contrast",
+            "adjust_saturation", "adjust_hue", "ColorJitter",
+            "ContrastTransform", "SaturationTransform", "HueTransform",
+            "Grayscale", "RandomAffine", "RandomErasing",
+            "RandomPerspective", "RandomRotation"]

@@ -1,0 +1,487 @@
+"""Vision transforms — geometric & photometric tail (reference:
+python/paddle/vision/transforms/functional.py hflip/vflip/crop/pad/
+rotate/affine/perspective/erase/adjust_*; transforms.py BaseTransform,
+ColorJitter, Grayscale, RandomAffine/Erasing/Perspective/Rotation).
+
+Host-side numpy HWC like the rest of the input pipeline (the reference's
+functional_cv2 path); geometric warps ride scipy.ndimage."""
+
+from __future__ import annotations
+
+import numbers
+import random as _pyrandom
+
+import numpy as np
+
+__all__ = [
+    "BaseTransform", "hflip", "vflip", "crop", "center_crop", "pad",
+    "rotate", "affine", "perspective", "erase", "to_grayscale",
+    "adjust_brightness", "adjust_contrast", "adjust_hue", "ColorJitter",
+    "ContrastTransform", "SaturationTransform", "HueTransform", "Grayscale",
+    "RandomAffine", "RandomErasing", "RandomPerspective", "RandomRotation",
+]
+
+
+def _np_img(img):
+    return np.asarray(img)
+
+
+def _max_val(img):
+    return 255.0 if np.asarray(img).max() > 1.5 else 1.0
+
+
+# ---- functional ----------------------------------------------------------
+
+def hflip(img):
+    """reference functional.py hflip."""
+    return _np_img(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _np_img(img)[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return _np_img(img)[top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    im = _np_img(img)
+    h, w = im.shape[:2]
+    th, tw = output_size
+    return crop(im, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    im = _np_img(img)
+    if isinstance(padding, int):
+        l = t = r = b = padding
+    elif len(padding) == 2:
+        l, t = padding
+        r, b = padding
+    else:
+        l, t, r, b = padding
+    cfg = [(t, b), (l, r)] + [(0, 0)] * (im.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    if mode == "constant":
+        return np.pad(im, cfg, mode, constant_values=fill)
+    return np.pad(im, cfg, mode)
+
+
+def _warp(img, inv_matrix, fill=0, interpolation="nearest"):
+    """Apply the inverse 3x3 homography with scipy map_coordinates."""
+    from scipy import ndimage
+    im = _np_img(img).astype(np.float32)
+    squeeze = im.ndim == 2
+    if squeeze:
+        im = im[:, :, None]
+    h, w, c = im.shape
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ones = np.ones_like(xx)
+    coords = np.stack([xx.ravel(), yy.ravel(), ones.ravel()])  # x,y,1
+    src = inv_matrix @ coords
+    denom = np.where(np.abs(src[2]) < 1e-9, 1e-9, src[2])
+    sx, sy = (src[0] / denom).reshape(h, w), (src[1] / denom).reshape(h, w)
+    # solver round-off can put boundary pixels a few ulp outside the image,
+    # which mode="constant" would fill; clamp within a tiny tolerance
+    eps = 1e-6
+    sx = np.where((sx > -eps) & (sx < 0), 0.0, sx)
+    sx = np.where((sx > w - 1) & (sx < w - 1 + eps), w - 1, sx)
+    sy = np.where((sy > -eps) & (sy < 0), 0.0, sy)
+    sy = np.where((sy > h - 1) & (sy < h - 1 + eps), h - 1, sy)
+    order = 1 if interpolation in ("bilinear", "linear") else 0
+    out = np.stack([
+        ndimage.map_coordinates(im[:, :, ch], [sy, sx], order=order,
+                                cval=fill, mode="constant")
+        for ch in range(c)], axis=-1)
+    return out[:, :, 0] if squeeze else out
+
+
+def _affine_inv_matrix(angle, translate, scale, shear, center):
+    cx, cy = center
+    rot = np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in (shear if isinstance(shear, (list,
+              tuple)) else (shear, 0.0))]
+    # forward matrix: T(center) R S Sh T(-center) T(translate)
+    a = np.cos(rot - sy) / max(np.cos(sy), 1e-9)
+    b = -np.cos(rot - sy) * np.tan(sx) / max(np.cos(sy), 1e-9) - np.sin(rot)
+    c = np.sin(rot - sy) / max(np.cos(sy), 1e-9)
+    d = -np.sin(rot - sy) * np.tan(sx) / max(np.cos(sy), 1e-9) + np.cos(rot)
+    m = np.array([[a * scale, b * scale,
+                   cx + translate[0] - (a * scale) * cx - (b * scale) * cy],
+                  [c * scale, d * scale,
+                   cy + translate[1] - (c * scale) * cx - (d * scale) * cy],
+                  [0, 0, 1.0]])
+    return np.linalg.inv(m)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """reference functional.py affine."""
+    im = _np_img(img)
+    h, w = im.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    inv = _affine_inv_matrix(angle, translate, scale, shear, center)
+    return _warp(im, inv, fill=fill, interpolation=interpolation)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """reference functional.py rotate (expand=True grows the canvas)."""
+    im = _np_img(img)
+    h, w = im.shape[:2]
+    if expand:
+        rad = np.deg2rad(angle)
+        nw = int(abs(w * np.cos(rad)) + abs(h * np.sin(rad)) + 0.5)
+        nh = int(abs(h * np.cos(rad)) + abs(w * np.sin(rad)) + 0.5)
+        padded = np.zeros((nh, nw) + im.shape[2:], im.dtype)
+        oy, ox = (nh - h) // 2, (nw - w) // 2
+        padded[oy:oy + h, ox:ox + w] = im
+        im, h, w = padded, nh, nw
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    inv = _affine_inv_matrix(angle, (0, 0), 1.0, (0, 0), center)
+    return _warp(im, inv, fill=fill, interpolation=interpolation)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Solve the 8-dof homography mapping endpoints -> startpoints."""
+    a = []
+    bvec = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        bvec += [sx, sy]
+    sol = np.linalg.lstsq(np.asarray(a, np.float64),
+                          np.asarray(bvec, np.float64), rcond=None)[0]
+    return np.array([[sol[0], sol[1], sol[2]],
+                     [sol[3], sol[4], sol[5]],
+                     [sol[6], sol[7], 1.0]])
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """reference functional.py perspective — warp startpoints quad onto
+    endpoints quad."""
+    inv = _perspective_coeffs(startpoints, endpoints)
+    return _warp(_np_img(img), inv, fill=fill, interpolation=interpolation)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """reference functional.py erase — fill a region with v. Accepts HWC
+    numpy or CHW tensors like the reference."""
+    from ..core.tensor import Tensor
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+        val = v._value if isinstance(v, Tensor) else v
+        new = img._value.at[..., i:i + h, j:j + w].set(val)
+        if inplace:
+            img._in_place_update(new)
+            return img
+        return Tensor(new)
+    im = _np_img(img)
+    out = im if inplace else im.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+_GRAY_W = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+def to_grayscale(img, num_output_channels=1):
+    """reference functional.py to_grayscale (ITU-R 601-2 luma)."""
+    im = _np_img(img).astype(np.float32)
+    if im.ndim == 2:
+        g = im
+    else:
+        g = im[..., :3] @ _GRAY_W
+    g = g[..., None]
+    if num_output_channels == 3:
+        g = np.repeat(g, 3, axis=-1)
+    return g.astype(_np_img(img).dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    """reference functional.py adjust_brightness."""
+    im = _np_img(img)
+    hi = _max_val(im)
+    return np.clip(im.astype(np.float32) * brightness_factor, 0,
+                   hi).astype(im.dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    im = _np_img(img)
+    hi = _max_val(im)
+    mean = to_grayscale(im).mean()
+    out = (im.astype(np.float32) - mean) * contrast_factor + mean
+    return np.clip(out, 0, hi).astype(im.dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    im = _np_img(img)
+    hi = _max_val(im)
+    gray = to_grayscale(im, num_output_channels=3).astype(np.float32)
+    out = im.astype(np.float32) * saturation_factor + \
+        gray * (1 - saturation_factor)
+    return np.clip(out, 0, hi).astype(im.dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """reference functional.py adjust_hue — shift H in HSV space by
+    hue_factor (in [-0.5, 0.5] turns)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    import colorsys
+    im = _np_img(img)
+    hi = _max_val(im)
+    x = im.astype(np.float32) / hi
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = np.max(x[..., :3], axis=-1)
+    minc = np.min(x[..., :3], axis=-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-9), 0.0)
+    dz = np.maximum(delta, 1e-9)
+    hr = np.where(maxc == r, (g - b) / dz % 6, 0.0)
+    hg = np.where(maxc == g, (b - r) / dz + 2, 0.0)
+    hb = np.where(maxc == b, (r - g) / dz + 4, 0.0)
+    hsel = np.where(maxc == r, hr, np.where(maxc == g, hg, hb)) / 6.0
+    hsel = (hsel + hue_factor) % 1.0
+    i = np.floor(hsel * 6.0)
+    f = hsel * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1) * hi
+    return np.clip(out, 0, hi).astype(im.dtype)
+
+
+# ---- transform classes ---------------------------------------------------
+
+class BaseTransform:
+    """reference transforms.py BaseTransform — keyed multi-input dispatch
+    (image/coords/boxes/mask) with _apply_* overrides."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+        self.params = None
+
+    def _get_params(self, inputs):
+        return None
+
+    def __call__(self, inputs):
+        single = not isinstance(inputs, (list, tuple))
+        data = (inputs,) if single else tuple(inputs)
+        self.params = self._get_params(data)
+        outputs = []
+        for key, d in zip(self.keys, data):
+            apply_fn = getattr(self, f"_apply_{key}", None)
+            outputs.append(apply_fn(d) if apply_fn else d)
+        outputs += list(data[len(self.keys):])
+        return outputs[0] if single else tuple(outputs)
+
+    def _apply_image(self, image):
+        return image
+
+
+class ContrastTransform(BaseTransform):
+    """reference transforms.py ContrastTransform."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, factor)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("saturation value must be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, factor)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, factor)
+
+
+class ColorJitter(BaseTransform):
+    """reference transforms.py ColorJitter — random-order brightness/
+    contrast/saturation/hue jitter."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.brightness, self.contrast = brightness, contrast
+        self.saturation, self.hue = saturation, hue
+
+    def _apply_image(self, img):
+        from .transforms import BrightnessTransform
+        ts = []
+        if self.brightness:
+            ts.append(BrightnessTransform(self.brightness))
+        if self.contrast:
+            ts.append(ContrastTransform(self.contrast))
+        if self.saturation:
+            ts.append(SaturationTransform(self.saturation))
+        if self.hue:
+            ts.append(HueTransform(self.hue))
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            img = t(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    """reference transforms.py RandomRotation."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    """reference transforms.py RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate, self.scale_rng, self.shear = translate, scale, shear
+        self.interpolation, self.fill, self.center = interpolation, fill, \
+            center
+
+    def _apply_image(self, img):
+        im = _np_img(img)
+        h, w = im.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0],
+                                   self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1],
+                                   self.translate[1]) * h
+        scale = (np.random.uniform(*self.scale_rng)
+                 if self.scale_rng is not None else 1.0)
+        shear = 0.0
+        if self.shear is not None:
+            sh = self.shear
+            if isinstance(sh, numbers.Number):
+                sh = (-sh, sh)
+            shear = np.random.uniform(sh[0], sh[1])
+        return affine(im, angle, (tx, ty), scale, shear,
+                      self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """reference transforms.py RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        im = _np_img(img)
+        h, w = im.shape[:2]
+        d = self.distortion_scale
+        hw, hh = int(w * d / 2), int(h * d / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, hw + 1),
+                np.random.randint(0, hh + 1)),
+               (w - 1 - np.random.randint(0, hw + 1),
+                np.random.randint(0, hh + 1)),
+               (w - 1 - np.random.randint(0, hw + 1),
+                h - 1 - np.random.randint(0, hh + 1)),
+               (np.random.randint(0, hw + 1),
+                h - 1 - np.random.randint(0, hh + 1))]
+        return perspective(im, start, end, self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """reference transforms.py RandomErasing."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        im = _np_img(img)
+        h, w = im.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            aspect = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                              np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * aspect)))
+            ew = int(round(np.sqrt(target / aspect)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                v = (np.random.standard_normal((eh, ew) + im.shape[2:])
+                     if self.value == "random" else self.value)
+                return erase(im, i, j, eh, ew, v, self.inplace)
+        return img

@@ -1,0 +1,231 @@
+"""Vision transforms/ops/datasets + static compat + namespace shims
+(reference: vision/transforms, vision/ops.py detection ops, static/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestTransformsExtra:
+    def test_flips_crops_pad(self):
+        import paddle_tpu.vision.transforms as T
+        img = (np.random.rand(16, 20, 3) * 255).astype(np.uint8)
+        np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(T.vflip(img), img[::-1])
+        assert T.crop(img, 2, 3, 10, 12).shape == (10, 12, 3)
+        assert T.center_crop(img, 8).shape == (8, 8, 3)
+        assert T.pad(img, 2).shape == (20, 24, 3)
+
+    def test_geometric_identity(self):
+        import paddle_tpu.vision.transforms as T
+        img = (np.random.rand(16, 20, 3) * 255).astype(np.uint8)
+        pts = [(0, 0), (19, 0), (19, 15), (0, 15)]
+        assert np.abs(T.perspective(img, pts, pts).astype(float)
+                      - img.astype(float)).mean() < 0.5
+        assert np.abs(T.affine(img, 0, (0, 0), 1.0, 0).astype(float)
+                      - img.astype(float)).mean() < 0.5
+        assert T.rotate(img, 45, expand=True).shape[0] > 16
+
+    def test_photometric(self):
+        import paddle_tpu.vision.transforms as T
+        img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        assert T.adjust_brightness(img, 1.5).mean() >= img.mean()
+        assert np.abs(T.adjust_hue(img, 0.0).astype(float)
+                      - img.astype(float)).max() <= 2.0
+        assert T.to_grayscale(img).shape == (8, 8, 1)
+        assert T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img).shape == img.shape
+        e = T.erase(img, 1, 2, 3, 4, 0)
+        assert (e[1:4, 2:6] == 0).all()
+
+    def test_random_classes(self):
+        import paddle_tpu.vision.transforms as T
+        img = (np.random.rand(16, 16, 3) * 255).astype(np.uint8)
+        for t in [T.RandomRotation(30),
+                  T.RandomAffine(10, translate=(0.1, 0.1)),
+                  T.RandomPerspective(prob=1.0),
+                  T.RandomErasing(prob=1.0), T.Grayscale(3)]:
+            assert t(img).shape[:2] == (16, 16)
+
+
+class TestVisionOpsExtra:
+    def test_deform_conv_zero_offsets_equals_conv(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.vision import ops as V
+        x = paddle.to_tensor(np.random.randn(1, 4, 8, 8).astype("float32"))
+        w = paddle.to_tensor(np.random.randn(6, 4, 3, 3).astype("float32"))
+        off = paddle.to_tensor(np.zeros((1, 18, 8, 8), "float32"))
+        out = V.deform_conv2d(x, off, w, padding=1)
+        ref = F.conv2d(x, w, padding=1)
+        np.testing.assert_allclose(_np(out), _np(ref), atol=1e-3)
+
+    def test_psroi_prior_matrixnms(self):
+        from paddle_tpu.vision import ops as V
+        xp = paddle.to_tensor(np.random.randn(1, 8, 16, 16).astype(
+            "float32"))
+        boxes = paddle.to_tensor(np.array([[0., 0., 8., 8.]], "float32"))
+        pool = V.psroi_pool(xp, boxes, paddle.to_tensor(np.array([1])), 2)
+        assert pool.shape == [1, 2, 2, 2]
+        feat = paddle.to_tensor(np.zeros((1, 3, 4, 4), "float32"))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), "float32"))
+        pb, pv = V.prior_box(feat, img, min_sizes=[8.], aspect_ratios=[2.],
+                             flip=True)
+        assert pb.shape[:2] == [4, 4] and pb.shape[3] == 4
+        bb = np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+                        [20, 20, 30, 30]]], "float32")
+        sc = np.array([[[0., 0., 0.], [0.9, 0.85, 0.8]]], "float32")
+        out, num = V.matrix_nms(paddle.to_tensor(bb), paddle.to_tensor(sc),
+                                0.1)
+        assert int(_np(num)[0]) >= 2
+
+    def test_yolo_box_and_loss(self):
+        from paddle_tpu.vision import ops as V
+        S, C = 3, 5
+        xin = paddle.to_tensor(
+            np.random.randn(1, S * (5 + C), 4, 4).astype("float32"),
+            stop_gradient=False)
+        boxes, scores = V.yolo_box(
+            xin.detach(), paddle.to_tensor(np.array([[128, 128]])),
+            [10, 13, 16, 30, 33, 23], C, 0.01, 32)
+        assert boxes.shape == [1, S * 16, 4]
+        gt_box = paddle.to_tensor(np.array(
+            [[[0.5, 0.5, 0.2, 0.3]]], "float32"))
+        gt_label = paddle.to_tensor(np.array([[1]]))
+        loss = V.yolo_loss(xin, gt_box, gt_label,
+                           [10, 13, 16, 30, 33, 23], [0, 1, 2], C, 0.7, 32)
+        assert np.isfinite(_np(loss)).all()
+        loss.sum().backward()
+        assert xin.grad is not None and np.isfinite(_np(xin.grad)).all()
+
+    def test_generate_and_distribute_proposals(self):
+        from paddle_tpu.vision import ops as V
+        an = np.random.rand(4 * 4 * 3, 4).astype("float32") * 8
+        an[:, 2:] += an[:, :2] + 4
+        rois, probs = V.generate_proposals(
+            paddle.to_tensor(np.random.rand(1, 3, 4, 4).astype("float32")),
+            paddle.to_tensor((np.random.randn(1, 12, 4, 4) * 0.1).astype(
+                "float32")),
+            paddle.to_tensor(np.array([[32., 32.]], "float32")),
+            paddle.to_tensor(an.reshape(4, 4, 3, 4)),
+            paddle.to_tensor(np.full((4, 4, 3, 4), 0.1, "float32")),
+            pre_nms_top_n=20, post_nms_top_n=5)
+        assert rois.shape[1] == 4 and rois.shape[0] <= 5
+        multi, restore = V.distribute_fpn_proposals(
+            paddle.to_tensor(np.array(
+                [[0, 0, 10, 10], [0, 0, 100, 100], [0, 0, 300, 300]],
+                "float32")), 2, 5, 4, 224)
+        assert len(multi) == 4
+
+
+class TestFolderDatasets:
+    def test_dataset_and_image_folder(self, tmp_path):
+        from PIL import Image
+        import paddle_tpu.vision.datasets as D
+        root = str(tmp_path)
+        for cls in ["cat", "dog"]:
+            os.makedirs(f"{root}/{cls}", exist_ok=True)
+            for i in range(2):
+                Image.fromarray((np.random.rand(8, 8, 3) * 255).astype(
+                    "uint8")).save(f"{root}/{cls}/{i}.png")
+        ds = D.DatasetFolder(root)
+        assert len(ds) == 4 and ds.classes == ["cat", "dog"]
+        img, lbl = ds[0]
+        assert img.shape == (8, 8, 3) and lbl == 0
+        assert len(D.ImageFolder(root)) == 4
+
+
+class TestAudioIO:
+    def test_wav_roundtrip_and_dataset(self, tmp_path):
+        import paddle_tpu.audio as A
+        sr = 8000
+        wav = np.sin(np.linspace(0, 100, 2000)).astype("float32")[None]
+        path = str(tmp_path / "t.wav")
+        A.save(path, paddle.to_tensor(wav), sr)
+        back, sr2 = A.load(path)
+        assert sr2 == sr
+        np.testing.assert_allclose(_np(back), wav, atol=1e-3)
+        assert A.info(path).num_channels == 1
+        from paddle_tpu.audio.datasets import AudioClassificationDataset
+        ds = AudioClassificationDataset([path], [3], feat_type="mfcc")
+        feat, lbl = ds[0]
+        assert feat.ndim == 2 and lbl == 3
+
+
+class TestTextDatasets:
+    def test_wmt_and_movielens(self, tmp_path):
+        import paddle_tpu.text.datasets as TD
+        src, trg = tmp_path / "s.txt", tmp_path / "t.txt"
+        src.write_text("hello world\nfoo bar\n")
+        trg.write_text("bonjour monde\nfu ba\n")
+        ds = TD.WMT16(src_file=str(src), trg_file=str(trg))
+        s, t_in, t_out = ds[0]
+        assert s[0] == 0 and s[-1] == 1 and len(ds) == 2
+        ml = tmp_path / "ml"
+        ml.mkdir()
+        (ml / "users.dat").write_text("1::M::25::4::z\n")
+        (ml / "movies.dat").write_text("10::A::Drama\n")
+        (ml / "ratings.dat").write_text("1::10::5::1\n")
+        m = TD.Movielens(data_file=str(ml), test_ratio=0.0)
+        assert len(m) == 1
+
+
+class TestStaticCompat:
+    def test_builders_and_ema(self):
+        import paddle_tpu.static as st
+        x = paddle.to_tensor(np.random.randn(2, 8).astype("float32"))
+        out = st.nn.fc(x, 4, activation="relu")
+        assert out.shape == [2, 4] and (_np(out) >= 0).all()
+        img = paddle.to_tensor(np.random.randn(1, 3, 8, 8).astype(
+            "float32"))
+        assert st.nn.conv2d(img, 6, 3).shape[1] == 6
+        w = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+        ema = st.ExponentialMovingAverage(0.9)
+        ema.update([w])
+        with paddle.no_grad():
+            w.fill_(5.0)
+        ema.update([w])
+        with ema.apply():
+            assert _np(w)[0] < 5.0
+        assert _np(w)[0] == 5.0
+
+    def test_control_flow_and_gradients(self):
+        import paddle_tpu.static as st
+        assert st.nn.cond(paddle.to_tensor(np.array(True)),
+                          lambda: 1, lambda: 2) == 1
+        xx = paddle.to_tensor(np.random.randn(3).astype("float32"),
+                              stop_gradient=False)
+        g = st.gradients((xx * xx).sum(), xx)
+        np.testing.assert_allclose(_np(g[0]), 2 * _np(xx), atol=1e-5)
+        out = st.nn.while_loop(
+            lambda v: paddle.to_tensor(np.array(v.item() < 3)),
+            lambda v: [paddle.to_tensor(np.array(v.item() + 1))],
+            [paddle.to_tensor(np.array(0))])
+        assert out[0].item() == 3
+
+
+class TestNamespaceShims:
+    def test_reader_decorators(self):
+        r = paddle.reader.shuffle(lambda: iter(range(10)), 4)
+        assert sorted(r()) == list(range(10))
+        c = paddle.reader.cache(lambda: iter(range(3)))
+        assert list(c()) == [0, 1, 2] and list(c()) == [0, 1, 2]
+
+    def test_distributed_namespaces(self):
+        import paddle_tpu.distributed as d
+        pm = d.passes.PassManager([d.passes.new_pass("auto_parallel_amp")])
+        assert pm.names == ["auto_parallel_amp"]
+        with pytest.raises(ValueError):
+            d.passes.new_pass("not_a_pass")
+        assert hasattr(d.sharding, "group_sharded_parallel")
+        import paddle_tpu.distributed.io as dio
+        assert hasattr(dio, "save_persistables")
+
+    def test_onnx_raises_helpfully(self):
+        with pytest.raises(NotImplementedError):
+            paddle.onnx.export(None, "/tmp/m")
