@@ -128,6 +128,35 @@ def set_cuda_rng_state(state):
     return set_rng_state(state)
 
 
+# remaining reference tensor methods living outside the op surface
+# (tensor/__init__.py lists them in tensor_method_func too)
+from .framework.core import (  # noqa: E402
+    is_complex as _isc, is_floating_point as _isf, is_integer as _isi,
+    create_parameter as _cp)
+from .signal import stft as _stft, istft as _istft  # noqa: E402
+from .ops.linalg import inverse as _inverse  # noqa: E402
+
+for _name, _fn in [("is_complex", _isc), ("is_floating_point", _isf),
+                   ("is_integer", _isi), ("create_parameter",
+                                          staticmethod(_cp)),
+                   ("stft", _stft), ("istft", _istft),
+                   ("inverse", _inverse)]:
+    if not hasattr(Tensor, _name):
+        setattr(Tensor, _name, _fn)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """reference tensor/creation.py create_tensor — an empty typed
+    tensor slot."""
+    import jax.numpy as _jnp
+    from .core.dtype import convert_dtype
+    t = Tensor(_jnp.zeros((), convert_dtype(dtype)), name=name)
+    t.persistable = persistable
+    return t
+
+
+Tensor.create_tensor = staticmethod(create_tensor)
+
 __version__ = "0.1.0"
 
 __all__ = (

@@ -81,6 +81,8 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
             raw = f.readframes(n)
         dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
         arr = np.frombuffer(raw, dt).reshape(-1, ch).T.astype(np.float32)
+        if width == 1:
+            arr = arr - 128.0  # 8-bit WAV is unsigned PCM centered at 128
         if normalize:
             arr = arr / float(2 ** (8 * width - 1))
     if frame_offset:

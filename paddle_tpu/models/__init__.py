@@ -5,6 +5,12 @@ paddle_tpu.vision.models."""
 
 from .llama import LlamaConfig, LlamaForCausalLM, llama_loss_fn, LLAMA_PRESETS  # noqa: F401
 from .gpt import GPTConfig, GPTForCausalLM, GPT_PRESETS  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForMaskedLM, BertForSequenceClassification,
+    BERT_PRESETS,
+)
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "llama_loss_fn",
-           "LLAMA_PRESETS", "GPTConfig", "GPTForCausalLM", "GPT_PRESETS"]
+           "LLAMA_PRESETS", "GPTConfig", "GPTForCausalLM", "GPT_PRESETS", "BertConfig", "BertModel",
+           "BertForMaskedLM", "BertForSequenceClassification",
+           "BERT_PRESETS"]

@@ -1,0 +1,140 @@
+"""BERT / ERNIE-style bidirectional encoder (reference: PaddleNLP
+bert/ernie modeling — the encoder family the reference ecosystem's SFT
+recipes start from; in-tree anchor: python/paddle/nn/layer/transformer.py
+TransformerEncoder).
+
+TPU-native: built from the framework's own nn layers — every encoder
+layer is dense matmuls XLA fuses; the MLM head reuses the embedding
+matrix transpose when tied."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+
+__all__ = ["BertConfig", "BertModel", "BertForMaskedLM",
+           "BertForSequenceClassification", "BERT_PRESETS"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+
+
+BERT_PRESETS = {
+    "bert-base": dict(),
+    "bert-large": dict(hidden_size=1024, num_hidden_layers=24,
+                       num_attention_heads=16, intermediate_size=4096),
+    "debug": dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                  num_attention_heads=4, intermediate_size=128,
+                  max_position_embeddings=64),
+}
+
+
+class _BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position = nn.Embedding(cfg.max_position_embeddings,
+                                     cfg.hidden_size)
+        self.token_type = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size)
+        self.ln = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        b, s = input_ids.shape
+        pos = Tensor(jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32),
+                                      (b, s)))
+        if token_type_ids is None:
+            # reference defaults to all-zeros segment ids — row 0 of the
+            # token_type table is always added (and trained)
+            token_type_ids = Tensor(jnp.zeros((b, s), jnp.int32))
+        x = (self.word(input_ids) + self.position(pos)
+             + self.token_type(token_type_ids))
+        return self.dropout(self.ln(x))
+
+
+class BertModel(nn.Layer):
+    """Encoder trunk: embeddings + TransformerEncoder + pooler."""
+
+    def __init__(self, config: BertConfig | str = "bert-base"):
+        super().__init__()
+        if isinstance(config, str):
+            config = BertConfig(**BERT_PRESETS[config])
+        self.config = cfg = config
+        self.embeddings = _BertEmbeddings(cfg)
+        layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads,
+            cfg.intermediate_size, dropout=cfg.hidden_dropout_prob,
+            activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            act_dropout=0.0, normalize_before=False)
+        self.encoder = nn.TransformerEncoder(layer, cfg.num_hidden_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.pool_act = nn.Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            # [b, s] 1/0 -> additive [b, 1, 1, s]
+            m = attention_mask._value.astype(jnp.float32)
+            attention_mask = Tensor((1.0 - m)[:, None, None, :] * -1e4)
+        seq = self.encoder(x, src_mask=attention_mask)
+        pooled = self.pool_act(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class BertForMaskedLM(nn.Layer):
+    """MLM head over the trunk; decoder weight tied to the word
+    embedding."""
+
+    def __init__(self, config: BertConfig | str = "bert-base"):
+        super().__init__()
+        self.bert = BertModel(config)
+        cfg = self.bert.config
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.act = (nn.GELU() if cfg.hidden_act == "gelu" else nn.ReLU())
+        self.ln = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.decoder_bias = self.create_parameter(
+            shape=[cfg.vocab_size], is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.ln(self.act(self.transform(seq)))
+        from ..ops.manipulation import transpose
+        w = self.bert.embeddings.word.weight  # [V, D] — tied decoder
+        # graph-preserving transpose: gradients flow back into the
+        # embedding table through the logits projection
+        logits = h @ transpose(w, [1, 0]) + self.decoder_bias
+        return logits
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig | str = "bert-base",
+                 num_classes=2, dropout=None):
+        super().__init__()
+        self.bert = BertModel(config)
+        cfg = self.bert.config
+        self.dropout = nn.Dropout(dropout if dropout is not None
+                                  else cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))

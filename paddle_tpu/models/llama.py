@@ -49,6 +49,9 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 500000.0
     tie_word_embeddings: bool = False
+    # Qwen2/ERNIE-style additive QKV biases (reference: PaddleNLP qwen2
+    # modeling — same decoder with attention_bias=True)
+    attention_bias: bool = False
     recompute: bool = False
     # reference recompute_granularity (fleet/meta_parallel recompute):
     # "full" remats the whole layer; "core_attn" saves the projection /
@@ -96,6 +99,21 @@ LLAMA_PRESETS = {
                      num_hidden_layers=4, num_attention_heads=8,
                      num_key_value_heads=4, num_experts=4,
                      num_experts_per_tok=2, max_position_embeddings=2048),
+    # BASELINE config 4 anchor: Qwen2 = llama decoder + QKV biases
+    "qwen2-7b": dict(vocab_size=152064, hidden_size=3584,
+                     intermediate_size=18944, num_hidden_layers=28,
+                     num_attention_heads=28, num_key_value_heads=4,
+                     rope_theta=1000000.0, attention_bias=True),
+    "qwen2-0.5b": dict(vocab_size=151936, hidden_size=896,
+                       intermediate_size=4864, num_hidden_layers=24,
+                       num_attention_heads=14, num_key_value_heads=2,
+                       rope_theta=1000000.0, attention_bias=True,
+                       tie_word_embeddings=True),
+    "qwen2-debug": dict(vocab_size=128, hidden_size=64,
+                        intermediate_size=172, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        max_position_embeddings=256, attention_bias=True,
+                        tie_word_embeddings=True),
 }
 
 
@@ -154,9 +172,16 @@ def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint):
 
     # attention block
     y = _rms(x, lp["input_ln"], cfg.rms_norm_eps)
-    q = checkpoint_name(y @ lp["wq"], "qkv").reshape(b, s, h, hd)
-    k = checkpoint_name(y @ lp["wk"], "qkv").reshape(b, s, kvh, hd)
-    v = checkpoint_name(y @ lp["wv"], "qkv").reshape(b, s, kvh, hd)
+    q = y @ lp["wq"]
+    k = y @ lp["wk"]
+    v = y @ lp["wv"]
+    if "bq" in lp:  # Qwen2-style attention biases
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = checkpoint_name(q, "qkv").reshape(b, s, h, hd)
+    k = checkpoint_name(k, "qkv").reshape(b, s, kvh, hd)
+    v = checkpoint_name(v, "qkv").reshape(b, s, kvh, hd)
     # K/V stay sep-sharded: ring/all-to-all attention (distributed.sep)
     # consumes them in place of the allgather the reference would issue
     q = hint(_rope(q, positions, cfg.rope_theta, hd), "dp", "sep", "mp", None)
@@ -328,6 +353,10 @@ class LlamaForCausalLM(nn.Layer):
         mk("wk", [L, d, kvh * hd], ("pp", None, "mp"))
         mk("wv", [L, d, kvh * hd], ("pp", None, "mp"))
         mk("wo", [L, h * hd, d], ("pp", "mp", None))
+        if cfg.attention_bias:
+            mk("bq", [L, h * hd], ("pp", "mp"), std=0.0)
+            mk("bk", [L, kvh * hd], ("pp", "mp"), std=0.0)
+            mk("bv", [L, kvh * hd], ("pp", "mp"), std=0.0)
         mk("input_ln", [L, d], ("pp", None), ones=True)
         mk("post_ln", [L, d], ("pp", None), ones=True)
         if cfg.num_experts > 0:
@@ -348,6 +377,8 @@ class LlamaForCausalLM(nn.Layer):
 
     def _stacked_names(self):
         base = ["wq", "wk", "wv", "wo", "input_ln", "post_ln"]
+        if self.config.attention_bias:
+            base = base + ["bq", "bk", "bv"]
         if self.config.num_experts > 0:
             return base + ["router", "we_gate", "we_up", "we_down"]
         return base + ["w_gate", "w_up", "w_down"]

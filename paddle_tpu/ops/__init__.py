@@ -96,6 +96,16 @@ def _install_tensor_methods():
     for name, fn in _INPLACE_NS.items():
         if not hasattr(Tensor, name):
             setattr(Tensor, name, fn)
+    # full reference tensor-method surface: attach every op the reference
+    # lists in tensor/__init__.py tensor_method_func that we have
+    # (python/paddle/tensor/__init__.py monkey-patches the same way)
+    import pathlib as _pl
+    _ref_list = _pl.Path(__file__).with_name("tensor_methods.txt")
+    if _ref_list.exists():
+        for name in _ref_list.read_text().split():
+            fn = _NS.get(name)
+            if fn is not None and not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
     for name in _METHOD_NAMES:
         fn = _NS.get(name)
         if fn is None:

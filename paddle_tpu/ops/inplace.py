@@ -98,6 +98,8 @@ _INPLACE_OF = {
     "bitwise_and": "bitwise_and_", "bitwise_not": "bitwise_not_",
     "bitwise_or": "bitwise_or_", "bitwise_xor": "bitwise_xor_",
     "addmm": "addmm_", "polygamma": "polygamma_",
+    "acosh": "acosh_", "asinh": "asinh_", "atanh": "atanh_",
+    "erfinv": "erfinv_",
 }
 
 

@@ -74,8 +74,13 @@ class Adam(Optimizer):
             self._init_slot("moment2_max", like_master=True)
         if self._multi_precision:
             if "master_weight" not in self._accumulators:
+                # copy=True: astype on an fp32 param is a no-op returning
+                # the SAME buffer, and a master aliasing its param breaks
+                # donation in compiled train steps ("donate same buffer
+                # twice")
                 self._accumulators["master_weight"] = [
-                    p._value.astype(jnp.float32) for p in self._parameter_list]
+                    jnp.array(p._value, dtype=jnp.float32, copy=True)
+                    for p in self._parameter_list]
 
     def _decayed_grad(self, p, g):
         return self._apply_weight_decay(p, g)

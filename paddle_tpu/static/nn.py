@@ -177,9 +177,10 @@ def cond(pred, true_fn=None, false_fn=None, name=None,
 def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
     """reference control_flow.py while_loop — eager python loop."""
     vars_ = list(loop_vars)
-    while bool(np.asarray(cond_fn(*vars_)._value
-                          if isinstance(cond_fn(*vars_), Tensor)
-                          else cond_fn(*vars_))):
+    while True:
+        c = cond_fn(*vars_)
+        if not bool(np.asarray(c._value if isinstance(c, Tensor) else c)):
+            break
         out = body(*vars_)
         vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
     return vars_

@@ -217,12 +217,12 @@ class PSRoIPool:
 
 
 @defop("psroi_pool")
-def _psroi_pool(x, boxes, output_size, spatial_scale, out_channels):
+def _psroi_pool(x, boxes, img_idx, output_size, spatial_scale,
+                out_channels):
     ph, pw = output_size
-    n_rois = boxes.shape[0]
     _, c, h, w = x.shape
 
-    def one(roi):
+    def one(roi, bi):
         x1 = roi[0] * spatial_scale
         y1 = roi[1] * spatial_scale
         x2 = roi[2] * spatial_scale
@@ -230,6 +230,7 @@ def _psroi_pool(x, boxes, output_size, spatial_scale, out_channels):
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bin_w, bin_h = rw / pw, rh / ph
+        feat = x[bi]  # this RoI's image (boxes_num routing)
         outs = []
         yy = jnp.arange(h)[:, None]
         xx = jnp.arange(w)[None, :]
@@ -243,27 +244,31 @@ def _psroi_pool(x, boxes, output_size, spatial_scale, out_channels):
                 area = jnp.maximum(inside.sum(), 1)
                 # position-sensitive channel group for this bin
                 cidx = (iy * pw + ix)
-                chans = x[0, cidx * out_channels:(cidx + 1) * out_channels]
+                chans = feat[cidx * out_channels:(cidx + 1) * out_channels]
                 pooled = jnp.where(inside[None], chans, 0.0).sum(
                     axis=(1, 2)) / area
                 outs.append(pooled)
         out = jnp.stack(outs, axis=-1).reshape(out_channels, ph, pw)
         return out
 
-    return jax.vmap(one)(boxes)
+    return jax.vmap(one)(boxes, img_idx)
 
 
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                name=None):
     """Position-sensitive RoI pooling (reference: vision/ops.py
-    psroi_pool — input channels = out_channels * ph * pw)."""
+    psroi_pool — input channels = out_channels * ph * pw; boxes_num maps
+    each RoI to its batch image)."""
     output_size = _pair(output_size)
     ph, pw = output_size
     c = _t(x).shape[1]
     if c % (ph * pw) != 0:
         raise ValueError("psroi_pool input channels must be divisible by "
                          "output_size[0] * output_size[1]")
-    return _psroi_pool(_t(x), _t(boxes), output_size=output_size,
+    counts = np.asarray(_t(boxes_num)._value).astype(np.int64)
+    img_idx = Tensor(jnp.asarray(
+        np.repeat(np.arange(len(counts)), counts).astype(np.int32)))
+    return _psroi_pool(_t(x), _t(boxes), img_idx, output_size=output_size,
                        spatial_scale=float(spatial_scale),
                        out_channels=c // (ph * pw))
 
@@ -619,27 +624,27 @@ def _yolo_loss(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
     tcls = jnp.zeros((n, s, class_num, h, w), x.dtype)
     tscale = jnp.zeros((n, s, h, w), x.dtype)
     bidx = jnp.repeat(jnp.arange(n)[:, None], b, 1)
-    sel = (bidx, local_slot, gj, gi)
+    # invalid/padded gts scatter to row h — out of bounds, which jax
+    # silently DROPS, so they can never clobber a real gt sharing
+    # (slot 0, cell 0, 0)
+    gj_sel = jnp.where(has_slot, gj, h)
+    sel = (bidx, local_slot, gj_sel, gi)
     gscore = gt_score if gt_score is not None else jnp.ones_like(gtx)
-    upd = jnp.where(has_slot, gscore, 0.0)
-    obj_target = obj_target.at[sel].max(upd)
-    tx = tx.at[sel].set(jnp.where(has_slot, gtx * w - gi, 0.0))
-    ty = ty.at[sel].set(jnp.where(has_slot, gty * h - gj, 0.0))
+    obj_target = obj_target.at[sel].max(gscore, mode="drop")
+    tx = tx.at[sel].set(gtx * w - gi, mode="drop")
+    ty = ty.at[sel].set(gty * h - gj, mode="drop")
     an_w = an[local_slot][..., 0] / input_size
     an_h = an[local_slot][..., 1] / input_size
-    tw = tw.at[sel].set(jnp.where(
-        has_slot, jnp.log(jnp.maximum(gtw / jnp.maximum(an_w, 1e-9),
-                                      1e-9)), 0.0))
-    th = th.at[sel].set(jnp.where(
-        has_slot, jnp.log(jnp.maximum(gth / jnp.maximum(an_h, 1e-9),
-                                      1e-9)), 0.0))
-    tscale = tscale.at[sel].set(jnp.where(
-        has_slot, 2.0 - gtw * gth, 0.0))
+    tw = tw.at[sel].set(jnp.log(jnp.maximum(
+        gtw / jnp.maximum(an_w, 1e-9), 1e-9)), mode="drop")
+    th = th.at[sel].set(jnp.log(jnp.maximum(
+        gth / jnp.maximum(an_h, 1e-9), 1e-9)), mode="drop")
+    tscale = tscale.at[sel].set(2.0 - gtw * gth, mode="drop")
     cls_idx = jnp.clip(gt_label, 0, class_num - 1)
     smooth_pos = (1.0 - 1.0 / class_num if use_label_smooth and
                   class_num > 1 else 1.0)
-    tcls = tcls.at[(bidx, local_slot, cls_idx, gj, gi)].max(
-        jnp.where(has_slot, smooth_pos, 0.0))
+    tcls = tcls.at[(bidx, local_slot, cls_idx, gj_sel, gi)].max(
+        jnp.full_like(gtx, smooth_pos), mode="drop")
 
     # ignore mask: predicted boxes with IoU > thresh vs any gt
     px1 = px - pw / 2

@@ -469,8 +469,17 @@ class RandomErasing(BaseTransform):
     def _apply_image(self, img):
         if np.random.rand() >= self.prob:
             return img
-        im = _np_img(img)
-        h, w = im.shape[:2]
+        from ..core.tensor import Tensor as _Tensor
+        is_tensor = isinstance(img, _Tensor)
+        if is_tensor:
+            # CHW tensor path: spatial dims are the LAST two; erase()
+            # indexes [..., i:i+h, j:j+w]
+            h, w = img.shape[-2:]
+            tail_shape = (img.shape[0],) if img.ndim == 3 else ()
+        else:
+            img = _np_img(img)
+            h, w = img.shape[:2]
+            tail_shape = img.shape[2:]
         area = h * w
         for _ in range(10):
             target = np.random.uniform(*self.scale) * area
@@ -481,7 +490,12 @@ class RandomErasing(BaseTransform):
             if eh < h and ew < w:
                 i = np.random.randint(0, h - eh)
                 j = np.random.randint(0, w - ew)
-                v = (np.random.standard_normal((eh, ew) + im.shape[2:])
-                     if self.value == "random" else self.value)
-                return erase(im, i, j, eh, ew, v, self.inplace)
+                if self.value == "random":
+                    v = (np.random.standard_normal(
+                        tail_shape + (eh, ew)) if is_tensor else
+                        np.random.standard_normal((eh, ew) + tail_shape))
+                    v = v.astype(np.float32)
+                else:
+                    v = self.value
+                return erase(img, i, j, eh, ew, v, self.inplace)
         return img

@@ -198,3 +198,50 @@ class TestInceptionFamilies:
         names = {n for n in names if not n[0].isupper()}
         missing = [n for n in sorted(names) if not hasattr(M, n)]
         assert missing == [], missing
+
+
+class TestBertAndQwen:
+    """Encoder family + Qwen2-style attention-bias decoder (reference:
+    PaddleNLP bert/qwen2 modeling; in-tree nn TransformerEncoder)."""
+
+    def test_bert_mlm_descends(self):
+        from paddle_tpu.models import BertForMaskedLM
+        import paddle_tpu.nn.functional as F
+        m = BertForMaskedLM("debug")
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (2, 16), dtype=np.int32))
+        mask = paddle.to_tensor(np.ones((2, 16), dtype=np.int32))
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        l0 = None
+        for _ in range(4):
+            logits = m(ids, attention_mask=mask)
+            loss = F.cross_entropy(logits.reshape([-1, 128]),
+                                   ids.reshape([-1]))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if l0 is None:
+                l0 = loss.item()
+        assert logits.shape == [2, 16, 128]
+        assert loss.item() < l0
+
+    def test_bert_classifier_and_pooler(self):
+        from paddle_tpu.models import BertForSequenceClassification
+        cls = BertForSequenceClassification("debug", num_classes=3)
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (2, 16), dtype=np.int32))
+        assert cls(ids).shape == [2, 3]
+
+    def test_qwen2_attention_bias_trainstep(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_loss_fn
+        qm = LlamaForCausalLM("qwen2-debug")
+        names = [n for n, _ in qm.named_parameters()]
+        assert "bq" in names and "bk" in names and "bv" in names
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (2, 16), dtype=np.int32))
+        opt = paddle.optimizer.AdamW(1e-3, parameters=qm.parameters())
+        step = paddle.jit.TrainStep(qm, opt, llama_loss_fn)
+        l0 = float(step(ids, ids))
+        for _ in range(3):
+            l = float(step(ids, ids))
+        assert l < l0

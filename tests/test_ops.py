@@ -559,3 +559,29 @@ class TestFrameworkShims:
                                ref, re.M))
         missing = [x for x in sorted(names) if not hasattr(paddle, x)]
         assert missing == [], missing
+
+
+class TestTensorMethodParity:
+    def test_reference_tensor_method_surface(self):
+        """Every method in the reference tensor/__init__.py
+        tensor_method_func list exists on Tensor."""
+        import re, pathlib
+        t = paddle.to_tensor([1.0])
+        ref = pathlib.Path(
+            "/root/reference/python/paddle/tensor/__init__.py").read_text()
+        names = set(re.findall(r"^\s+'([A-Za-z_][A-Za-z0-9_]*)',\s*$",
+                               ref, re.M))
+        missing = [n for n in sorted(names) if not hasattr(t, n)]
+        assert missing == [], missing
+
+    def test_new_methods_work(self):
+        x = paddle.to_tensor(np.array([[4., 0.], [0., 9.]], "float32"))
+        np.testing.assert_allclose(_np(x.inverse()),
+                                   np.diag([0.25, 1 / 9.]), atol=1e-5)
+        assert paddle.to_tensor([1.0]).is_floating_point()
+        a = paddle.to_tensor(np.array([1., 2.], "float32"))
+        assert abs(_np(a.atan2(paddle.to_tensor(
+            np.array([1., 1.], "float32"))))[0] - np.arctan2(1, 1)) < 1e-6
+        w = paddle.to_tensor(np.array([0.5], "float32"))
+        w.erfinv_()
+        assert np.isfinite(_np(w)).all()

@@ -253,3 +253,19 @@ class TestHigherOrderGrad:
         want = 2 * np.cos(np.linspace(0.2, 1.0, 5)) * np.exp(
             np.linspace(0.2, 1.0, 5))
         np.testing.assert_allclose(np.asarray(g2._value), want, rtol=1e-4)
+
+
+def test_multi_precision_master_does_not_alias_fp32_param():
+    """multi_precision with fp32 params must COPY the master weight —
+    astype(fp32) on fp32 is a no-op returning the same buffer, and an
+    aliased master breaks donation in compiled train steps."""
+    import numpy as np
+    import paddle_tpu as paddle
+    w = paddle.to_tensor(np.ones(4, "float32"), stop_gradient=False)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=[w], multi_precision=True)
+    loss = (w * w).sum()
+    loss.backward()
+    opt.step()
+    master = opt._accumulators["master_weight"][0]
+    assert master.unsafe_buffer_pointer() != \
+        w._value.unsafe_buffer_pointer()
