@@ -42,25 +42,34 @@ def main():
 
     import os
     paddle.seed(0)
+    preset = os.environ.get("BENCH_PRESET", "default")
     if on_tpu:
-        # ~700M-param model at the 8B target's EXACT layer dims
-        # (hidden 4096, ff 14336, 32 heads / 8 kv heads, head_dim 128 —
-        # the llama3-8b preset), depth cut to 2 layers to fit one v5e
-        # chip's 16G HBM. bf16 storage / fp32 master weights. Hidden-size
-        # ladder (each measured at its own best batch/head config, see
-        # BASELINE.md rows r02a-r02c): d1024 starves the MXU, d2048 ~56%,
-        # d4096 (this config) is the per-chip arithmetic intensity the
-        # v5p-64 north star scales from.
+        # Two measured presets (see BASELINE.md "Measured" table):
+        #   default — ~700M params at the 8B target's EXACT layer dims
+        #     (hidden 4096, ff 14336, 32 heads / 8 kv heads, head_dim 128 —
+        #     the llama3-8b preset), depth cut to 2 layers so fp32 master
+        #     weights + Adam moments fit one v5e chip's 16G HBM. Per-layer
+        #     arithmetic intensity is what the v5p-64 north star scales from.
+        #   deep — 508M at d2048/ff5632/L8: validates that scan-over-layers
+        #     + remat at real depth holds the MFU the 2-layer row reports.
+        if preset == "deep":
+            # head_dim stays 128 (16 heads at d2048) — the MXU-friendly
+            # head width the 8B target uses
+            dims = dict(hidden=2048, ff=5632, layers=8, batch=8, heads=16)
+        else:
+            dims = dict(hidden=4096, ff=14336, layers=2, batch=6, heads=32)
         cfg = LlamaConfig(
             vocab_size=int(os.environ.get("BENCH_VOCAB", 32000)),
-            hidden_size=int(os.environ.get("BENCH_HIDDEN", 4096)),
-            intermediate_size=int(os.environ.get("BENCH_FF", 14336)),
-            num_hidden_layers=int(os.environ.get("BENCH_LAYERS", 2)),
-            num_attention_heads=32, num_key_value_heads=8,
+            hidden_size=int(os.environ.get("BENCH_HIDDEN", dims["hidden"])),
+            intermediate_size=int(os.environ.get("BENCH_FF", dims["ff"])),
+            num_hidden_layers=int(os.environ.get("BENCH_LAYERS",
+                                                 dims["layers"])),
+            num_attention_heads=int(os.environ.get(
+                "BENCH_HEADS", dims["heads"])), num_key_value_heads=8,
             max_position_embeddings=4096, dtype="bfloat16",
             recompute=bool(int(os.environ.get("BENCH_RECOMPUTE", 1))),
             recompute_granularity=os.environ.get("BENCH_REMAT", "core_attn"))
-        batch = int(os.environ.get("BENCH_BATCH", 6))
+        batch = int(os.environ.get("BENCH_BATCH", dims["batch"]))
         seq = int(os.environ.get("BENCH_SEQ", 2048))
         iters = int(os.environ.get("BENCH_ITERS", 20))
     else:
@@ -78,16 +87,34 @@ def main():
     dist.shard_model_state(model, mesh)
 
     step = dist.DistTrainStep(model, opt, llama_loss_fn, mesh, donate=True)
-    ids = paddle.to_tensor(
-        np.random.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
 
+    # Fresh batch per step so the printed loss is a correctness signal,
+    # not single-batch memorization. Sequences carry learnable structure
+    # (noisy affine next-token process) so the loss FALLS from ~ln(V)
+    # toward the process entropy as training proceeds — a causality or
+    # optimizer bug shows up as a flat/rising loss.
+    rng = np.random.default_rng(0)
+    support = min(256, cfg.vocab_size)  # restricted support: the unigram
+    # marginal (~ln(support)) is learnable within the bench's few steps,
+    # so a falling loss is visible even in a 20-step timing run
+
+    def fresh_batch():
+        toks = np.empty((batch, seq), dtype=np.int32)
+        toks[:, 0] = rng.integers(0, support, batch)
+        noise = rng.integers(-2, 3, size=(batch, seq - 1))
+        for t in range(1, seq):
+            toks[:, t] = (toks[:, t - 1] * 5 + 17 + noise[:, t - 1]) \
+                % support
+        return paddle.to_tensor(toks)
+
+    batches = [fresh_batch() for _ in range(iters + 1)]
     # compile + warmup (fetch to host: block_until_ready is a no-op through
     # the remote-TPU tunnel)
-    loss = step(ids, ids)
-    float(loss)
+    loss_first = float(step(batches[-1], batches[-1]))
+    loss = loss_first
     t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(ids, ids)
+    for i in range(iters):
+        loss = step(batches[i], batches[i])
     float(loss)  # steps chain through donated params; fetch syncs them all
     dt = time.perf_counter() - t0
 
@@ -106,7 +133,9 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": {"mfu": round(mfu, 4), "params": int(n_params),
-                  "batch": batch, "seq": seq, "loss": round(float(loss), 4),
+                  "batch": batch, "seq": seq, "preset": preset,
+                  "loss_first": round(loss_first, 4),
+                  "loss": round(float(loss), 4),
                   "backend": jax.default_backend()},
     }))
 

@@ -127,8 +127,9 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
     """Normalize a weight by its largest singular value, estimated with
     power iteration (reference: python/paddle/nn/utils/spectral_norm_hook.py
     spectral_norm)."""
-    import numpy as np
-    from ..core.tensor import Parameter
+    import jax
+    from ..core.tensor import Parameter, Tensor
+    from ..ops import random as _random
     w = getattr(layer, name)
     if dim is None:
         dim = 1 if type(layer).__name__.endswith(
@@ -137,8 +138,10 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
     v0 = w._value
     mat = jnp.moveaxis(v0, dim, 0).reshape(v0.shape[dim], -1)
     h, w_dim = mat.shape
-    rng = np.random.default_rng(0)
-    u = jnp.asarray(rng.standard_normal(h).astype(np.float32))
+    # Sample u through the framework RNG so paddle.seed controls it and
+    # each spectral_norm instance gets a distinct vector (reference samples
+    # via the framework RNG in spectral_norm_hook.py).
+    u = jax.random.normal(_random.next_key(), (h,), dtype=jnp.float32)
     u = u / (jnp.linalg.norm(u) + eps)
 
     orig = Parameter(v0, trainable=True)
@@ -161,9 +164,18 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
         # n_power_iterations=0 uses the persisted vector like the reference
         v_ = m.T @ u_
         v_ = v_ / (jnp.linalg.norm(v_) + eps)
-        sigma = jnp.dot(u_, m @ v_)
-        from ..core.tensor import Tensor as _T
-        w_sn = wv / float(sigma)
+        # sigma must stay on the autograd tape: the reference
+        # (spectral_norm_hook.py) computes sigma = u . (W v) with u/v as
+        # constants and divides the live weight by it, so dL/dW includes
+        # the -u v^T sigma'/sigma^2 term. Rebuild the u.W.v contraction
+        # with Tensor ops on wv (u_ / v_ are stop-gradient constants).
+        ndim = len(wv.shape)
+        perm = [dim] + [i for i in range(ndim) if i != dim]
+        w_mat = wv.transpose(perm).reshape([wv.shape[dim], -1])
+        u_t = Tensor(u_, stop_gradient=True)
+        v_t = Tensor(v_, stop_gradient=True)
+        sigma = (u_t.matmul(w_mat) * v_t).sum()
+        w_sn = wv / sigma
         object.__setattr__(layer_, name, w_sn)
 
     _compute(layer)

@@ -348,11 +348,13 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         q = min(6, m, n)
     if center:
         a = a - a.mean(axis=-2, keepdims=True)
-    rng = np.random.default_rng(0)
+    import jax
+    from ..ops import random as _random
     # oversample then truncate (Halko et al.), re-orthonormalizing every
     # power iteration for numerical range accuracy
     p_over = min(n, q + 4)
-    omega = jnp.asarray(rng.standard_normal((n, p_over)).astype(a.dtype))
+    omega = jax.random.normal(
+        _random.next_key(), (n, p_over), dtype=jnp.float32).astype(a.dtype)
     y = a @ omega
     for _ in range(max(niter, 1)):
         y, _ = jnp.linalg.qr(a @ (a.T @ y))

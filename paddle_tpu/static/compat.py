@@ -110,6 +110,20 @@ class ExponentialMovingAverage:
     def update(self, parameters=None):
         import paddle_tpu as p
         params = parameters or self._tracked()
+        if not params:
+            # Match the reference: with no explicit list, EMA tracks the
+            # trainable parameters of the default main program.
+            from ..core.tensor import Parameter
+            from . import default_main_program
+            params = [t for t in default_main_program().external_vars()
+                      .values() if isinstance(t, Parameter)
+                      and getattr(t, "trainable", True)]
+        if not params:
+            raise ValueError(
+                "ExponentialMovingAverage.update() found no parameters to "
+                "track: pass `parameters=` explicitly or record ops that "
+                "consume trainable parameters into the default main "
+                "program first.")
         self._tracked_params = list(params)
         self._step += 1
         d = min(self._decay, (1 + self._step) / (10 + self._step))

@@ -193,6 +193,11 @@ class TestStaticCompat:
         with ema.apply():
             assert _np(w)[0] < 5.0
         assert _np(w)[0] == 5.0
+        # first update() with nothing to track must fail loudly, not no-op
+        import pytest as _pytest
+        with st.program_guard(st.Program()):
+            with _pytest.raises(ValueError, match="no parameters"):
+                st.ExponentialMovingAverage(0.9).update()
 
     def test_control_flow_and_gradients(self):
         import paddle_tpu.static as st
