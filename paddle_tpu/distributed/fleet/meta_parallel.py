@@ -104,7 +104,9 @@ class PipelineParallel(MetaParallelBase):
         self.accumulate_steps = acc
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """reference :600 — returns the averaged loss over microbatches."""
+        """reference :600 — returns the averaged loss over microbatches
+        (detached: the returned total must not pin any microbatch's
+        graph)."""
         x, y = data
         from ...ops.manipulation import split
         n = self.accumulate_steps
@@ -120,7 +122,8 @@ class PipelineParallel(MetaParallelBase):
                 scaler.scale(loss).backward()
             else:
                 loss.backward()
-            total = loss if total is None else total + loss.detach()
+            d = loss.detach()
+            total = d if total is None else total + d
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()
@@ -132,14 +135,44 @@ class PipelineParallel(MetaParallelBase):
         return total
 
     def eval_batch(self, data, compute_loss=True):
+        """reference :700 — microbatched: averaged loss when compute_loss,
+        else per-microbatch outputs concatenated along the batch dim."""
+        from ...ops.manipulation import concat, split
         x, y = data
-        out = self._layers(x)
+        n = self.accumulate_steps
+        if x.shape[0] % n != 0:
+            n = 1  # remainder batch (e.g. validation tail): run whole
+        xs = split(x, n, axis=0) if n > 1 else [x]
+        ys = split(y, n, axis=0) if n > 1 else [y]
         loss_fn = getattr(self._layers, "_loss_fn", None)
+        outs, total = [], None
+        for xb, yb in zip(xs, ys):
+            out = self._layers(xb)
+            if compute_loss and loss_fn is not None:
+                loss = loss_fn(out, yb) / n
+                total = loss if total is None else total + loss
+            else:
+                outs.append(out)
         if compute_loss and loss_fn is not None:
-            return loss_fn(out, y)
-        return out
+            return total
+        return outs[0] if len(outs) == 1 else concat(outs, axis=0)
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """reference pipeline_parallel.py:832 — virtual stages; compiled path
-    treats interleaving as a scheduling hint (XLA already overlaps)."""
+    """reference pipeline_parallel.py:832 — virtual pipeline stages: each
+    rank holds ``virtual_pp_degree`` layer chunks, cutting the bubble
+    ~v-fold. The schedule itself is compiled (fleet/pipeline.py
+    spmd_pipeline interleave=v); this wrapper turns the strategy knob into
+    the model's pp_interleave config so DistTrainStep builds the
+    interleaved program."""
+
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        super().__init__(layers, hcg, strategy, **kwargs)
+        vp = kwargs.get("num_virtual_pipeline_stages", 0)
+        if not vp and strategy is not None:
+            vp = int(strategy.pipeline_configs.get("virtual_pp_degree", 2))
+        self.virtual_pp_degree = vp or 2
+        target = getattr(self._layers, "_layers", self._layers)
+        cfg = getattr(target, "config", None)
+        if cfg is not None and hasattr(cfg, "pp_interleave"):
+            cfg.pp_interleave = self.virtual_pp_degree

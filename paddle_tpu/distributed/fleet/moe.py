@@ -35,34 +35,76 @@ class NaiveGate(nn.Layer):
 
 
 class GShardGate(NaiveGate):
-    """reference moe/gate/gshard_gate.py: adds aux load-balancing loss."""
+    """reference moe/gate/gshard_gate.py: GShard routing — train/eval
+    capacity factors and RANDOM second-expert routing (the 2nd choice is
+    kept with probability min(1, 2*g2), so weak second choices don't burn
+    capacity). The me*ce aux loss is computed in moe_route and surfaced
+    as MoELayer.l_aux."""
 
     def __init__(self, d_model, num_expert, world_size=1, topk=2,
                  capacity=(1.2, 2.4), group=None):
         super().__init__(d_model, num_expert, world_size, topk)
         self.capacity = capacity
 
+    def second_expert_drop(self, logits, training=True):
+        """[N] bool: True where the 2nd choice should be DROPPED."""
+        if self.top_k < 2 or not training:
+            return None
+        probs = jax.nn.softmax(
+            jnp.asarray(logits).astype(jnp.float32), axis=-1)
+        topv, _ = jax.lax.top_k(probs, 2)
+        from ...ops import random as _random
+        u = jax.random.uniform(_random.next_key(), (probs.shape[0],))
+        return u >= jnp.minimum(1.0, 2.0 * topv[:, 1])
+
 
 class SwitchGate(NaiveGate):
-    """reference moe/gate/switch_gate.py: top-1 routing."""
+    """reference moe/gate/switch_gate.py: top-1 routing with train-time
+    multiplicative jitter on the router logits (Switch Transformer:
+    uniform noise in [1-eps, 1+eps] decorrelates routing)."""
 
     def __init__(self, d_model, num_expert, world_size=1, topk=1,
                  switch_eps=0.1, capacity=(1.2, 2.4), group=None):
         super().__init__(d_model, num_expert, world_size, topk)
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+
+    def forward(self, x):
+        logits = self.gate(x)
+        if self.training and self.switch_eps:
+            from ...core.tensor import Tensor
+            from ...ops import random as _random
+            noise = jax.random.uniform(
+                _random.next_key(), jnp.asarray(logits._value).shape,
+                minval=1.0 - self.switch_eps, maxval=1.0 + self.switch_eps)
+            logits = logits * Tensor(noise, stop_gradient=True)
+        return logits
 
 
-def moe_slots(logits, num_experts, capacity, top_k):
+def moe_slots(logits, num_experts, capacity, top_k, drop2_mask=None):
     """Slot metadata only — top_k on RAW logits (softmax is monotonic, so
     indices match) to keep the eager pre-pass cheap. Returns slot [N, k]
-    int: flat position in the [E*C] buffer, E*C meaning 'dropped'."""
+    int: flat position in the [E*C] buffer, E*C meaning 'dropped'.
+    ``drop2_mask`` [N] bool: GShard random routing — choices >= 2nd are
+    force-dropped (and don't consume capacity) where True."""
     _, topi = jax.lax.top_k(logits, top_k)
     n = logits.shape[0]
     flat_e = topi.reshape(-1)
     onehot = jax.nn.one_hot(flat_e, num_experts, dtype=jnp.int32)
+    if drop2_mask is not None and top_k >= 2:
+        forced = jnp.concatenate(
+            [jnp.zeros((n, 1), bool),
+             jnp.broadcast_to(drop2_mask[:, None], (n, top_k - 1))],
+            axis=1).reshape(-1)
+        onehot = onehot * (~forced[:, None]).astype(jnp.int32)
+    else:
+        forced = None
     pos = jnp.cumsum(onehot, axis=0) - onehot
     pos_in_expert = jnp.take_along_axis(
         pos, flat_e[:, None], axis=1)[:, 0].reshape(n, top_k)
     keep = pos_in_expert < capacity
+    if forced is not None:
+        keep = jnp.logical_and(keep, ~forced.reshape(n, top_k))
     return jnp.where(keep, topi * capacity + pos_in_expert,
                      num_experts * capacity)
 
@@ -149,11 +191,13 @@ def _combine(expert_outputs, gates, slot):
     return moe_unpermute(expert_outputs, slot, gates, n)
 
 
-def moe_dispatch_combine(x, logits, num_experts, capacity, top_k):
+def moe_dispatch_combine(x, logits, num_experts, capacity, top_k,
+                         drop2_mask=None):
     """Returns (expert_in, gates, slot_raw, aux). slot is a raw int array
     (routing metadata, not a differentiable Tensor)."""
     lv = logits._value if isinstance(logits, Tensor) else jnp.asarray(logits)
-    slot = moe_slots(lv, num_experts, capacity, top_k)
+    slot = moe_slots(lv, num_experts, capacity, top_k,
+                     drop2_mask=drop2_mask)
     expert_in, gates, aux = _dispatch(
         x, logits, slot=slot, num_experts=num_experts, capacity=capacity,
         top_k=top_k)
@@ -170,7 +214,7 @@ class MoELayer(nn.Layer):
 
     def __init__(self, d_model=None, experts=None, gate=None, moe_group=None,
                  mp_group=None, recompute_interval=0, top_k=2,
-                 capacity_factor=1.25, **kwargs):
+                 capacity_factor=None, **kwargs):
         super().__init__()
         if isinstance(gate, dict):
             gate_type = gate.get("type", "gshard")
@@ -189,11 +233,24 @@ class MoELayer(nn.Layer):
         d = orig_shape[-1]
         x2 = reshape(x, [-1, d])
         n_tokens = x2.shape[0]
-        capacity = max(1, int(self.capacity_factor * n_tokens
+        # explicit capacity_factor wins; else the gate's train/eval
+        # capacity pair (GShard/Switch); else the 1.25 default
+        factor = self.capacity_factor
+        if factor is None:
+            if hasattr(self.gate, "capacity"):
+                factor = self.gate.capacity[0 if self.training else 1]
+            else:
+                factor = 1.25
+        capacity = max(1, int(factor * n_tokens
                               * self.top_k / self.num_experts))
         logits = self.gate(x2)
+        drop2 = None
+        if isinstance(self.gate, GShardGate):
+            drop2 = self.gate.second_expert_drop(
+                logits._value, training=self.training)
         expert_in, gates, slot, aux = moe_dispatch_combine(
-            x2, logits, self.num_experts, capacity, self.top_k)
+            x2, logits, self.num_experts, capacity, self.top_k,
+            drop2_mask=drop2)
         # shard expert dim over 'ep' (all-to-all inserted by GSPMD)
         expert_in = shard_hint(expert_in, "ep", None, None)
         outs = []

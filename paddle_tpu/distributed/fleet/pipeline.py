@@ -145,56 +145,136 @@ class PipelineLayer(nn.Layer):
 # ---------------------------------------------------------------------------
 # Compiled SPMD pipeline schedule
 # ---------------------------------------------------------------------------
-def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatch: int,
-                  axis_name: str = "pp"):
-    """Build a pipelined apply: ``stage_fn(stage_params, x) -> y`` runs one
-    stage's layers; weights must be stacked [n_stages, ...] and sharded over
-    ``axis_name``. Returns ``fn(stacked_params, x_microbatched)`` for use
-    INSIDE shard_map over the pp axis, where x_microbatched is
-    [n_microbatch, mb, ...] (replicated across pp).
+def interleave_permutation(n_layers: int, n_stages: int,
+                           interleave: int) -> list[int]:
+    """Layer permutation mapping natural order to the interleaved layout:
+    rank r's local [L/pp] slice holds its ``interleave`` virtual-stage
+    chunks contiguously (chunk j of rank r = virtual stage j*pp + r,
+    reference pipeline_parallel.py:832 / Megatron virtual stages)."""
+    chunk = n_layers // (n_stages * interleave)
+    perm = []
+    for r in range(n_stages):
+        for j in range(interleave):
+            s = j * n_stages + r
+            perm.extend(range(s * chunk, (s + 1) * chunk))
+    return perm
 
-    Schedule: n_microbatch + n_stages - 1 ticks; each tick every stage
-    computes its current microbatch then activations ppermute to the next
-    stage (scaling-book pipelining recipe; reference 1F1B semantics emerge
-    after autodiff of this program)."""
+
+def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatch: int,
+                  axis_name: str = "pp", interleave: int = 1,
+                  remat: bool = True, has_aux: bool = False):
+    """Build a pipelined apply: ``stage_fn(chunk_params, x) -> y`` runs one
+    virtual-stage chunk's layers; weights must be stacked
+    [n_stages * chunk_layers * interleave, ...], sharded over ``axis_name``,
+    and (for interleave > 1) pre-permuted with :func:`interleave_permutation`
+    so each rank's local slice is its chunks in order. Returns
+    ``fn(stacked_params, x_microbatched)`` for use INSIDE shard_map over the
+    pp axis, where x_microbatched is [n_microbatch, mb, ...] (replicated
+    across pp).
+
+    Schedule (reference 1F1B/interleave pipeline_parallel.py:397,832 —
+    rebuilt as one SPMD program; backward order emerges from autodiff):
+
+    - tick t, rank r: active virtual stage (j, m) with
+      t = r + j*n_microbatch + m; one chunk computed per rank per tick, so
+      total ticks = interleave*n_microbatch + n_stages - 1 of CHUNK time.
+      Bubble fraction (pp-1)/(v*n_mb + pp - 1): pp=4 v=1 n_mb=8 -> 27%,
+      pp=4 v=4 n_mb=8 -> 9%, pp=8 v=4 n_mb=16 -> 10% (vs GPipe n_mb=pp:
+      43% / 47%).
+    - activations ppermute one rank ahead every tick; the chunk-boundary
+      hop (rank pp-1 -> rank 0, next chunk) parks in a [n_mb, ...] buffer
+      until rank 0's schedule reaches it (requires n_mb >= pp).
+    - ``remat``: each chunk call is wrapped in jax.checkpoint, so the
+      backward holds only the per-tick BOUNDARY activations (n_ticks x
+      [mb, ...]) plus one chunk's internals during its recompute — the
+      1F1B activation bound. Without it, every tick's full stage internals
+      stay live (unbounded in n_mb).
+    - ``has_aux``: stage_fn returns (y, scalar); active-tick scalars are
+      summed across ticks and psum'd over the pp axis (per-layer router
+      aux losses etc.), and apply returns (outputs, aux_sum)."""
+    if interleave > 1 and n_microbatch < n_stages:
+        raise ValueError(
+            f"interleaved pipeline needs n_microbatch >= n_stages "
+            f"(got {n_microbatch} < {n_stages}): the chunk-boundary "
+            f"buffer is indexed by microbatch")
+    v = interleave
 
     def apply(stage_params, x_mb):
         stage = lax.axis_index(axis_name)
-        n_ticks = n_microbatch + n_stages - 1
+        n_ticks = v * n_microbatch + n_stages - 1
         mb_shape = x_mb.shape[1:]
-        state = jnp.zeros(mb_shape, x_mb.dtype)  # current activation
-        outputs = jnp.zeros((n_microbatch,) + mb_shape, x_mb.dtype)
-        # mark carry as pp-varying (shard_map vma typing)
-        if hasattr(lax, "pcast"):
-            state = lax.pcast(state, (axis_name,), to="varying")
-            outputs = lax.pcast(outputs, (axis_name,), to="varying")
-        elif hasattr(lax, "pvary"):
-            state = lax.pvary(state, (axis_name,))
-            outputs = lax.pvary(outputs, (axis_name,))
+
+        def _pv(a):
+            if hasattr(lax, "pcast"):
+                return lax.pcast(a, (axis_name,), to="varying")
+            if hasattr(lax, "pvary"):
+                return lax.pvary(a, (axis_name,))
+            return a
+
+        # local chunks view: [v*Lc, ...] -> [v, Lc, ...]
+        chunked = jax.tree_util.tree_map(
+            lambda a: a.reshape((v, a.shape[0] // v) + a.shape[1:]),
+            stage_params)
+
+        def chunk_apply(j, x):
+            pj = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, j, 0, keepdims=False),
+                chunked)
+            res = stage_fn(pj, x)
+            return res if has_aux else (res, jnp.zeros((), jnp.float32))
+
+        if remat:
+            # residuals per tick = (j, x) only — the boundary activation;
+            # chunk internals recompute during backward (1F1B memory bound)
+            chunk_apply = jax.checkpoint(chunk_apply)
+
+        state = _pv(jnp.zeros(mb_shape, x_mb.dtype))     # just-received act
+        outputs = _pv(jnp.zeros((n_microbatch,) + mb_shape, x_mb.dtype))
+        # chunk-boundary parking buffer (rank 0 reads chunk j>0 inputs)
+        inbuf = _pv(jnp.zeros((n_microbatch,) + mb_shape, x_mb.dtype))
+        aux_acc = _pv(jnp.zeros((), jnp.float32))
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
-        def tick(t, carry):
-            state, outputs = carry
-            # stage 0 ingests microbatch t (if in range)
-            mb_idx = jnp.clip(t, 0, n_microbatch - 1)
-            fresh = x_mb[mb_idx]
-            inp = jnp.where(stage == 0, fresh, state)
-            out = stage_fn(stage_params, inp)
-            # last stage emits result for microbatch t - (n_stages - 1)
-            out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatch - 1)
-            is_emit = jnp.logical_and(stage == n_stages - 1,
-                                      t >= n_stages - 1)
-            outputs = jnp.where(is_emit, outputs.at[out_idx].set(out),
-                                outputs)
-            # shift activations to next stage
+        def tick(carry, t):
+            state, outputs, inbuf, aux_acc = carry
+            # this rank's scheduled virtual stage: t = stage + j*n_mb + m
+            rel = t - stage
+            j = jnp.clip(rel // n_microbatch, 0, v - 1)
+            m = jnp.clip(rel, 0, v * n_microbatch - 1) % n_microbatch
+            fresh = x_mb[m]  # already pp-varying (m depends on axis_index)
+            first_chunk_in = jnp.where(j == 0, fresh, inbuf[m])
+            inp = jnp.where(stage == 0, first_chunk_in, state)
+            out, aux_t = chunk_apply(j, inp)
+            active = jnp.logical_and(rel >= 0, rel < v * n_microbatch)
+            aux_acc = aux_acc + jnp.where(active, aux_t, 0.0)
+            # last rank, last chunk emits microbatch m's result
+            is_emit = jnp.logical_and(
+                jnp.logical_and(stage == n_stages - 1, j == v - 1),
+                rel >= (v - 1) * n_microbatch)
+            outputs = jnp.where(is_emit, outputs.at[m].set(out), outputs)
+            # shift activations one rank ahead
             state = lax.ppermute(out, axis_name, perm)
-            return (state, outputs)
+            if v > 1:
+                # rank 0 parks the chunk-boundary activation it just
+                # received (sender = rank pp-1 at tick t, stage (j_s, m_s));
+                # consumed when rank 0 reaches chunk j_s+1, microbatch m_s
+                rel_s = t - (n_stages - 1)
+                j_s = rel_s // n_microbatch
+                m_s = jnp.clip(rel_s, 0, v * n_microbatch - 1) % n_microbatch
+                park = jnp.logical_and(
+                    jnp.logical_and(rel_s >= 0, j_s < v - 1), stage == 0)
+                inbuf = jnp.where(park, inbuf.at[m_s].set(state), inbuf)
+            return (state, outputs, inbuf, aux_acc), None
 
-        state, outputs = lax.fori_loop(0, n_ticks, tick, (state, outputs))
+        (state, outputs, inbuf, aux_acc), _ = lax.scan(
+            tick, (state, outputs, inbuf, aux_acc), jnp.arange(n_ticks))
         # results live on the last stage; broadcast so every pp rank returns
         # the same outputs (psum over one-hot)
         mask = (stage == n_stages - 1).astype(outputs.dtype)
         outputs = lax.psum(outputs * mask, axis_name)
+        if has_aux:
+            # every rank's active ticks contributed its own layers' aux
+            return outputs, lax.psum(aux_acc, axis_name)
         return outputs
 
     return apply

@@ -108,6 +108,66 @@ class TestLlama:
         assert m._parameters["we_gate"].grad is not None
         assert m._parameters["router"].grad is not None
 
+    def test_moe_aux_loss_applied(self):
+        """VERDICT #2: the GShard aux loss must reach the training
+        objective — zeroing its weight changes the loss."""
+        from paddle_tpu.models.llama import (LlamaConfig, LLAMA_PRESETS,
+                                             LlamaForCausalLM,
+                                             llama_loss_fn)
+        ids = paddle.to_tensor(np.random.randint(0, 1024, (2, 32)))
+        paddle.seed(0)
+        m = LlamaForCausalLM("tiny-moe")
+        l_with = float(llama_loss_fn(m, ids, ids))
+        paddle.seed(0)
+        cfg = LlamaConfig(**LLAMA_PRESETS["tiny-moe"])
+        cfg.moe_aux_loss_weight = 0.0
+        m0 = LlamaForCausalLM(cfg)
+        l_without = float(llama_loss_fn(m0, ids, ids))
+        assert l_with > l_without  # aux term is nonnegative and nonzero
+        # z-loss knob has its own observable effect
+        paddle.seed(0)
+        cfg_z = LlamaConfig(**LLAMA_PRESETS["tiny-moe"])
+        cfg_z.moe_aux_loss_weight = 0.0
+        cfg_z.moe_z_loss_weight = 0.01
+        mz = LlamaForCausalLM(cfg_z)
+        l_z = float(llama_loss_fn(mz, ids, ids))
+        assert l_z > l_without
+
+    def test_moe_expert_balance_improves_with_aux(self):
+        """Training on the aux loss alone must rebalance a router that
+        starts collapsed onto one expert (GShard me*ce objective:
+        minimized at uniform load)."""
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.nn as nn
+        d, E = 16, 4
+        paddle.seed(1)
+        experts = [nn.Linear(d, d) for _ in range(E)]
+        moe = dist.fleet.MoELayer(d_model=d, experts=experts, top_k=2,
+                                  capacity_factor=4.0)
+        # collapse: bias routes everything to expert 0
+        bias = np.zeros(E, np.float32)
+        bias[0] = 5.0
+        moe.gate.gate.bias.set_value(bias)
+        opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                     parameters=moe.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(64, d).astype("float32"))
+
+        def max_share():
+            logits = np.asarray(moe.gate(x)._value)
+            top1 = np.argmax(logits, axis=-1)
+            c = np.bincount(top1, minlength=E)
+            return c.max() / c.sum()
+
+        assert max_share() > 0.9  # collapsed
+        for _ in range(30):
+            moe(x)
+            aux = moe.l_aux
+            aux.backward()
+            opt.step()
+            opt.clear_grad()
+        assert max_share() < 0.6, max_share()
+
     def test_tied_embeddings(self):
         from paddle_tpu.models.llama import LlamaConfig, LLAMA_PRESETS, LlamaForCausalLM
         cfg = LlamaConfig(**LLAMA_PRESETS["debug"])
