@@ -46,61 +46,46 @@ def ring_attention_local(q, k, v, axis_name: str, n_shards: int,
     """Per-shard ring attention body (call inside shard_map over
     ``axis_name``). q: [b, sq, h, d]; k, v: [b, sk, hkv, d] — all local
     shards of a sequence laid out in contiguous blocks (GSPMD 'sep'
-    sharding). Returns the local output [b, sq, h, d]."""
+    sharding). Returns the local output [b, sq, h, d].
+
+    BLOCKWISE (VERDICT #4): each hop runs the flash kernel on the local
+    (q, k_hop, v_hop) pair, producing (out, lse); hops combine with an
+    online softmax over the lse — per-hop memory is O(sq·d), never the
+    full [sq, sk] score matrix. The lse path is differentiable
+    (kernels.flash_attention.attention_with_lse folds the lse cotangent
+    into the FA2 backward)."""
+    from ..kernels.flash_attention import attention_with_lse
     b, sq, h, d = q.shape
-    sk, hkv = k.shape[1], k.shape[2]
-    g = h // hkv
-    scale = 1.0 / (d ** 0.5)
     my = lax.axis_index(axis_name)
-
-    qh = _grouped(q).reshape(b, hkv, g, sq, d)
-    m0 = jnp.full((b, hkv, g, sq), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, hkv, g, sq), jnp.float32)
-    acc0 = jnp.zeros((b, hkv, g, sq, d), jnp.float32)
-    if hasattr(lax, "pcast"):  # mark accumulators sep-varying (vma typing)
-        m0, l0, acc0 = (lax.pcast(a, (axis_name,), to="varying")
-                        for a in (m0, l0, acc0))
-    elif hasattr(lax, "pvary"):
-        m0, l0, acc0 = (lax.pvary(a, (axis_name,)) for a in (m0, l0, acc0))
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
-    q_pos = my * sq + jnp.arange(sq)
 
-    def block(t, k_cur, v_cur, m, l, acc):
-        # after t hops my block originated on rank (my - t) mod n
-        src = (my - t) % n_shards
-        kh = _grouped(k_cur)                                  # [b, hkv, sk, d]
-        vh = _grouped(v_cur)
-        s = jnp.einsum("bngsd,bntd->bngst", qh, kh,
-                       preferred_element_type=jnp.float32) * scale
-        if causal:
-            k_pos = src * sk + jnp.arange(sk)
-            mask = q_pos[:, None] >= k_pos[None, :]           # [sq, sk]
-            s = jnp.where(mask, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[..., None])
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + p.sum(axis=-1)
-        acc = acc * alpha[..., None] + jnp.einsum(
-            "bngst,bntd->bngsd", p.astype(v_cur.dtype), vh,
-            preferred_element_type=jnp.float32)
-        return m_new, l, acc
+    # hop 0: the local block — ordinary causal (or full) attention
+    out0, lse0 = attention_with_lse(q, k, v, causal=causal)
+    out0 = out0.astype(jnp.float32)
 
     def step(carry, t):
-        k_cur, v_cur, m, l, acc = carry
-        m, l, acc = block(t, k_cur, v_cur, m, l, acc)
-        k_next = lax.ppermute(k_cur, axis_name, perm)
-        v_next = lax.ppermute(v_cur, axis_name, perm)
-        return (k_next, v_next, m, l, acc), None
+        k_cur, v_cur, lse_run, out_run = carry
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        # after t hops my held block originated on rank (my - t) mod n
+        src = (my - t) % n_shards
+        out_h, lse_h = attention_with_lse(q, k_cur, v_cur, causal=False)
+        if causal:
+            # blocks strictly earlier attend fully; later (wrapped)
+            # blocks contribute nothing (weight exp(-inf) = 0)
+            valid = src < my
+            lse_h = jnp.where(valid, lse_h, _NEG_INF)
+        new_lse = jnp.logaddexp(lse_run, lse_h)
+        w_old = jnp.exp(lse_run - new_lse)              # [b*h, 1, sq]
+        w_new = jnp.exp(lse_h - new_lse)
+        wo = jnp.swapaxes(w_old.reshape(b, h, sq), 1, 2)[..., None]
+        wn = jnp.swapaxes(w_new.reshape(b, h, sq), 1, 2)[..., None]
+        out_run = out_run * wo + out_h.astype(jnp.float32) * wn
+        return (k_cur, v_cur, new_lse, out_run), None
 
-    # n-1 compute+rotate steps, then the last block without the rotation
-    # (its permute result would be dead, but XLA can't DCE a collective
-    # inside the scan body)
-    (k, v, m, l, acc), _ = lax.scan(
-        step, (k, v, m0, l0, acc0), jnp.arange(n_shards - 1))
-    m, l, acc = block(n_shards - 1, k, v, m, l, acc)
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
-    out = out.reshape(b, hkv * g, sq, d)
-    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+    (_, _, _, out), _ = lax.scan(
+        step, (k, v, lse0, out0), jnp.arange(1, n_shards))
+    return out.astype(q.dtype)
 
 
 def _seq_spec(axis_name):

@@ -93,6 +93,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, seq_len,
 
 
 def _choose_blocks(seq_len, head_dim, dtype):
+    """Pick (bq, bk, stream). ``stream=True`` switches the kernels to
+    double-buffered BK-sized HBM→VMEM DMA for the full-sequence operands
+    (K/V in fwd+dq, Q/dO in dK/dV) instead of whole-sequence VMEM blocks —
+    the long-context path (VERDICT #4: (1, S, D) blocks break ≥32k).
+    The decision is an explicit VMEM-budget check, not guesswork."""
     import os
     base = int(os.environ.get("PT_FLASH_BLOCK", 512))
     if base < 8 or (base & (base - 1)) != 0:
@@ -105,8 +110,89 @@ def _choose_blocks(seq_len, head_dim, dtype):
     bk = base
     while seq_len % bk != 0 and bk > 8:
         bk //= 2
-    # keep q/k/v blocks + accumulators well under VMEM (~16MB)
-    return bq, bk
+    esize = jnp.dtype(dtype).itemsize
+    budget = float(os.environ.get("PT_FLASH_VMEM_MB", 10.0)) * 2 ** 20
+    # worst-case resident set of the non-streaming kernels (dkv: q + do
+    # full-seq + k/v blocks + f32 accumulators + lse/delta rows)
+    full_seq_bytes = 2 * seq_len * head_dim * esize
+    block_bytes = (2 * bk * head_dim * esize          # k/v or q/do blocks
+                   + 3 * bq * head_dim * 4            # f32 acc + dq + tmp
+                   + 4 * seq_len * 4)                 # lse/delta rows
+    stream = full_seq_bytes + block_bytes > budget
+    return bq, bk, stream
+
+
+def _fwd_kernel_stream(q_ref, k_hbm, v_hbm, o_ref, lse_ref, k_s, v_s,
+                       ksem, vsem, *, bq, bk, seq_len, causal, scale,
+                       group):
+    """Forward with K/V left in HBM (memory_space=ANY) and streamed into
+    VMEM in double-buffered BK chunks — resident VMEM is O(bq*D + bk*D)
+    regardless of S (the long-context path)."""
+    bh = pl.program_id(0)
+    qblk = pl.program_id(1)
+    kv_row = bh // group
+    q = q_ref[0]
+    d = q.shape[-1]
+
+    def kdma(slot, j):
+        return pltpu.make_async_copy(
+            k_hbm.at[kv_row, pl.ds(j * bk, bk), :], k_s.at[slot],
+            ksem.at[slot])
+
+    def vdma(slot, j):
+        return pltpu.make_async_copy(
+            v_hbm.at[kv_row, pl.ds(j * bk, bk), :], v_s.at[slot],
+            vsem.at[slot])
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    n_kblocks = seq_len // bk
+    if causal:
+        upper = (qblk + 1) * bq + bk - 1
+        n_loop = jnp.minimum(upper // bk, n_kblocks)
+    else:
+        n_loop = n_kblocks
+
+    q_ids = qblk * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    kdma(0, 0).start()
+    vdma(0, 0).start()
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, 2)
+        nxt = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < n_loop)
+        def _prefetch():
+            kdma(nxt, j + 1).start()
+            vdma(nxt, j + 1).start()
+
+        kdma(slot, j).wait()
+        vdma(slot, j).wait()
+        k = k_s[slot]
+        v = v_s[slot]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=jax.lax.Precision.DEFAULT) * scale
+        if causal:
+            k_ids = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_loop, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)
 
 
 def _flash_fwd_impl(q, k, v, causal, interpret=False, with_lse=False):
@@ -118,28 +204,57 @@ def _flash_fwd_impl(q, k, v, causal, interpret=False, with_lse=False):
     qf = jnp.swapaxes(q, 1, 2).reshape(B * H, S, D)
     kf = jnp.swapaxes(k, 1, 2).reshape(B * Hkv, S, D)
     vf = jnp.swapaxes(v, 1, 2).reshape(B * Hkv, S, D)
-    bq, bk = _choose_blocks(S, D, q.dtype)
+    bq, bk, stream = _choose_blocks(S, D, q.dtype)
 
-    kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_len=S,
-                               causal=causal, scale=scale)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=(B * H, S // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh // G, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh // G, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, 1, S), jnp.float32),
-        ],
-        interpret=interpret,
-    )(qf, kf, vf)
+    if stream and _HAS_PLTPU:
+        kernel = functools.partial(
+            _fwd_kernel_stream, bq=bq, bk=bk, seq_len=S, causal=causal,
+            scale=scale, group=G)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(B * H, S // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+                pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+                jax.ShapeDtypeStruct((B * H, 1, S), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, bk, D), k.dtype),
+                pltpu.VMEM((2, bk, D), v.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            interpret=interpret,
+        )(qf, kf, vf)
+    else:
+        kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_len=S,
+                                   causal=causal, scale=scale)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(B * H, S // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+                pl.BlockSpec((1, S, D), lambda bh, qi: (bh // G, 0, 0)),
+                pl.BlockSpec((1, S, D), lambda bh, qi: (bh // G, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+                pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+                jax.ShapeDtypeStruct((B * H, 1, S), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qf, kf, vf)
     out = jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
     if with_lse:
         return out, lse
@@ -189,6 +304,156 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
     dq = jax.lax.fori_loop(0, n_loop, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dq_kernel_stream(q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref,
+                      dq_ref, k_s, v_s, ksem, vsem, *, bq, bk, seq_len,
+                      causal, scale, group):
+    """dQ with K/V streamed from HBM (double-buffered BK chunks)."""
+    bh = pl.program_id(0)
+    qblk = pl.program_id(1)
+    kv_row = bh // group
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    d = q.shape[-1]
+
+    def kdma(slot, j):
+        return pltpu.make_async_copy(
+            k_hbm.at[kv_row, pl.ds(j * bk, bk), :], k_s.at[slot],
+            ksem.at[slot])
+
+    def vdma(slot, j):
+        return pltpu.make_async_copy(
+            v_hbm.at[kv_row, pl.ds(j * bk, bk), :], v_s.at[slot],
+            vsem.at[slot])
+
+    n_kblocks = seq_len // bk
+    if causal:
+        upper = (qblk + 1) * bq + bk - 1
+        n_loop = jnp.minimum(upper // bk, n_kblocks)
+    else:
+        n_loop = n_kblocks
+
+    q_ids = qblk * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    kdma(0, 0).start()
+    vdma(0, 0).start()
+
+    def body(j, dq):
+        slot = jax.lax.rem(j, 2)
+        nxt = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < n_loop)
+        def _prefetch():
+            kdma(nxt, j + 1).start()
+            vdma(nxt, j + 1).start()
+
+        kdma(slot, j).wait()
+        vdma(slot, j).wait()
+        k = k_s[slot]
+        v = v_s[slot]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        if causal:
+            k_ids = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=jax.lax.Precision.DEFAULT)
+        ds = (p * (dp - delta[:, None])).astype(k.dtype)
+        return dq + scale * jnp.dot(ds, k,
+                                    preferred_element_type=jnp.float32,
+                                    precision=jax.lax.Precision.DEFAULT)
+
+    dq = jax.lax.fori_loop(0, n_loop, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel_stream(q_hbm, k_ref, v_ref, do_hbm, lse_ref, delta_ref,
+                       dk_ref, dv_ref, q_s, do_s, qsem, dosem, *, bq, bk,
+                       seq_len, causal, scale, group):
+    """dK/dV with Q and dO streamed from HBM (double-buffered BQ chunks);
+    lse/delta rows ([1,1,S] f32) stay as regular VMEM blocks."""
+    bh = pl.program_id(0)
+    kblk = pl.program_id(1)
+    g = pl.program_id(2)
+    q_row = bh * group + g
+
+    @pl.when(g == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    k = k_ref[0]
+    v = v_ref[0]
+    d = k.shape[-1]
+
+    def qdma(slot, j):
+        return pltpu.make_async_copy(
+            q_hbm.at[q_row, pl.ds(j * bq, bq), :], q_s.at[slot],
+            qsem.at[slot])
+
+    def dodma(slot, j):
+        return pltpu.make_async_copy(
+            do_hbm.at[q_row, pl.ds(j * bq, bq), :], do_s.at[slot],
+            dosem.at[slot])
+
+    n_qblocks = seq_len // bq
+    lo = (kblk * bk) // bq if causal else 0
+
+    k_ids = kblk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+
+    qdma(0, lo).start()
+    dodma(0, lo).start()
+
+    def body(j, carry):
+        dk, dv = carry
+        slot = jax.lax.rem(j - lo, 2)
+        nxt = jax.lax.rem(j - lo + 1, 2)
+
+        @pl.when(j + 1 < n_qblocks)
+        def _prefetch():
+            qdma(nxt, j + 1).start()
+            dodma(nxt, j + 1).start()
+
+        qdma(slot, j).wait()
+        dodma(slot, j).wait()
+        q = q_s[slot]
+        do = do_s[slot]
+        lse = lse_ref[0, 0, pl.ds(j * bq, bq)]
+        delta = delta_ref[0, 0, pl.ds(j * bq, bq)]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        if causal:
+            q_ids = j * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None]).astype(do.dtype)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=jax.lax.Precision.DEFAULT)
+        ds = (p.astype(jnp.float32) * (dp - delta[:, None])).astype(q.dtype)
+        dk = dk + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        lo, n_qblocks, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0] = dk_ref[0] + dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv_ref[0] + dv.astype(dv_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -246,7 +511,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv_ref[0] + dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd_impl(q, k, v, out, lse, g, causal, interpret=False):
+def _flash_bwd_impl(q, k, v, out, lse, g, causal, interpret=False,
+                    g_lse=None):
     B, S, H, D = q.shape
     Hkv = k.shape[2]
     G = H // Hkv
@@ -259,42 +525,90 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, interpret=False):
     # D_i = rowsum(dO_i * O_i) — cheap elementwise, XLA fuses it
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1)[:, None, :]                          # [B*H, 1, S]
-    bq, bk = _choose_blocks(S, D, q.dtype)
+    if g_lse is not None:
+        # lse cotangent folds into delta: ds = p*(dp - delta + g_lse)
+        # because d lse_i / d s_ij = p_ij (see flash_attention_with_lse)
+        delta = delta - g_lse
+    bq, bk, stream = _choose_blocks(S, D, q.dtype)
+    stream = stream and _HAS_PLTPU
 
-    dq_kernel = functools.partial(_dq_kernel, bq=bq, bk=bk, seq_len=S,
-                                  causal=causal, scale=scale)
+    if stream:
+        dq_kernel = functools.partial(
+            _dq_kernel_stream, bq=bq, bk=bk, seq_len=S, causal=causal,
+            scale=scale, group=G)
+        dq_in_specs = [
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+        ]
+        dq_scratch = [
+            pltpu.VMEM((2, bk, D), k.dtype),
+            pltpu.VMEM((2, bk, D), v.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+    else:
+        dq_kernel = functools.partial(_dq_kernel, bq=bq, bk=bk, seq_len=S,
+                                      causal=causal, scale=scale)
+        dq_in_specs = [
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh // G, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh // G, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
+        ]
+        dq_scratch = []
     dqf = pl.pallas_call(
         dq_kernel,
         grid=(B * H, S // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh // G, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh // G, 0, 0)),
-            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, 1, bq), lambda bh, qi: (bh, 0, qi)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=dq_scratch,
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
-    dkv_kernel = functools.partial(_dkv_kernel, bq=bq, bk=bk, seq_len=S,
-                                   causal=causal, scale=scale)
     # grid: G is the fastest-varying (last) dim, so the G query heads of a
     # KV head revisit the same (bh_kv, ki) output block consecutively and
     # accumulate in place
+    if stream:
+        dkv_kernel = functools.partial(
+            _dkv_kernel_stream, bq=bq, bk=bk, seq_len=S, causal=causal,
+            scale=scale, group=G)
+        dkv_in_specs = [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, bk, D), lambda bh, ki, gi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki, gi: (bh, ki, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, 1, S), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
+        ]
+        dkv_scratch = [
+            pltpu.VMEM((2, bq, D), q.dtype),
+            pltpu.VMEM((2, bq, D), g.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+    else:
+        dkv_kernel = functools.partial(_dkv_kernel, bq=bq, bk=bk, seq_len=S,
+                                       causal=causal, scale=scale)
+        dkv_in_specs = [
+            pl.BlockSpec((1, S, D), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki, gi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki, gi: (bh, ki, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
+        ]
+        dkv_scratch = []
     dkf, dvf = pl.pallas_call(
         dkv_kernel,
         grid=(B * Hkv, S // bk, G),
-        in_specs=[
-            pl.BlockSpec((1, S, D), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, ki, gi: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, ki, gi: (bh, ki, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
-            pl.BlockSpec((1, 1, S), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
-            pl.BlockSpec((1, 1, S), lambda bh, ki, gi: (bh * G + gi, 0, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda bh, ki, gi: (bh, ki, 0)),
             pl.BlockSpec((1, bk, D), lambda bh, ki, gi: (bh, ki, 0)),
@@ -303,6 +617,7 @@ def _flash_bwd_impl(q, k, v, out, lse, g, causal, interpret=False):
             jax.ShapeDtypeStruct((B * Hkv, S, D), jnp.float32),
             jax.ShapeDtypeStruct((B * Hkv, S, D), jnp.float32),
         ],
+        scratch_shapes=dkv_scratch,
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
@@ -338,6 +653,28 @@ def _sdpa_reference(q, k, v, causal):
     return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
 
 
+def _sdpa_reference_with_lse(q, k, v, causal):
+    """XLA fallback returning (out [B,S,H,D], lse [B*H,1,S]) — pure jnp,
+    so autodiff handles the lse cotangent without a custom rule."""
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    qh = jnp.swapaxes(q, 1, 2).reshape(B, Hkv, G, S, D)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    s = jnp.einsum("bngsd,bntd->bngst", qh, kh).astype(jnp.float32)
+    s = s / (D ** 0.5)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, _NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)          # [B,Hkv,G,S]
+    p = jnp.exp(s - lse[..., None]).astype(q.dtype)
+    out = jnp.einsum("bngst,bntd->bngsd", p, vh)
+    out = jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
+    return out, lse.reshape(B * H, 1, S)
+
+
 # ---------------------------------------------------------------------------
 # differentiable entry
 # ---------------------------------------------------------------------------
@@ -360,6 +697,47 @@ def _flash_bwd_rule(causal, interpret, res, g):
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_with_lse(q, k, v, causal=False, interpret=False):
+    """Flash attention that ALSO returns the per-row logsumexp
+    ([B*H, 1, S] f32) as a differentiable output — the building block for
+    blockwise/ring attention, where per-hop (out, lse) pairs are combined
+    with an online softmax. The lse cotangent folds into the standard
+    FA2 backward via delta' = delta - g_lse (d lse_i/d s_ij = p_ij, so
+    ds = p*(dp - delta + g_lse))."""
+    return _flash_fwd_impl(q, k, v, causal, interpret, with_lse=True)
+
+
+def _fwl_fwd_rule(q, k, v, causal, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, interpret, with_lse=True)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _fwl_bwd_rule(causal, interpret, res, g):
+    g_out, g_lse = g
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(q, k, v, out, lse, g_out, causal, interpret,
+                           g_lse=g_lse.astype(jnp.float32))
+
+
+flash_attention_with_lse.defvjp(_fwl_fwd_rule, _fwl_bwd_rule)
+
+
+def attention_with_lse(q, k, v, causal=False):
+    """(out, lse) attention picking pallas when tileable on TPU, else the
+    differentiable XLA reference. Used by distributed.sep ring attention
+    (the blockwise local step SURVEY §5 mandates)."""
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv != 0:
+        raise ValueError(
+            f"query heads ({H}) must be a multiple of key/value heads "
+            f"({Hkv}) for grouped-query attention")
+    if S % 128 != 0 or D % 8 != 0 or jax.default_backend() != "tpu":
+        return _sdpa_reference_with_lse(q, k, v, causal)
+    return flash_attention_with_lse(q, k, v, causal, False)
 
 
 def flash_attention_fwd(q, k, v, causal=False):

@@ -116,3 +116,129 @@ class TestFlashAttention:
         ref = _sdpa_reference(q, kr, vr, True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5)
+
+
+class TestFlashAttentionWithLse:
+    """flash_attention_with_lse: the (out, lse) building block for
+    blockwise/ring attention (VERDICT #4). The lse cotangent must fold
+    into the FA2 backward via delta' = delta - g_lse."""
+
+    def test_lse_matches_reference(self):
+        from paddle_tpu.kernels.flash_attention import (
+            _sdpa_reference_with_lse, flash_attention_with_lse)
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(2, 128, 4, 16).astype(np.float32) * 0.3)
+        k = jnp.asarray(rng.randn(2, 128, 2, 16).astype(np.float32) * 0.3)
+        v = jnp.asarray(rng.randn(2, 128, 2, 16).astype(np.float32) * 0.3)
+        out, lse = flash_attention_with_lse(q, k, v, True, True)
+        ref_out, ref_lse = _sdpa_reference_with_lse(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                                   atol=2e-3)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                                   atol=2e-3)
+
+    def test_lse_cotangent_grads(self):
+        from paddle_tpu.kernels.flash_attention import (
+            _sdpa_reference_with_lse, flash_attention_with_lse)
+        rng = np.random.RandomState(5)
+        q = jnp.asarray(rng.randn(1, 128, 4, 16).astype(np.float32) * 0.3)
+        k = jnp.asarray(rng.randn(1, 128, 2, 16).astype(np.float32) * 0.3)
+        v = jnp.asarray(rng.randn(1, 128, 2, 16).astype(np.float32) * 0.3)
+        wl = jnp.asarray(rng.randn(4, 1, 128).astype(np.float32))
+        wo = jnp.asarray(rng.randn(1, 128, 4, 16).astype(np.float32))
+
+        def loss(fn):
+            def f(q, k, v):
+                out, lse = fn(q, k, v)
+                return jnp.sum(out * wo) + jnp.sum(lse * wl)
+            return f
+
+        g = jax.grad(loss(lambda q, k, v: flash_attention_with_lse(
+            q, k, v, True, True)), argnums=(0, 1, 2))(q, k, v)
+        r = jax.grad(loss(lambda q, k, v: _sdpa_reference_with_lse(
+            q, k, v, True)), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-3)
+
+
+class TestChooseBlocksVmem:
+    def test_stream_flag_tracks_budget(self):
+        """VERDICT weak #7: _choose_blocks must be a real VMEM check, not
+        unchecked arithmetic — long sequences flip to the streaming path."""
+        import os
+        from paddle_tpu.kernels.flash_attention import _choose_blocks
+        bq, bk, stream = _choose_blocks(2048, 128, jnp.bfloat16)
+        assert not stream
+        bq, bk, stream = _choose_blocks(32768, 128, jnp.bfloat16)
+        assert stream
+        os.environ["PT_FLASH_VMEM_MB"] = "0.5"
+        try:
+            _, _, stream = _choose_blocks(2048, 128, jnp.bfloat16)
+            assert stream
+        finally:
+            del os.environ["PT_FLASH_VMEM_MB"]
+
+
+class TestRingAttentionBlockwise:
+    def test_ring_parity_large_local_block(self):
+        """Ring attention at local_S=1024 (2 shards) matches full
+        attention — grads included (lse-combination path)."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.sep import ring_attention
+        from paddle_tpu.kernels.flash_attention import _sdpa_reference
+        mesh = dist.ProcessMesh(shape=[1, 1, 2, 1, 1],
+                                dim_names=["dp", "pp", "sep", "ep", "mp"])
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(1, 2048, 4, 16).astype(np.float32) * 0.3)
+        k = jnp.asarray(rng.randn(1, 2048, 2, 16).astype(np.float32) * 0.3)
+        v = jnp.asarray(rng.randn(1, 2048, 2, 16).astype(np.float32) * 0.3)
+        w = jnp.asarray(rng.randn(1, 2048, 4, 16).astype(np.float32))
+
+        def ring_loss(q, k, v):
+            o = ring_attention(q, k, v, causal=True, mesh=mesh.jax_mesh)
+            return jnp.sum(o * w)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(_sdpa_reference(q, k, v, True) * w)
+
+        lr, gr = jax.value_and_grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        lf, gf = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        assert abs(float(lr) - float(lf)) / abs(float(lf)) < 1e-4
+        for a, b in zip(gr, gf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-3)
+
+
+class TestStreamingKernels:
+    """The double-buffered DMA kernels must be exercised in CI (interpret
+    mode executes pltpu.make_async_copy faithfully): force the stream
+    path via the VMEM budget env and check fwd+grad parity."""
+
+    def test_forced_stream_parity(self, monkeypatch):
+        from paddle_tpu.kernels.flash_attention import (_choose_blocks,
+                                                        _sdpa_reference,
+                                                        flash_attention)
+        monkeypatch.setenv("PT_FLASH_VMEM_MB", "0.01")
+        assert _choose_blocks(128, 16, jnp.float32)[2]  # streaming on
+        rng = np.random.RandomState(9)
+        q = jnp.asarray(rng.randn(2, 128, 4, 16).astype(np.float32) * 0.3)
+        k = jnp.asarray(rng.randn(2, 128, 2, 16).astype(np.float32) * 0.3)
+        v = jnp.asarray(rng.randn(2, 128, 2, 16).astype(np.float32) * 0.3)
+        w = jnp.asarray(rng.randn(2, 128, 4, 16).astype(np.float32))
+        out = flash_attention(q, k, v, True, True)
+        ref = _sdpa_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True, True) * w)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(_sdpa_reference(q, k, v, True) * w)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        r = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-3)
