@@ -20,6 +20,7 @@ TPU-first architecture:
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass, field
 
@@ -172,7 +173,7 @@ def _attention(q, k, v, causal=True):
 
 
 def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint,
-                   mp_axis=None):
+                   mp_axis=None, return_kv=False):
     """One decoder layer on raw arrays. lp = this layer's parameter dict.
 
     ``mp_axis``: inside the manual-pp region GSPMD cannot be steered (no
@@ -225,10 +226,14 @@ def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint,
         up = checkpoint_name(y @ lp["w_up"], "mlp_up")
         x = x + hint(_mp_sum((gate * up) @ lp["w_down"]), "dp", "sep", None)
         penalty = jnp.zeros((), jnp.float32)
+    if return_kv:
+        # post-rope K and V for the decode-time cache (prefill capture)
+        return x, penalty, k, v
     return x, penalty
 
 
-def _moe_mlp(cfg: LlamaConfig, lp: dict, y, mesh_hint, mp_axis=None):
+def _moe_mlp(cfg: LlamaConfig, lp: dict, y, mesh_hint, mp_axis=None,
+             capacity_override=None):
     """Expert-parallel SwiGLU MoE (BASELINE config 5; reference
     moe_layer.py:263 semantics). Sort/scatter dispatch — tokens scatter
     into the [E, C, d] buffer and gather back by slot, no [N, E, C] dense
@@ -240,8 +245,9 @@ def _moe_mlp(cfg: LlamaConfig, lp: dict, y, mesh_hint, mp_axis=None):
     E = cfg.num_experts
     tokens = y.reshape(b * s, d)
     logits = tokens @ lp["router"]
-    capacity = max(1, int(cfg.moe_capacity_factor * b * s
-                          * cfg.num_experts_per_tok / E))
+    capacity = capacity_override or max(
+        1, int(cfg.moe_capacity_factor * b * s
+               * cfg.num_experts_per_tok / E))
     _, gates, slot, aux = moe_route(logits, E, capacity,
                                     cfg.num_experts_per_tok)
     expert_in = moe_permute(tokens, slot, E, capacity)
@@ -264,11 +270,19 @@ def _moe_mlp(cfg: LlamaConfig, lp: dict, y, mesh_hint, mp_axis=None):
     return out.reshape(b, s, d), penalty.astype(jnp.float32)
 
 
-def _scan_layers(cfg, stacked, x, positions, mesh_hint, mp_axis=None):
+def _scan_layers(cfg, stacked, x, positions, mesh_hint, mp_axis=None,
+                 collect_kv=False):
     """Scan the decoder over a stacked [n, ...] parameter tree (full depth
     in the GSPMD path, one stage's local slice inside the pipeline).
-    Returns (x, penalty) with penalty the summed per-layer router aux."""
+    Returns (x, penalty) with penalty the summed per-layer router aux;
+    with ``collect_kv`` also the per-layer post-rope K and V stacks
+    ([L, b, s, kvh, hd]) for the decode cache."""
     def layer_fn(carry, lp):
+        if collect_kv:
+            out, penalty, kk, vv = _decoder_layer(
+                cfg, lp, carry, positions, mesh_hint, mp_axis=mp_axis,
+                return_kv=True)
+            return out, (penalty, kk, vv)
         out, penalty = _decoder_layer(cfg, lp, carry, positions, mesh_hint,
                                       mp_axis=mp_axis)
         return out, penalty
@@ -281,8 +295,11 @@ def _scan_layers(cfg, stacked, x, positions, mesh_hint, mp_axis=None):
             layer_fn = jax.checkpoint(layer_fn, policy=policy)
         else:
             layer_fn = jax.checkpoint(layer_fn)
-    x, penalties = jax.lax.scan(layer_fn, x, stacked)
-    return x, jnp.sum(penalties)
+    x, ys = jax.lax.scan(layer_fn, x, stacked)
+    if collect_kv:
+        penalties, ks, vs = ys
+        return x, jnp.sum(penalties), ks, vs
+    return x, jnp.sum(ys)
 
 
 def _pp_degree(mesh) -> int:
@@ -394,6 +411,8 @@ def _pipelined_layers(cfg, stacked, x, mesh, mesh_hint, stacked_specs=None):
                       str(param_specs[n])) for n in stacked)))
     fn = _PIPELINE_CACHE.get(cache_key)
     if fn is None:
+        if len(_PIPELINE_CACHE) >= 16:  # FIFO bound
+            _PIPELINE_CACHE.pop(next(iter(_PIPELINE_CACHE)))
         # check_vma must stay on: disabling it demotes the region to
         # full-manual over every mesh axis, breaking partial-manual specs
         fn = jax.jit(jax.shard_map(apply, mesh=mesh,
@@ -498,16 +517,23 @@ class LlamaForCausalLM(nn.Layer):
         return base + ["w_gate", "w_up", "w_down"]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=0, seed=0):
+                 top_k=0, seed=0, use_cache=True):
         """Autoregressive sampling (greedy when temperature=0); returns
-        the full [b, s + max_new_tokens] id array as a Tensor."""
+        the full [b, s + max_new_tokens] id array as a Tensor. With
+        ``use_cache`` (default) each new token is an O(1) jitted decode
+        step against a per-layer KV cache (VERDICT #5); the re-encode
+        path remains for pp>1 meshes and as the parity oracle."""
         from ..core import autograd
+        from ..distributed.fleet.mp_layers import current_mesh
         ids = input_ids._value if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
+        if _pp_degree(current_mesh()) > 1:
+            use_cache = False  # decode cache is a single-program path
+        gen = _generate_cached if use_cache else _generate
         with autograd.no_grad():
-            out = _generate(self, ids, int(max_new_tokens),
-                            float(temperature), int(top_k),
-                            jax.random.PRNGKey(seed))
+            out = gen(self, ids, int(max_new_tokens),
+                      float(temperature), int(top_k),
+                      jax.random.PRNGKey(seed))
         return Tensor(out, stop_gradient=True)
 
     def forward(self, input_ids):
@@ -553,26 +579,185 @@ class LlamaForCausalLM(nn.Layer):
 
 
 def _generate(model, input_ids, max_new_tokens, temperature, top_k, key):
-    """Greedy / top-k sampling loop (reference PaddleNLP generation_utils
-    greedy_search/sampling). Each step re-encodes the full prefix — the
-    scan-stacked weights make that one compiled forward per length; a
-    decode-time KV cache is the masked_multihead_attention path
-    (incubate) used by serving stacks."""
+    """Re-encode sampling loop (reference PaddleNLP generation_utils
+    greedy_search/sampling) — the legacy O(S) per-token path, kept as the
+    parity oracle for the KV-cache path and as the fallback for pp>1
+    meshes."""
     ids = input_ids
     for _ in range(max_new_tokens):
         logits = model(Tensor(ids))._value[:, -1, :]     # [b, vocab]
-        if temperature == 0.0:
-            nxt = jnp.argmax(logits, axis=-1)
-        else:
-            logits = logits / temperature
-            if top_k and top_k > 0:
-                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, logits, axis=-1)
+        key, nxt = _sample(logits, temperature, top_k, key)
         ids = jnp.concatenate([ids, nxt[:, None].astype(ids.dtype)],
                               axis=1)
     return ids
+
+
+def _sample(logits, temperature, top_k, key, greedy=None):
+    """greedy must be a STATIC bool when temperature is traced (the
+    jitted decode path passes temperature as an operand so distinct
+    temperatures share one compiled program)."""
+    if greedy is None:
+        greedy = temperature == 0.0  # legacy eager path: python float
+    if greedy:
+        return key, jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    key, sub = jax.random.split(key)
+    return key, jax.random.categorical(sub, logits, axis=-1)
+
+
+def _decode_layer_step(cfg, lp, x, ck, cv, t):
+    """One decoder layer for ONE token at position t against the KV cache
+    (reference: incubate masked_multihead_attention — the serving decode
+    kernel — with a STATIC [b, S_max, kvh, hd] cache updated in place via
+    dynamic_update_slice so the jitted step never reshapes)."""
+    hd = cfg.head_dim
+    h = lp["wq"].shape[-1] // hd
+    kvh = lp["wk"].shape[-1] // hd
+    b = x.shape[0]
+    s_max = ck.shape[1]
+    g = h // kvh
+    pos = jnp.broadcast_to(t, (b, 1))
+
+    y = _rms(x, lp["input_ln"], cfg.rms_norm_eps)
+    q = y @ lp["wq"]
+    k = y @ lp["wk"]
+    v = y @ lp["wv"]
+    if "bq" in lp:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = _rope(q.reshape(b, 1, h, hd), pos, cfg.rope_theta, hd)
+    k = _rope(k.reshape(b, 1, kvh, hd), pos, cfg.rope_theta, hd)
+    v = v.reshape(b, 1, kvh, hd)
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, t, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, t, 0, 0))
+    # grouped single-token attention over the cache, masked to <= t
+    qg = q[:, 0].reshape(b, kvh, g, hd)
+    s = jnp.einsum("bngd,btnd->bngt", qg.astype(jnp.float32),
+                   ck.astype(jnp.float32)) / (hd ** 0.5)
+    mask = jnp.arange(s_max) <= t
+    s = jnp.where(mask[None, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    attn = jnp.einsum("bngt,btnd->bngd", p, cv.astype(jnp.float32))
+    attn = attn.astype(x.dtype).reshape(b, 1, h * hd)
+    x = x + attn @ lp["wo"]
+
+    y = _rms(x, lp["post_ln"], cfg.rms_norm_eps)
+    if cfg.num_experts > 0:
+        # dropless decode routing (serving convention): every choice of
+        # every decoded token fits, so generation never silently skips an
+        # expert — capacity contention is a TRAINING device, and the
+        # re-encode path's contention depends on the whole prefix anyway
+        mlp_out, _ = _moe_mlp(cfg, lp, y, lambda a, spec: a,
+                              capacity_override=b * cfg.num_experts_per_tok)
+        x = x + mlp_out
+    else:
+        gate = jax.nn.silu(y @ lp["w_gate"])
+        x = x + (gate * (y @ lp["w_up"])) @ lp["w_down"]
+    return x, ck, cv
+
+
+def _decode_step(cfg, stacked, embed, final_norm, lm_head, token, cache_k,
+                 cache_v, t):
+    """Jittable single-token step: [b] token ids + [L, b, S_max, kvh, hd]
+    caches -> (logits [b, V], updated caches). O(1) work per token."""
+    x = jnp.take(embed, token, axis=0)[:, None, :]       # [b, 1, d]
+
+    def layer_fn(carry, xs):
+        lp, ck, cv = xs
+        out, ck, cv = _decode_layer_step(cfg, lp, carry, ck, cv, t)
+        return out, (ck, cv)
+
+    x, (cks, cvs) = jax.lax.scan(layer_fn, x, (stacked, cache_k, cache_v))
+    x = _rms(x, final_norm, cfg.rms_norm_eps)
+    logits = (x[:, 0] @ lm_head).astype(jnp.float32)
+    return logits, cks, cvs
+
+
+_GEN_CACHE: dict = {}
+
+
+def _generate_all(cfg, max_new_tokens, greedy, top_k, stacked, embed,
+                  final_norm, lm_head, ids, key, temperature):
+    """One jitted program for the WHOLE generation: prefill (collecting
+    per-layer K/V), then a lax.scan of O(1) decode steps with sampling
+    fused in — a single device execution per generate() call (the
+    per-token host round trip through the TPU tunnel costs ~100ms,
+    dwarfing the 2ms step)."""
+    b, s0 = ids.shape
+    s_max = s0 + max_new_tokens
+    positions = jnp.broadcast_to(jnp.arange(s0)[None, :], (b, s0))
+    if lm_head is None:
+        lm_head = embed.T  # tied embeddings: transpose fuses inside jit
+    temperature = 0.0 if greedy else temperature
+
+    x = jnp.take(embed, ids, axis=0)
+    x, _, ks, vs = _scan_layers(cfg, stacked, x, positions,
+                                lambda a, spec: a, collect_kv=True)
+    x = _rms(x, final_norm, cfg.rms_norm_eps)
+    logits = (x[:, -1] @ lm_head).astype(jnp.float32)
+    L = cfg.num_hidden_layers
+    kvh, hd = ks.shape[-2], ks.shape[-1]
+    cache_k = jnp.zeros((L, b, s_max, kvh, hd), ks.dtype)
+    cache_v = jnp.zeros((L, b, s_max, kvh, hd), vs.dtype)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, ks, (0, 0, 0, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, vs, (0, 0, 0, 0, 0))
+
+    key, first = _sample(logits, temperature, top_k, key, greedy=greedy)
+
+    def body(carry, i):
+        tok, ck, cv, key = carry
+        logits, ck, cv = _decode_step(cfg, stacked, embed, final_norm,
+                                      lm_head, tok, ck, cv, s0 + i)
+        key, nxt = _sample(logits, temperature, top_k, key, greedy=greedy)
+        return (nxt, ck, cv, key), nxt
+
+    if max_new_tokens > 1:
+        (_, _, _, _), toks = jax.lax.scan(
+            body, (first, cache_k, cache_v, key),
+            jnp.arange(max_new_tokens - 1))
+        new = jnp.concatenate([first[None], toks], axis=0)  # [n, b]
+    else:
+        new = first[None]
+    return jnp.concatenate([ids, new.T.astype(ids.dtype)], axis=1)
+
+
+def _generate_cached(model, input_ids, max_new_tokens, temperature, top_k,
+                     key):
+    """KV-cache generation (VERDICT #5): one prefill forward captures the
+    per-layer post-rope K/V stacks; decoding is a fused jitted scan of
+    O(1) steps against the static-shape cache. Dense models are
+    greedy-parity-tested against the re-encode oracle; MoE decode uses
+    DROPLESS routing (serving convention) and can legitimately differ
+    from the oracle, whose capacity contention depends on the whole
+    prefix. The compiled program is cached per (config, shapes,
+    max_new_tokens, greedy, top_k) with FIFO eviction; temperature is a
+    traced operand so it never triggers a recompile."""
+    if max_new_tokens <= 0:
+        return input_ids
+    cfg = model.config
+    names = model._stacked_names()
+    stacked = {n: model._parameters[n]._value for n in names}
+    embed = model._parameters["embed_tokens"]._value
+    final_norm = model._parameters["final_norm"]._value
+    head = model._parameters.get("lm_head")
+    lm_head = head._value if head is not None else None  # None: tied
+
+    greedy = temperature == 0.0
+    cache_key = (_freeze_cfg(cfg), input_ids.shape, max_new_tokens,
+                 greedy, top_k, head is None)
+    fn = _GEN_CACHE.get(cache_key)
+    if fn is None:
+        if len(_GEN_CACHE) >= 16:  # FIFO bound: dicts preserve order
+            _GEN_CACHE.pop(next(iter(_GEN_CACHE)))
+        fn = jax.jit(functools.partial(_generate_all, cfg, max_new_tokens,
+                                       greedy, top_k))
+        _GEN_CACHE[cache_key] = fn
+    return fn(stacked, embed, final_norm, lm_head, input_ids, key,
+              jnp.asarray(temperature, jnp.float32))
 
 
 def llama_loss_fn(model, input_ids, labels):
