@@ -122,20 +122,117 @@ def _single_process(group) -> bool:
         and get_world_size() == 1
 
 
+def _is_subgroup(group):
+    return group is not None and bool(group.ranks) and \
+        len(group.ranks) != get_world_size()
+
+
+def _nonmember(group):
+    """Reference semantics: a rank outside the group returns immediately
+    from its collectives (communication/group.py is_member)."""
+    return _is_subgroup(group) and get_rank() not in group.ranks
+
+
+def _grank(group):
+    """Rank within the group (global rank for the default group)."""
+    if group is None or not group.ranks:
+        return get_rank()
+    return group.get_group_rank(get_rank())
+
+
+def _gsize(group):
+    return group.nranks if group is not None else get_world_size()
+
+
+_GRP_ROUND: dict[int, int] = {}
+
+
+class _KvSubgroup:
+    """Eager SUBGROUP collectives (VERDICT #7): group-local rendezvous in
+    a per-group namespace of the coordinator KV store
+    (``ptpu_grp/{gid}/{round}/{rank}``) — only the group's members enter,
+    so mp/pp/dp-axis eager collectives work cross-process without
+    deadlocking the rest of the world (reference: per-ring comm contexts,
+    process_group.h:47). Exposes the same two primitives the full-world
+    multihost path uses, so every collective above works unchanged.
+    Requires all processes to create groups in the same order (gids must
+    agree — the reference has the same contract)."""
+
+    def __init__(self, group):
+        self.group = group
+
+    def _gather_payloads(self, payload: bytes) -> list[bytes]:
+        import base64
+        from .. import flags
+        from .watchdog import comm_guard
+        client = _kv_client()
+        g = self.group
+        r = _GRP_ROUND.get(g.gid, 0)
+        _GRP_ROUND[g.gid] = r + 1
+        me = get_rank()
+        pre = f"ptpu_grp/{g.gid}/{r}"
+        client.key_value_set(f"{pre}/{me}",
+                             base64.b64encode(payload).decode())
+        timeout_ms = 2000 * int(flags.flag("comm_timeout_seconds"))
+        outs = []
+        with comm_guard("subgroup_gather", f"gid={g.gid} round={r}"):
+            for peer in g.ranks:
+                if peer == me:
+                    outs.append(payload)
+                else:
+                    outs.append(base64.b64decode(
+                        client.blocking_key_value_get(
+                            f"{pre}/{peer}", timeout_ms)))
+        # deferred cleanup with lag 2: a member can only reach round r
+        # after completing round r-1, which required every member's r-1
+        # key, which is only posted after that member completed r-2 — so
+        # by the time anyone starts round r, all r-2 reads are done.
+        if r >= 2:
+            try:
+                client.key_value_delete(f"ptpu_grp/{g.gid}/{r - 2}/{me}")
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+        return outs
+
+    def process_allgather(self, arr):
+        arr = np.asarray(arr)
+        outs = self._gather_payloads(arr.tobytes())
+        return np.stack([np.frombuffer(b, arr.dtype).reshape(arr.shape)
+                         for b in outs])
+
+    def broadcast_one_to_all(self, arr, is_source):
+        arr = np.asarray(arr)
+        # non-source members post ONLY the 1-byte flag — the rendezvous
+        # moves O(group * payload), not O(group^2 * payload), through the
+        # coordinator
+        flag = b"\x01" if is_source else b"\x00"
+        payload = flag + (arr.tobytes() if is_source else b"")
+        outs = self._gather_payloads(payload)
+        for b in outs:
+            if b[:1] == b"\x01":
+                return np.frombuffer(b[1:], arr.dtype).reshape(arr.shape)
+        raise RuntimeError("broadcast: no source rank inside the group")
+
+
 def _mh(group=None):
-    """Multihost collectives are FULL-WORLD (every process must enter);
-    entering with a proper subgroup would deadlock the other ranks, so
-    raise instead (reference subgroups ride per-ring NCCL comms we don't
-    have an eager analogue for yet)."""
-    if group is not None and group.ranks and \
-            len(group.ranks) != get_world_size():
-        raise NotImplementedError(
-            f"eager cross-host collectives support only the default "
-            f"(full-world) group; got subgroup ranks={group.ranks}. Use "
-            f"compiled collectives (fcollectives / shard_map) for "
-            f"per-axis communication.")
+    """Comm backend for eager cross-host collectives: the full world rides
+    jax multihost_utils; proper subgroups ride the KV-store rendezvous
+    (group-local — only members enter)."""
+    if _is_subgroup(group):
+        _kv_client()  # fail fast without a distributed runtime
+        return _KvSubgroup(group)
     from jax.experimental import multihost_utils
     return _Watched(multihost_utils)
+
+
+def _rows_in_group_order(gathered, group):
+    """Collectives index gathered rows by GROUP rank. The KV subgroup path
+    already returns rows in group order; the multihost full-world path
+    stacks rows in GLOBAL process order, which differs when a full-size
+    group lists its ranks in non-ascending order — reindex."""
+    if group is None or not group.ranks or _is_subgroup(group):
+        return gathered
+    return gathered[np.asarray(group.ranks)]
 
 
 class _Watched:
@@ -160,6 +257,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce across processes (reference
     communication/all_reduce.py)."""
     if _single_process(group):
+        return _Task(tensor._value)
+    if _nonmember(group):
         return _Task(tensor._value)
     # cross-host: sum over all processes via global broadcast trick
     mh = _mh(group)
@@ -187,8 +286,11 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if _single_process(group):
         tensor_list.append(Tensor(tensor._value))
         return _Task(tensor._value)
+    if _nonmember(group):
+        return _Task(tensor._value)
     mh = _mh(group)
-    gathered = mh.process_allgather(np.asarray(tensor._value))
+    gathered = _rows_in_group_order(
+        mh.process_allgather(np.asarray(tensor._value)), group)
     for i in range(gathered.shape[0]):
         tensor_list.append(Tensor(jnp.asarray(gathered[i])))
     return _Task(tensor._value)
@@ -197,6 +299,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 def all_gather_object(object_list, obj, group=None):
     if _single_process(group):
         object_list.append(obj)
+        return
+    if _nonmember(group):
         return
     import pickle
     mh = _mh(group)
@@ -214,6 +318,8 @@ def all_gather_object(object_list, obj, group=None):
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     if _single_process(group):
+        return _Task(tensor._value)
+    if _nonmember(group):
         return _Task(tensor._value)
     mh = _mh(group)
     out = mh.broadcast_one_to_all(np.asarray(tensor._value),
@@ -234,14 +340,15 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         if tensor_list:
             tensor._in_place_update(tensor_list[get_rank()]._value)
         return _Task(tensor._value)
+    if _nonmember(group):
+        return _Task(tensor._value)
     mh = _mh(group)
-    rank = get_rank()
     stackd = (np.stack([np.asarray(t._value) for t in tensor_list])
-              if rank == src else
-              np.zeros((get_world_size(),) + tuple(np.asarray(
+              if get_rank() == src else
+              np.zeros((_gsize(group),) + tuple(np.asarray(
                   tensor._value).shape), np.asarray(tensor._value).dtype))
-    out = mh.broadcast_one_to_all(stackd, is_source=rank == src)
-    tensor._in_place_update(jnp.asarray(out[rank]))
+    out = mh.broadcast_one_to_all(stackd, is_source=get_rank() == src)
+    tensor._in_place_update(jnp.asarray(out[_grank(group)]))
     return _Task(tensor._value)
 
 
@@ -253,10 +360,13 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     if _single_process(group):
         out_tensor_list.extend(Tensor(t._value) for t in in_tensor_list)
         return _Task(None)
+    if _nonmember(group):
+        return _Task(None)
     mh = _mh(group)
-    rank = get_rank()
+    rank = _grank(group)
     stacked = np.stack([np.asarray(t._value) for t in in_tensor_list])
-    gathered = mh.process_allgather(stacked)        # [world, world, ...]
+    gathered = _rows_in_group_order(
+        mh.process_allgather(stacked), group)       # [group, group, ...]
     for i in range(gathered.shape[0]):
         out_tensor_list.append(Tensor(jnp.asarray(gathered[i][rank])))
     return _Task(None)
@@ -272,10 +382,13 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
             acc = acc + t._value
         tensor._in_place_update(acc)
         return _Task(tensor._value)
+    if _nonmember(group):
+        return _Task(tensor._value)
     mh = _mh(group)
-    rank = get_rank()
+    rank = _grank(group)
     stacked = np.stack([np.asarray(t._value) for t in tensor_list])
-    gathered = mh.process_allgather(stacked)        # [world, world, ...]
+    gathered = _rows_in_group_order(
+        mh.process_allgather(stacked), group)       # [group, group, ...]
     red = _reduce_gathered(gathered, op)
     tensor._in_place_update(jnp.asarray(red[rank]))
     return _Task(tensor._value)

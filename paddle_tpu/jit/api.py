@@ -63,7 +63,7 @@ class StaticFunction:
     """Callable wrapping jax.jit over the eager code
     (reference program_translator.py:316 StaticFunction)."""
 
-    def __init__(self, function: Callable, input_spec=None, full_graph=True,
+    def __init__(self, function: Callable, input_spec=None, full_graph=False,
                  **kwargs):
         self._raw_fn = function
         from ..nn.layer.layers import Layer
@@ -75,6 +75,14 @@ class StaticFunction:
         self._input_spec = input_spec
         self._jitted = None
         self._state_items: list[tuple[str, Tensor]] = []
+        # graph-break bookkeeping (reference SOT guard/retrace:
+        # jit/sot/opcode_translator/executor/guard.py): jax.jit's
+        # shape/dtype-keyed cache IS the guard — a changed input signature
+        # retraces (see _trace_count); full_graph=False additionally arms
+        # the eager fallback for non-traceable Python
+        self._full_graph = full_graph
+        self._fallback = False
+        self._trace_count = 0
         functools.update_wrapper(self, self._callable)
 
     def _build(self):
@@ -83,6 +91,7 @@ class StaticFunction:
         state_objs = [t for _, t in self._state_items]
 
         def pure(state_vals, rng_key, args, kwargs):
+            self._trace_count += 1  # python side effect: runs at trace time
             originals = [t._value for t in state_objs]
             orig_nodes = [(t._grad_node, t._out_index) for t in state_objs]
             old_key = R.default_generator._key
@@ -126,7 +135,7 @@ class StaticFunction:
                          for a in example_args])
 
     def __call__(self, *args, **kwargs):
-        if not _to_static_enabled[0]:
+        if not _to_static_enabled[0] or self._fallback:
             return self._callable(*args, **kwargs)
         if self._jitted is None:
             self._build()
@@ -138,8 +147,29 @@ class StaticFunction:
         kwargs_vals = jax.tree_util.tree_map(
             lambda x: x._value if isinstance(x, Tensor) else x, kwargs,
             is_leaf=lambda x: isinstance(x, Tensor))
-        out_vals, new_state = self._jitted(state_vals, R.next_key(),
-                                           args_vals, kwargs_vals)
+        try:
+            out_vals, new_state = self._jitted(state_vals, R.next_key(),
+                                               args_vals, kwargs_vals)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError) as e:
+            # graph break: non-traceable Python (data-dependent control
+            # flow, host round trips). The reference's SOT would fall back
+            # to executing the offending bytecode eagerly between traced
+            # subgraphs (opcode_executor.py); the conservative TPU
+            # analogue runs the WHOLE function eagerly from now on.
+            if self._full_graph:
+                raise
+            import warnings
+            warnings.warn(
+                f"to_static: {getattr(self._callable, '__name__', '?')} is "
+                f"not fully traceable ({type(e).__name__}); falling back "
+                f"to eager execution for this function. Use static-safe "
+                f"control flow (paddle.static.nn.cond / lax.cond) to keep "
+                f"it compiled.", RuntimeWarning, stacklevel=2)
+            self._fallback = True
+            return self._callable(*args, **kwargs)
         # buffer updates (e.g. BN running stats) land back in the objects
         for t, v in zip(state_objs, new_state):
             t._value = v

@@ -117,14 +117,14 @@ def main():
     np.testing.assert_allclose(np.asarray(outs2[0]._value),
                                np.asarray(outs2[1]._value), atol=1e-6)
 
-    # subgroup guard: eager cross-host collective with a proper subgroup
-    # must raise, not deadlock
-    g01 = dist.new_group([0])
-    try:
-        dist.all_reduce(paddle.to_tensor([1.0]), group=g01)
-        raise AssertionError("subgroup all_reduce should raise")
-    except NotImplementedError:
-        pass
+    # subgroup collectives (VERDICT #7): a proper 1-of-2 subgroup —
+    # member reduces with itself over the KV rendezvous; the non-member
+    # returns immediately instead of deadlocking
+    g0 = dist.new_group([0])
+    t0 = paddle.to_tensor(np.full((2,), float(rank + 5), np.float32))
+    dist.all_reduce(t0, group=g0)
+    np.testing.assert_allclose(np.asarray(t0._value),
+                               np.full((2,), float(rank + 5)))
 
     dist.barrier()
     print(f"rank {rank}: COMM_OK")
