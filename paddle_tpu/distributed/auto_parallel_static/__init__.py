@@ -60,9 +60,24 @@ class Engine:
 
     # -- build --------------------------------------------------------------
     def prepare(self, inputs_spec=None, labels_spec=None, mesh=None,
-                mode="train"):
+                mode="train", batch_size=8, seq_len=2048):
         """reference prepare:1419 — resolve the mesh, apply sharding
-        config, compile the distributed step."""
+        config, compile the distributed step. ``mode="auto"`` runs the
+        Planner (completion.py:181 analogue): it proposes (dp, mp, pp,
+        zero stage) from the model + device count via the analytic
+        memory/step-time cost model and configures the mesh + sharding
+        accordingly."""
+        if mode == "auto" and mesh is None:
+            import jax
+            from .planner import Planner
+            plan = Planner().plan(self._model, len(jax.devices()),
+                                  batch_size=batch_size, seq_len=seq_len)
+            self.plan = plan
+            mesh = ProcessMesh(shape=plan.mesh_shape,
+                               dim_names=plan.mesh_dim_names)
+            if plan.zero_stage:
+                self._strategy.sharding.enable = True
+                self._strategy.sharding.stage = plan.zero_stage
         self._mesh = mesh or get_mesh()
         if self._mesh is None:
             import jax

@@ -1,0 +1,138 @@
+"""Parallelism planner (reference: auto_parallel/static/completion.py:181
+Completer + tuner/ cost models — rule-based completion over a ProgramDesc;
+auto_tuner/tuner.py prunes and searches degree combinations).
+
+TPU-native collapse: GSPMD already owns per-op sharding propagation, so
+what remains of "completion" is the DECISION — pick (dp, mp, pp, zero
+stage) for a model + world size. The planner enumerates mesh
+factorizations (pruned like the auto-tuner), scores them with an
+analytic memory + step-time cost model, and returns the best feasible
+plan. Engine.prepare(mode="auto") consumes it."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..auto_tuner import _divisors
+
+__all__ = ["Plan", "Planner", "plan_parallelism"]
+
+
+@dataclass
+class Plan:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    zero_stage: int = 0          # 0 = none, 1/2/3 = ZeRO over dp
+    cost: float = float("inf")   # estimated step time (relative units)
+    memory_per_device: float = 0.0
+
+    @property
+    def mesh_shape(self):
+        return [self.dp, self.pp, 1, 1, self.mp]
+
+    @property
+    def mesh_dim_names(self):
+        return ["dp", "pp", "sep", "ep", "mp"]
+
+
+class Planner:
+    """Analytic memory/step-time model.
+
+    Units are relative (bytes and FLOPs scaled by nominal hardware
+    rates); the RANKING is what matters. Knobs mirror the reference cost
+    model inputs (auto_parallel/static/tuner/cost_model):
+
+    - flops_rate:    device matmul throughput (FLOP/s)
+    - hbm_bytes:     per-device memory budget
+    - ici_bw:        interconnect bandwidth for mp/dp collectives (B/s)
+    """
+
+    def __init__(self, hbm_bytes=16e9, flops_rate=197e12, ici_bw=4.5e10,
+                 micro_batches=8):
+        self.hbm = hbm_bytes
+        self.flops = flops_rate
+        self.bw = ici_bw
+        self.n_mb = micro_batches
+
+    # -- model statistics ---------------------------------------------------
+    def _stats(self, model, batch_size, seq_len):
+        cfg = getattr(model, "config", None)
+        n_params = sum(p.size for p in model.parameters())
+        if cfg is not None and hasattr(cfg, "hidden_size"):
+            d = cfg.hidden_size
+            layers = getattr(cfg, "num_hidden_layers", 1)
+        else:
+            d = max(int(n_params ** 0.5) // 64 * 64, 64)
+            layers = 1
+        return n_params, d, layers
+
+    # -- scoring ------------------------------------------------------------
+    def score(self, model, world_size, dp, mp, pp, zero_stage,
+              batch_size, seq_len):
+        """Returns (cost_seconds, mem_bytes) or None if infeasible."""
+        n_params, d, layers = self._stats(model, batch_size, seq_len)
+        if layers % pp != 0 or batch_size % dp != 0:
+            return None
+        if d % mp != 0:
+            return None
+        # memory: bf16 params + fp32 master + 2 fp32 moments; params split
+        # over mp*pp; optimizer state additionally over dp under ZeRO
+        shard = mp * pp
+        opt_shard = shard * (dp if zero_stage >= 1 else 1)
+        param_mem = n_params * 2 / shard + n_params * 4 / \
+            (shard * (dp if zero_stage >= 3 else 1))
+        opt_mem = n_params * 8 / opt_shard
+        # activations: the n_mb boundary tensors jointly cover the whole
+        # per-replica batch (n_mb x [mb/n_mb, s, d] = [mb, s, d]), plus
+        # one microbatch's remat working set (~14 live [mb/n_mb, s, d]
+        # copies per layer-in-stage)
+        mb = batch_size // dp
+        act_mem = (mb * seq_len * d * 4 / mp
+                   + 14 * (mb // min(self.n_mb, mb) or 1)
+                   * seq_len * d * 4 * (layers // pp) / mp)
+        mem = param_mem + opt_mem + act_mem
+        if mem > self.hbm:
+            return None
+        # step time: compute + TP collectives + DP grad allreduce + bubble
+        flops_total = 6.0 * n_params * batch_size * seq_len
+        compute = flops_total / (world_size * self.flops)
+        # per-layer TP allreduce of activations (2 per layer fwd+bwd x2)
+        tp_comm = 0.0 if mp == 1 else \
+            4 * layers * (mb * seq_len * d * 2 / self.bw) * (mp - 1) / mp
+        dp_comm = 0.0 if dp == 1 else \
+            2 * (n_params / (mp * pp)) * 2 / self.bw * (dp - 1) / dp
+        bubble = (pp - 1) / (self.n_mb + pp - 1)
+        cost = (compute + tp_comm + dp_comm) / max(1e-9, 1 - bubble)
+        return cost, mem
+
+    def plan(self, model, world_size, batch_size=8, seq_len=2048,
+             use_zero=True):
+        """Best feasible Plan; raises if nothing fits."""
+        best = None
+        for mp in _divisors(world_size):
+            for pp in _divisors(world_size // mp):
+                dp = world_size // (mp * pp)
+                for stage in ((0, 1, 2, 3) if use_zero and dp > 1 else (0,)):
+                    s = self.score(model, world_size, dp, mp, pp, stage,
+                                   batch_size, seq_len)
+                    if s is None:
+                        continue
+                    cost, mem = s
+                    if best is None or cost < best.cost:
+                        best = Plan(dp=dp, mp=mp, pp=pp, zero_stage=stage,
+                                    cost=cost, memory_per_device=mem)
+        if best is None:
+            raise RuntimeError(
+                f"no feasible (dp, mp, pp) plan for world_size="
+                f"{world_size}: model does not fit {self.hbm / 1e9:.1f} GB "
+                f"per device at any factorization — shrink the model or "
+                f"raise the device count")
+        return best
+
+
+def plan_parallelism(model, world_size, batch_size=8, seq_len=2048,
+                     **planner_kwargs):
+    """Convenience: Planner().plan(...)."""
+    return Planner(**planner_kwargs).plan(model, world_size, batch_size,
+                                          seq_len)

@@ -168,7 +168,6 @@ class _KvSubgroup:
         client = _kv_client()
         g = self.group
         r = _GRP_ROUND.get(g.gid, 0)
-        _GRP_ROUND[g.gid] = r + 1
         me = get_rank()
         pre = f"ptpu_grp/{g.gid}/{r}"
         client.key_value_set(f"{pre}/{me}",
@@ -183,6 +182,10 @@ class _KvSubgroup:
                     outs.append(base64.b64decode(
                         client.blocking_key_value_get(
                             f"{pre}/{peer}", timeout_ms)))
+        # advance the round only after a COMPLETE gather — a timeout must
+        # not desynchronize this member from its peers (same convention
+        # as recv()'s deferred seq increment)
+        _GRP_ROUND[g.gid] = r + 1
         # deferred cleanup with lag 2: a member can only reach round r
         # after completing round r-1, which required every member's r-1
         # key, which is only posted after that member completed r-2 — so
@@ -307,11 +310,11 @@ def all_gather_object(object_list, obj, group=None):
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     # pad to max length across hosts
     n = np.asarray([payload.size])
-    sizes = mh.process_allgather(n).reshape(-1)
+    sizes = _rows_in_group_order(mh.process_allgather(n), group).reshape(-1)
     maxlen = int(sizes.max())
     padded = np.zeros(maxlen, np.uint8)
     padded[:payload.size] = payload
-    all_p = mh.process_allgather(padded)
+    all_p = _rows_in_group_order(mh.process_allgather(padded), group)
     for i in range(all_p.shape[0]):
         object_list.append(pickle.loads(all_p[i][:int(sizes[i])].tobytes()))
 

@@ -43,20 +43,44 @@ class DistributedStrategy:
     def __init__(self):
         self.hybrid_configs = _Config(
             dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
-            sep_degree=1, order=["dp", "pp", "sharding", "sep", "mp"])
+            sep_degree=1, ep_degree=1,
+            order=["dp", "pp", "sharding", "sep", "mp"],
+            # nested per-mode knob blocks (proto mp_configs/pp_configs)
+            mp_configs=_Config(sync_param=False, sync_grad=False,
+                               sync_moment=False, sync_mode="broadcast"),
+            pp_configs=_Config(dp_comm_overlap=False,
+                               delay_scale_loss=False,
+                               enable_timer=False,
+                               sharding_comm_overlap=False,
+                               release_gradients=False))
         self.amp = False
-        self.amp_configs = _Config(init_loss_scaling=32768.0, use_pure_fp16=False,
-                                   use_fp16_guard=True, custom_white_list=[],
-                                   custom_black_list=[])
+        self.amp_configs = _Config(
+            init_loss_scaling=32768.0, use_pure_fp16=False,
+            use_pure_bf16=False, use_fp16_guard=True, use_bf16_guard=False,
+            custom_white_list=[], custom_black_list=[],
+            custom_black_varnames=[], use_dynamic_loss_scaling=True,
+            incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+            incr_ratio=2.0, decr_ratio=0.5, use_optimizer_fp16=False)
         self.recompute = False
-        self.recompute_configs = _Config(checkpoints=[])
+        self.recompute_configs = _Config(checkpoints=[],
+                                         enable_offload=False,
+                                         checkpoint_shape=[])
         self.sharding = False
-        self.sharding_configs = _Config(stage=1, degree=8,
-                                        segment_broadcast_MB=32.0)
+        self.sharding_configs = _Config(
+            stage=1, degree=8, segment_broadcast_MB=32.0,
+            sharding_segment_strategy="segment_broadcast_MB",
+            segment_anchors=[], sharding_degree=8, mp_degree=1,
+            hybrid_dp=False, gradient_merge_acc_step=1, optimize_offload=False,
+            pp_allreduce_in_optimize=False, pp_degree=1,
+            optimize_cast=False, _dp_as_optimizer_sharding=False,
+            comm_overlap=False)
         self.pipeline = False
         self.pipeline_configs = _Config(accumulate_steps=1,
                                         micro_batch_size=1,
-                                        schedule_mode="1F1B")
+                                        schedule_mode="1F1B",
+                                        virtual_pp_degree=1,
+                                        enable_partial_send_recv=True,
+                                        p2p_cache_shape=True)
         self.tensor_parallel = False
         self.tensor_parallel_configs = _Config(tensor_parallel_degree=1)
         self.gradient_merge = False
@@ -117,6 +141,50 @@ class DistributedStrategy:
         self.semi_auto = False
         self.auto_search = False
         self.sync_nccl_allreduce = True
+        # remaining proto fields (distributed_strategy.proto): kept so
+        # reference recipes set them without error — GPU-runtime tuning
+        # XLA owns on TPU, plus the PS table schema (ps/ is in-memory
+        # here; the table/accessor params are stored verbatim)
+        self.hierarchical_allreduce_inter_nranks = 1
+        self.use_hierarchical_allreduce = False
+        self.fuse_grad_size_in_num = 8
+        self.calc_comm_same_stream = False
+        self.enable_backward_optimizer_op_deps = True
+        self.enable_auto_fusion = False
+        self.cache_runtime_context = False
+        self.fuse_bn_add_act_ops = False
+        self.fuse_gemm_epilogue = False
+        self.fused_attention = False
+        self.fused_feedforward = False
+        self.allow_cuda_graph_capture = False
+        self.fix_op_run_order = False
+        self.split_data = True
+        self.tensor_init_seed = -1
+        self.scale_gradient = False
+        self.launch_barrier = True
+        self.is_fl_ps_mode = False
+        self.with_coordinator = False
+        self.use_ps_gpu = False
+        self.adam_d2sum = False
+        self.downpour_table_param = _Config(
+            table_id=0, table_class="", shard_num=1000, table_name="",
+            accessor=_Config(accessor_class="CtrCommonAccessor", fea_dim=0,
+                             embedx_dim=8, embedx_threshold=10,
+                             ctr_accessor_param=_Config(
+                                 nonclk_coeff=0.1, click_coeff=1.0,
+                                 base_threshold=1.5, delta_threshold=0.25,
+                                 delta_keep_days=16,
+                                 show_click_decay_rate=0.98,
+                                 delete_threshold=0.8,
+                                 delete_after_unseen_days=30,
+                                 ssd_unseenday_threshold=1),
+                             embed_sgd_param=_Config(name="SparseAdaGradSGDRule"),
+                             embedx_sgd_param=_Config(name="SparseAdaGradSGDRule")))
+        self.trainer_desc_configs = _Config(dump_fields_path="",
+                                            dump_fields=[], dump_param=[],
+                                            stat_var_names=[])
+        self.fs_client_param = _Config(uri="", user="", passwd="",
+                                       hadoop_bin="")
         self.cudnn_exhaustive_search = False  # XLA autotunes on TPU
         self.cudnn_batchnorm_spatial_persistent = False
         self.conv_workspace_size_limit = 512

@@ -145,6 +145,16 @@ class PipelineLayer(nn.Layer):
 # ---------------------------------------------------------------------------
 # Compiled SPMD pipeline schedule
 # ---------------------------------------------------------------------------
+def safe_psum(x, axis_name):
+    """psum that sidesteps an XLA CPU crash: the AllReducePromotion pass
+    check-fails ("Invalid binary instruction opcode copy") cloning a bf16
+    all-reduce from these manual-region programs. TPU handles bf16
+    all-reduce natively; on CPU promote to f32 around the psum."""
+    if x.dtype == jnp.bfloat16 and jax.default_backend() == "cpu":
+        return lax.psum(x.astype(jnp.float32), axis_name).astype(x.dtype)
+    return lax.psum(x, axis_name)
+
+
 def interleave_permutation(n_layers: int, n_stages: int,
                            interleave: int) -> list[int]:
     """Layer permutation mapping natural order to the interleaved layout:
@@ -271,7 +281,7 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatch: int,
         # results live on the last stage; broadcast so every pp rank returns
         # the same outputs (psum over one-hot)
         mask = (stage == n_stages - 1).astype(outputs.dtype)
-        outputs = lax.psum(outputs * mask, axis_name)
+        outputs = safe_psum(outputs * mask, axis_name)
         if has_aux:
             # every rank's active ticks contributed its own layers' aux
             return outputs, lax.psum(aux_acc, axis_name)

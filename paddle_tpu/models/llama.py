@@ -192,7 +192,8 @@ def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint,
         return mesh_hint(a, spec)
 
     def _mp_sum(a):
-        return jax.lax.psum(a, mp_axis) if mp_axis is not None else a
+        from ..distributed.fleet.pipeline import safe_psum
+        return safe_psum(a, mp_axis) if mp_axis is not None else a
 
     # attention block
     y = _rms(x, lp["input_ln"], cfg.rms_norm_eps)
@@ -256,7 +257,8 @@ def _moe_mlp(cfg: LlamaConfig, lp: dict, y, mesh_hint, mp_axis=None,
     up = jnp.einsum("ecd,edf->ecf", expert_in, lp["we_up"])
     expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["we_down"])
     if mp_axis is not None:  # manual row-parallel over the ff contraction
-        expert_out = jax.lax.psum(expert_out, mp_axis)
+        from ..distributed.fleet.pipeline import safe_psum
+        expert_out = safe_psum(expert_out, mp_axis)
     expert_out = mesh_hint(expert_out, ("ep", None, None))
     out = moe_unpermute(expert_out, slot, gates, b * s).astype(y.dtype)
     # router penalty (VERDICT #2: the aux loss was computed then DROPPED):
@@ -380,6 +382,12 @@ def _pipelined_layers(cfg, stacked, x, mesh, mesh_hint, stacked_specs=None):
             lambda a: jnp.take(a, perm, axis=0), stacked)
     apply = spmd_pipeline(stage_fn, pp, n_mb, axis_name="pp", interleave=v,
                           has_aux=True)
+    in_dtype = x.dtype
+    if x.dtype == jnp.bfloat16 and jax.default_backend() == "cpu":
+        # XLA CPU's AllReducePromotion pass check-fails on the bf16
+        # all-reduce that the implicit pbroadcast of x_mb transposes to
+        # (see fleet.pipeline.safe_psum); carry boundaries in f32 there
+        x = x.astype(jnp.float32)
     x_mb = x.reshape(n_mb, mb, s, d)
 
     def _manual_part(ax):
@@ -422,7 +430,7 @@ def _pipelined_layers(cfg, stacked, x, mesh, mesh_hint, stacked_specs=None):
         _PIPELINE_CACHE[cache_key] = fn
     out, aux = fn(stacked, x_mb)
     # per-microbatch aux terms are token-means; average over microbatches
-    return out.reshape(b, s, d), aux / n_mb
+    return out.reshape(b, s, d).astype(in_dtype), aux / n_mb
 
 
 @defop("llama_forward")

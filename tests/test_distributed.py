@@ -873,6 +873,133 @@ class TestPipelineScheduleV2:
         assert loss.shape in ([], [1])
 
 
+class TestStrategyDrivenCompilation:
+    """VERDICT #8: DistributedStrategy knobs must ALTER the compiled
+    DistTrainStep, not just be stored."""
+
+    def _recipe(self):
+        """A PaddleNLP-style llama recipe dict, used unmodified."""
+        return {
+            "dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+            "amp": {"use_pure_fp16": False,
+                    "custom_black_list": ["softmax"]},
+            "recompute": {"granularity": "core_attn"},
+            "gradient_merge": {"k_steps": 2, "avg": True},
+            "pipeline": {"accumulate_steps": 4, "virtual_pp_degree": 2},
+        }
+
+    def _strategy(self, recipe):
+        st = dist.fleet.DistributedStrategy()
+        st.hybrid_configs = {**st.hybrid_configs,
+                             "dp_degree": recipe["dp_degree"],
+                             "mp_degree": recipe["mp_degree"],
+                             "pp_degree": recipe["pp_degree"]}
+        st.amp = True
+        st.amp_configs.update(recipe["amp"])
+        st.recompute = True
+        st.recompute_configs.update(recipe["recompute"])
+        st.gradient_merge = True
+        st.gradient_merge_configs.update(recipe["gradient_merge"])
+        st.pipeline = True
+        st.pipeline_configs.update(recipe["pipeline"])
+        return st
+
+    def test_recipe_runs_and_steers_model_config(self):
+        from paddle_tpu.models.llama import LlamaConfig, LLAMA_PRESETS, \
+            LlamaForCausalLM, llama_loss_fn
+        paddle.seed(2)
+        cfg = LlamaConfig(**LLAMA_PRESETS["tiny"])
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        st = self._strategy(self._recipe())
+        step = dist.DistTrainStep.from_strategy(
+            model, opt, llama_loss_fn, st, donate=False)
+        # knobs landed in the model config (observable compiled effects)
+        assert cfg.recompute and cfg.recompute_granularity == "core_attn"
+        assert cfg.pp_num_microbatches == 4
+        assert cfg.pp_interleave == 2
+        assert step.mesh.shape == [2, 2, 1, 1, 2]
+        ids = paddle.to_tensor(
+            np.random.randint(0, 1024, (8, 32), dtype=np.int32))
+        l1 = float(step(ids, ids))
+        l2 = float(step(ids, ids))
+        assert np.isfinite(l1) and l2 < l1
+
+    def test_gradient_merge_matches_manual_accumulation(self):
+        """k_steps=2 inside the jitted step == two manual half-batch
+        backwards with averaged grads + one update."""
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_loss_fn
+        mesh = dist.ProcessMesh(shape=[1, 1, 1, 1, 1],
+                                dim_names=["dp", "pp", "sep", "ep", "mp"])
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (4, 16), dtype=np.int32))
+
+        paddle.seed(5)
+        ref = LlamaForCausalLM("debug")
+        ropt = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=ref.parameters())
+        for sl in (slice(0, 2), slice(2, 4)):
+            sub = paddle.to_tensor(np.asarray(ids._value)[sl])
+            (llama_loss_fn(ref, sub, sub) / 2).backward()
+        ropt.step()
+        ropt.clear_grad()
+
+        paddle.seed(5)
+        model = LlamaForCausalLM("debug")
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        st = dist.fleet.DistributedStrategy()
+        st.gradient_merge = True
+        st.gradient_merge_configs.update({"k_steps": 2, "avg": True})
+        step = dist.DistTrainStep(model, opt, llama_loss_fn, mesh,
+                                  donate=False, strategy=st)
+        step(ids, ids)
+        for (n, p), (_, rp) in zip(model.named_parameters(),
+                                   ref.named_parameters()):
+            assert np.allclose(_np(p), _np(rp), atol=1e-5), n
+
+    def test_amp_knob_changes_compiled_dtypes(self):
+        """strategy.amp must put bf16 matmuls into the compiled program."""
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_loss_fn
+        mesh = dist.ProcessMesh(shape=[1, 1, 1, 1, 1],
+                                dim_names=["dp", "pp", "sep", "ep", "mp"])
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (2, 16), dtype=np.int32))
+
+        def lowered_text(amp_on):
+            paddle.seed(5)
+            model = LlamaForCausalLM("debug")
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=model.parameters())
+            st = dist.fleet.DistributedStrategy()
+            st.amp = amp_on
+            step = dist.DistTrainStep(model, opt, llama_loss_fn, mesh,
+                                      donate=False, strategy=st)
+            step(ids, ids)
+            return step._jitted.lower(
+                [p._value for p in step._params],
+                [b._value for b in step._buffers],
+                {k: list(v) for k, v in opt._accumulators.items()},
+                jax.random.PRNGKey(0), jnp.asarray(0, jnp.int32),
+                jnp.asarray(0.1, jnp.float32),
+                (ids._value, ids._value)).as_text()
+
+        assert "bf16" in lowered_text(True)
+        assert "bf16" not in lowered_text(False)
+
+    def test_proto_surface_accepts_reference_recipe_keys(self):
+        st = dist.fleet.DistributedStrategy()
+        # a sample of proto fields reference recipes set
+        st.amp_configs["use_dynamic_loss_scaling"] = False
+        st.sharding_configs["sharding_segment_strategy"] = "segment_anchors"
+        st.pipeline_configs["enable_partial_send_recv"] = False
+        st.hybrid_configs["pp_configs"]["dp_comm_overlap"] = True
+        st.downpour_table_param["accessor"]["embedx_dim"] = 16
+        st.trainer_desc_configs["dump_fields"] = ["loss"]
+        assert st.hybrid_configs["pp_configs"]["dp_comm_overlap"]
+
+
 class TestPipelineSepComposition:
     def test_pp_with_sep_axis_runs(self):
         """pp>1 + sep>1: the pipeline stage must fall back to gathered

@@ -189,3 +189,88 @@ class TestText:
                                    float(s_short._value[0]), rtol=1e-5)
         assert tuple(np.asarray(p_full._value)[0][:2]) == \
             tuple(np.asarray(p_short._value)[0])
+
+
+class TestPlannerAndMeasuredTuning:
+    """VERDICT #9: a minimal Completer/Planner proposes (dp, mp, pp,
+    sharding) from model + world size via a memory/FLOPs cost model, and
+    the auto-tuner gains a measure hook that runs REAL trial steps."""
+
+    def test_planner_proposes_feasible_plan(self):
+        from paddle_tpu.distributed.auto_parallel_static.planner import (
+            Planner)
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        model = LlamaForCausalLM("tiny")
+        plan = Planner().plan(model, 8, batch_size=8, seq_len=256)
+        assert plan.dp * plan.mp * plan.pp == 8
+        assert plan.cost < float("inf")
+        assert plan.memory_per_device > 0
+        assert model.config.num_hidden_layers % plan.pp == 0
+
+    def test_planner_memory_pressure_forces_model_sharding(self):
+        """With a budget barely above params/dev, pure DP (full replica
+        per device) must be infeasible and the plan must split the model
+        (mp*pp > 1 or ZeRO-3)."""
+        from paddle_tpu.distributed.auto_parallel_static.planner import (
+            Planner)
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        model = LlamaForCausalLM("tiny")
+        n_params = sum(p.size for p in model.parameters())
+        tight = Planner(hbm_bytes=n_params * 14 * 0.3)
+        plan = tight.plan(model, 8, batch_size=8, seq_len=256)
+        assert plan.mp * plan.pp > 1 or plan.zero_stage == 3
+        # and an impossible budget raises with a clear message
+        import pytest
+        with pytest.raises(RuntimeError, match="no feasible"):
+            Planner(hbm_bytes=1000).plan(model, 8, batch_size=8,
+                                         seq_len=256)
+
+    def test_engine_prepare_auto_mode(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_loss_fn
+        paddle.seed(0)
+        model = LlamaForCausalLM("debug")
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        engine = dist.Engine(model=model, loss=None, optimizer=opt)
+        # llama takes (ids) and Engine's loss_fn convention is (out, y) —
+        # supply a causal-LM loss through the loss hook
+        engine._loss = lambda out, y: paddle.nn.functional.cross_entropy(
+            out[:, :-1, :].reshape([-1, out.shape[-1]]),
+            y[:, 1:].reshape([-1]))
+        engine.prepare(mode="auto", batch_size=8, seq_len=32)
+        assert engine.plan.dp * engine.plan.mp * engine.plan.pp == 8
+        ids = np.random.randint(0, 128, (8, 32), dtype=np.int32)
+        loss = engine._step(paddle.to_tensor(ids), paddle.to_tensor(ids))
+        assert np.isfinite(float(loss))
+
+    def test_tuner_measures_real_trials(self):
+        from paddle_tpu.distributed.auto_tuner import (AutoTuner,
+                                                       trial_runner)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_loss_fn
+
+        def model_factory():
+            paddle.seed(3)
+            return LlamaForCausalLM("debug")
+
+        def make_batch():
+            ids = paddle.to_tensor(
+                np.random.randint(0, 128, (8, 32), dtype=np.int32))
+            return ids, ids
+
+        runner = trial_runner(model_factory, llama_loss_fn, make_batch,
+                              warmup=1, iters=1)
+        tuner = AutoTuner({
+            "world_size": 8,
+            "model_cfg": {"num_attention_heads": 4, "hidden_size": 64,
+                          "num_layers": 2, "global_batch_size": 8},
+            "micro_batch_size": [8],
+            "sharding_stage": [0],
+            "use_recompute": [False],
+            "task_limit": 3,
+        })
+        best = tuner.tune(runner)
+        assert best is not None and best["time"] > 0
+        measured = [h for h in tuner.recorder.history
+                    if h.get("time") is not None]
+        assert len(measured) >= 1  # real steps actually ran
