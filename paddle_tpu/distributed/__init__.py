@@ -39,7 +39,8 @@ from . import fleet  # noqa: F401
 from .fleet.sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .auto_parallel import shard_layer, shard_optimizer, to_static_dist  # noqa: F401
 from . import checkpoint  # noqa: F401
-from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from .checkpoint import (save_state_dict, load_state_dict,  # noqa: F401
+                         AutoCheckpoint)
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "is_initialized",

@@ -134,3 +134,161 @@ class DistributedSaver:
 
     def load(self, path, state_dict, **kwargs):
         return load_state_dict(state_dict, path)
+
+
+class AutoCheckpoint:
+    """Auto-checkpoint keyed to the elastic store (reference:
+    base/incubate/checkpoint/auto_checkpoint.py:70 TrainEpochRange — save
+    periodically, record progress in etcd, resume after relaunch).
+
+    - ``step(n)``: every ``every_n_steps`` (or ``interval_seconds``),
+      snapshot model (+ optimizer) state ASYNC and, once the write
+      completes, record {step, path} in the elastic KV store — a crashed
+      write never advertises a half checkpoint.
+    - ``resume()``: on (re)launch, read the store and restore the latest
+      complete snapshot into the live tensors; returns the recorded step
+      (0 when starting fresh). The elastic relaunch contract (exit 101 →
+      manager restarts workers) plus resume() gives crash-resume without
+      user code.
+    """
+
+    def __init__(self, name, model, optimizer=None, save_dir=None,
+                 store=None, every_n_steps=0, interval_seconds=0.0,
+                 keep_last=2):
+        import time
+        from .fleet.elastic import FileKVStore
+        self.name = name
+        self.model = model
+        self.optimizer = optimizer
+        self.save_dir = save_dir or os.path.join(
+            os.environ.get("PADDLE_AUTO_CKPT_DIR", "./auto_ckpt"), name)
+        self.store = store if store is not None else FileKVStore(
+            os.environ.get("PADDLE_ELASTIC_STORE",
+                           os.path.join(self.save_dir, "_store")))
+        self.every_n_steps = int(every_n_steps)
+        self.interval_seconds = float(interval_seconds)
+        self.keep_last = keep_last
+        self._key = f"ptpu_ckpt/{name}"
+        self._last_time = time.time()
+        self._inflight = None
+        self._watcher = None
+
+    # -- state --------------------------------------------------------------
+    def _state(self):
+        """Tensor state (model tensors restore in place; optimizer slot
+        wrappers are handed back through set_state_dict on resume);
+        non-tensor optimizer scalars (global_step, LR_Scheduler) ride the
+        KV record. _ensure_state() makes the slot tree exist on a fresh
+        relaunch so the saved/restored orbax trees match."""
+        state = {f"model.{k}": v
+                 for k, v in self.model.state_dict().items()}
+        scalars = {}
+        opt_tensors = {}
+        if self.optimizer is not None:
+            self.optimizer._ensure_state()
+            for k, v in self.optimizer.state_dict().items():
+                if isinstance(v, Tensor):
+                    state[f"opt.{k}"] = v
+                    opt_tensors[k] = v
+                else:
+                    scalars[k] = v
+        return state, scalars, opt_tensors
+
+    def _due(self, step):
+        import time
+        if self.every_n_steps and step % self.every_n_steps == 0:
+            return True
+        if self.interval_seconds and \
+                time.time() - self._last_time >= self.interval_seconds:
+            return True
+        return False
+
+    # -- save ---------------------------------------------------------------
+    def step(self, step):
+        """Call once per train step; checkpoints when due. Returns the
+        AsyncSaveHandle when a save started, else None."""
+        if not self._due(step):
+            return None
+        return self.save(step)
+
+    def save(self, step):
+        import threading
+        import time
+        # gate on BOTH the write thread and the record thread: a stale
+        # record thread publishing after a newer one would roll the store
+        # back to a (possibly GC'd) older snapshot
+        if (self._inflight is not None and not self._inflight.done()) or \
+                (self._watcher is not None and self._watcher.is_alive()):
+            return None                      # previous snapshot still writing
+        self._last_time = time.time()
+        path = os.path.join(self.save_dir, f"step_{int(step)}")
+        state, scalars, _ = self._state()
+        handle = save_state_dict(state, path, async_save=True)
+        self._inflight = handle
+        box = {"exc": None}
+
+        def record():
+            try:
+                handle.wait()
+                # advertise only COMPLETE snapshots
+                self.store.put(self._key,
+                               {"step": int(step), "path": path,
+                                "opt_scalars": scalars})
+                self._gc(int(step))
+            except BaseException as e:  # noqa: BLE001 — re-raised in wait()
+                box["exc"] = e
+
+        self._watch_box = box
+        self._watcher = threading.Thread(target=record, daemon=False)
+        self._watcher.start()
+        return handle
+
+    def _gc(self, newest_step):
+        """Keep the latest ``keep_last`` snapshots."""
+        import re
+        import shutil
+        try:
+            steps = sorted(
+                int(m.group(1))
+                for m in (re.match(r"step_(\d+)$", d)
+                          for d in os.listdir(self.save_dir))
+                if m)
+            for s in steps[:-self.keep_last]:
+                if s != newest_step:
+                    shutil.rmtree(
+                        os.path.join(self.save_dir, f"step_{s}"),
+                        ignore_errors=True)
+        except OSError:
+            pass
+
+    def wait(self):
+        """Join the in-flight snapshot; re-raises a failed write (a
+        silently lost checkpoint must not look like success)."""
+        if self._watcher is not None:
+            self._watcher.join()
+            exc = getattr(self, "_watch_box", {}).get("exc")
+            if exc is not None:
+                raise exc
+
+    # -- resume -------------------------------------------------------------
+    def resume(self):
+        """Restore the last recorded snapshot; returns its step (0 if
+        none). Called at (re)launch before the train loop. Model tensors
+        restore in place; optimizer slots + scalars (global_step,
+        LR_Scheduler) go through set_state_dict, so moments and schedules
+        survive the relaunch."""
+        rec = self.store.get(self._key)
+        if not rec:
+            return 0
+        state, _, opt_tensors = self._state()
+        load_state_dict(state, rec["path"])    # tensors restore in place
+        if self.optimizer is not None:
+            # the state_dict() wrappers now hold the restored arrays;
+            # set_state_dict writes them back into the live accumulators
+            merged = dict(opt_tensors)
+            merged.update(rec.get("opt_scalars") or {})
+            self.optimizer.set_state_dict(merged)
+        return int(rec["step"])
+
+
+__all__ += ["AutoCheckpoint"]

@@ -11,6 +11,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "AutoCheckpointCallback",
            "LRScheduler", "EarlyStopping", "ReduceLROnPlateau"]
 
 
@@ -150,6 +151,50 @@ class ModelCheckpoint(Callback):
     def on_train_end(self, logs=None):
         if self.save_dir:
             self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class AutoCheckpointCallback(Callback):
+    """hapi wiring for distributed.checkpoint.AutoCheckpoint (reference
+    auto_checkpoint.py TrainEpochRange used inside fit loops): async
+    snapshots every ``every_n_steps``, progress recorded in the elastic
+    store; call ``resume()`` (or read .start_step after on_train_begin)
+    to continue after a relaunch."""
+
+    def __init__(self, name, every_n_steps=100, interval_seconds=0.0,
+                 save_dir=None, store=None):
+        super().__init__()
+        self._name = name
+        self._every = every_n_steps
+        self._interval = interval_seconds
+        self._save_dir = save_dir
+        self._store = store
+        self._auto = None
+        self._global_step = 0
+        self.start_step = 0
+
+    def _ensure(self):
+        if self._auto is None:
+            from ..distributed.checkpoint import AutoCheckpoint
+            net = getattr(self.model, "network", self.model)
+            opt = getattr(self.model, "_optimizer", None)
+            self._auto = AutoCheckpoint(
+                self._name, net, optimizer=opt, save_dir=self._save_dir,
+                store=self._store, every_n_steps=self._every,
+                interval_seconds=self._interval)
+
+    def on_train_begin(self, logs=None):
+        self._ensure()
+        self.start_step = self._auto.resume()
+        self._global_step = self.start_step
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        self._auto.step(self._global_step)
+
+    def on_train_end(self, logs=None):
+        if self._auto is not None:
+            self._auto.save(self._global_step)
+            self._auto.wait()
 
 
 class LRScheduler(Callback):

@@ -124,6 +124,12 @@ class Model:
                         ["loss"] + [m.name() for m in self._metrics]})
         self.stop_training = False
         cbk.on_train_begin()
+        # crash-resume: a callback (AutoCheckpointCallback) may report
+        # already-completed work after on_train_begin; skip those steps so
+        # the relaunched fit doesn't double-train (reference
+        # auto_checkpoint.py TrainEpochRange skips completed epochs)
+        start_step = max((getattr(c, "start_step", 0) for c in cbks),
+                         default=0)
         history = {"loss": []}
         step_count = 0
         for epoch in range(epochs):
@@ -131,6 +137,9 @@ class Model:
             self._reset_metrics()
             logs = {}
             for step, batch in enumerate(loader):
+                if step_count < start_step:
+                    step_count += 1         # completed before the relaunch
+                    continue
                 cbk.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
                 losses, outs = self._train_one(

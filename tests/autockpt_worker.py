@@ -1,0 +1,66 @@
+"""Kill-and-relaunch worker for the auto-checkpoint test (reference:
+base/incubate/checkpoint/auto_checkpoint.py — training resumes from the
+last etcd-recorded snapshot after a crash).
+
+Usage: python autockpt_worker.py <workdir> <crash_at_step|-1>
+Trains 10 steps of a tiny regression; checkpoints every 2 steps; exits
+hard (os._exit(101), the elastic relaunch code) at the crash step. On
+relaunch, resume() must land on a recorded step > 0 and finish.
+Prints: RESUMED_AT <n> and DONE <final_step> <loss>.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distributed.checkpoint import AutoCheckpoint  # noqa: E402
+
+
+def main():
+    workdir, crash_at = sys.argv[1], int(sys.argv[2])
+    paddle.seed(0)
+    model = paddle.nn.Linear(8, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    from paddle_tpu.distributed.fleet.elastic import FileKVStore
+    auto = AutoCheckpoint("reg", model, optimizer=opt,
+                          save_dir=f"{workdir}/ckpt",
+                          store=FileKVStore(f"{workdir}/store"),
+                          every_n_steps=2)
+    start = auto.resume()
+    print(f"RESUMED_AT {start}", flush=True)
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(64, 8).astype(np.float32)
+    w_true = rng.randn(8, 1).astype(np.float32)
+    Y = X @ w_true
+
+    loss = None
+    for step in range(start + 1, 11):
+        x = paddle.to_tensor(X)
+        y = paddle.to_tensor(Y)
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        h = auto.step(step)
+        if h is not None:
+            auto.wait()               # deterministic test: join the record
+        if step == crash_at:
+            import os
+            os._exit(101)             # elastic relaunch contract
+    print(f"DONE {10} {float(loss):.6f} gstep {opt._global_step}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1095,3 +1095,139 @@ class TestCheckNanInf:
                 jax.block_until_ready(jax.jit(f)(jnp.full((2,), 1e30)))
         finally:
             paddle.set_flags({"check_nan_inf": False})
+
+
+class TestAutoCheckpoint:
+    """VERDICT #10: async orbax save + TTL auto-checkpoint keyed to the
+    elastic store; relaunch resumes from the last COMPLETE snapshot."""
+
+    def test_kill_and_relaunch_resumes_step(self, tmp_path):
+        import subprocess, sys, os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        worker = os.path.join(root, "tests", "autockpt_worker.py")
+        # first run crashes hard at step 6 (after the step-6 snapshot)
+        r1 = subprocess.run([sys.executable, worker, str(tmp_path), "6"],
+                            capture_output=True, text=True, timeout=180,
+                            cwd=root)
+        assert r1.returncode == 101, r1.stdout + r1.stderr
+        assert "RESUMED_AT 0" in r1.stdout
+        # relaunch: must resume from the recorded step (6) and finish
+        r2 = subprocess.run([sys.executable, worker, str(tmp_path), "-1"],
+                            capture_output=True, text=True, timeout=180,
+                            cwd=root)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+        assert "RESUMED_AT 6" in r2.stdout, r2.stdout
+        assert "DONE 10" in r2.stdout
+
+    def test_auto_checkpoint_records_only_complete_snapshots(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+        from paddle_tpu.distributed.fleet.elastic import FileKVStore
+        paddle.seed(1)
+        model = nn.Linear(4, 2)
+        store = FileKVStore(str(tmp_path / "store"))
+        auto = AutoCheckpoint("m", model, save_dir=str(tmp_path / "ck"),
+                              store=store, every_n_steps=1)
+        assert auto.resume() == 0          # fresh start
+        auto.step(1)
+        auto.wait()
+        rec = store.get("ptpu_ckpt/m")
+        assert rec and rec["step"] == 1
+        # mutate weights, resume, weights restored
+        w0 = _np(model.weight).copy()
+        with paddle.no_grad():
+            model.weight.fill_(123.0)
+        assert auto.resume() == 1
+        np.testing.assert_allclose(_np(model.weight), w0, atol=1e-6)
+
+    def test_adam_moments_and_scheduler_survive_relaunch(self, tmp_path):
+        """Optimizer slots restore through set_state_dict into the LIVE
+        accumulators (fresh wrappers from state_dict() don't reach them),
+        and the LR scheduler state rides the KV record."""
+        from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+        from paddle_tpu.distributed.fleet.elastic import FileKVStore
+        store = FileKVStore(str(tmp_path / "store"))
+
+        def make():
+            paddle.seed(3)
+            m = nn.Linear(4, 2)
+            sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                                  step_size=2)
+            o = paddle.optimizer.Adam(learning_rate=sched,
+                                      parameters=m.parameters())
+            return m, o
+
+        m1, o1 = make()
+        x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        for _ in range(3):
+            (m1(x) ** 2).mean().backward()
+            o1.step()
+            o1.clear_grad()
+            o1._lr_scheduler.step()
+        auto1 = AutoCheckpoint("adam", m1, optimizer=o1,
+                               save_dir=str(tmp_path / "ck"), store=store,
+                               every_n_steps=1)
+        auto1.step(3)
+        auto1.wait()
+        mom = np.asarray(o1._accumulators["moment1"][0])
+
+        # fresh process analogue: new model + optimizer, resume
+        m2, o2 = make()
+        auto2 = AutoCheckpoint("adam", m2, optimizer=o2,
+                               save_dir=str(tmp_path / "ck"), store=store,
+                               every_n_steps=1)
+        assert auto2.resume() == 3
+        np.testing.assert_allclose(
+            np.asarray(o2._accumulators["moment1"][0]), mom, atol=1e-7)
+        assert o2._global_step == 3
+        assert o2._lr_scheduler.last_epoch == o1._lr_scheduler.last_epoch
+
+    def test_gc_keeps_last_snapshots(self, tmp_path):
+        import os
+        from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+        from paddle_tpu.distributed.fleet.elastic import FileKVStore
+        model = nn.Linear(4, 2)
+        store = FileKVStore(str(tmp_path / "store"))
+        auto = AutoCheckpoint("m", model, save_dir=str(tmp_path / "ck"),
+                              store=store, every_n_steps=1, keep_last=2)
+        for s in (1, 2, 3, 4):
+            auto.step(s)
+            auto.wait()
+        kept = sorted(d for d in os.listdir(str(tmp_path / "ck"))
+                      if d.startswith("step_"))
+        assert kept == ["step_3", "step_4"], kept
+
+    def test_hapi_callback_resumes(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import FileKVStore
+        from paddle_tpu.hapi.callbacks import AutoCheckpointCallback
+        import paddle_tpu.hapi as hapi
+
+        class DS:
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                x = np.full((8,), float(i % 4), np.float32)
+                return x, x[:1]
+
+        store = FileKVStore(str(tmp_path / "store"))
+
+        def run():
+            paddle.seed(0)
+            net = nn.Linear(8, 1)
+            model = hapi.Model(net)
+            model.prepare(paddle.optimizer.SGD(
+                learning_rate=0.01, parameters=net.parameters()),
+                nn.MSELoss())
+            cb = AutoCheckpointCallback("h", every_n_steps=2,
+                                        save_dir=str(tmp_path / "ck"),
+                                        store=store)
+            model.fit(DS(), batch_size=8, epochs=1, callbacks=[cb],
+                      verbose=0)
+            return cb
+
+        cb1 = run()
+        assert cb1.start_step == 0
+        cb2 = run()                       # second fit resumes from store
+        assert cb2.start_step > 0
+        # resumed fit must SKIP completed steps, not double-train
+        assert cb2._global_step == cb1._global_step
