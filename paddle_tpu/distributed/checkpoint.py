@@ -228,14 +228,19 @@ class AutoCheckpoint:
         box = {"exc": None}
 
         def record():
+            from ..utils.log import log_event
             try:
                 handle.wait()
                 # advertise only COMPLETE snapshots
                 self.store.put(self._key,
                                {"step": int(step), "path": path,
                                 "opt_scalars": scalars})
+                log_event("checkpoint_saved", name=self.name,
+                          step=int(step), path=path)
                 self._gc(int(step))
             except BaseException as e:  # noqa: BLE001 — re-raised in wait()
+                log_event("checkpoint_failed", name=self.name,
+                          step=int(step), error=str(e))
                 box["exc"] = e
 
         self._watch_box = box
@@ -277,18 +282,48 @@ class AutoCheckpoint:
         restore in place; optimizer slots + scalars (global_step,
         LR_Scheduler) go through set_state_dict, so moments and schedules
         survive the relaunch."""
+        import re
+        from ..utils.log import log_event
         rec = self.store.get(self._key)
         if not rec:
+            log_event("checkpoint_resume", name=self.name, step=0,
+                      fresh=True)
             return 0
-        state, _, opt_tensors = self._state()
-        load_state_dict(state, rec["path"])    # tensors restore in place
-        if self.optimizer is not None:
-            # the state_dict() wrappers now hold the restored arrays;
-            # set_state_dict writes them back into the live accumulators
-            merged = dict(opt_tensors)
-            merged.update(rec.get("opt_scalars") or {})
-            self.optimizer.set_state_dict(merged)
-        return int(rec["step"])
+        # candidate snapshots: the recorded one first, then any older
+        # on-disk dirs — a lost snapshot (cleaned node-local disk, cwd
+        # change) must degrade to an older one or a fresh start, NOT a
+        # crash loop inside the crash-recovery feature
+        candidates = [(int(rec["step"]), rec["path"])]
+        try:
+            for d in sorted(os.listdir(self.save_dir), reverse=True):
+                m = re.match(r"step_(\d+)$", d)
+                p = os.path.join(self.save_dir, d)
+                if m and p != rec["path"]:
+                    candidates.append((int(m.group(1)), p))
+        except OSError:
+            pass
+        for step, path in candidates:
+            try:
+                state, _, opt_tensors = self._state()
+                load_state_dict(state, path)   # tensors restore in place
+            except Exception as e:  # noqa: BLE001 — try older snapshots
+                log_event("checkpoint_resume_failed", name=self.name,
+                          step=step, path=path, error=str(e))
+                continue
+            if self.optimizer is not None:
+                # the state_dict() wrappers now hold the restored arrays;
+                # set_state_dict writes them back into live accumulators
+                merged = dict(opt_tensors)
+                merged.update(rec.get("opt_scalars") or {})
+                if step != int(rec["step"]):
+                    merged["global_step"] = step  # older-snapshot fallback
+                self.optimizer.set_state_dict(merged)
+            log_event("checkpoint_resume", name=self.name, step=step,
+                      path=path, fresh=False)
+            return step
+        log_event("checkpoint_resume", name=self.name, step=0, fresh=True,
+                  note="recorded snapshots unreadable; starting fresh")
+        return 0
 
 
 __all__ += ["AutoCheckpoint"]

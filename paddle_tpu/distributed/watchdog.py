@@ -81,6 +81,8 @@ class CommWatchdog:
                         "elapsed_s": round(now - t.start, 1),
                         "detail": t.detail}
                 self.timed_out.append(info)
+                from ..utils.log import log_event
+                log_event("comm_timeout", **info)
                 print(f"[comm watchdog] collective {t.name!r} outstanding "
                       f"{info['elapsed_s']}s (> {self.timeout_s}s) on "
                       f"thread {t.thread} {t.detail} — a peer is likely "

@@ -193,7 +193,8 @@ class AutoCheckpointCallback(Callback):
 
     def on_train_end(self, logs=None):
         if self._auto is not None:
-            self._auto.save(self._global_step)
+            self._auto.wait()   # drain an in-flight periodic save first —
+            self._auto.save(self._global_step)  # else the gate drops this
             self._auto.wait()
 
 

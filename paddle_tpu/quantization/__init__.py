@@ -21,7 +21,7 @@ from ..core.tensor import Tensor
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
            "FakeQuanterWithAbsMaxObserver", "quant", "dequant",
-           "QuantedLinear"]
+           "QuantedLinear", "EMAObserver", "PercentileObserver"]
 
 
 # ---------------------------------------------------------------------------
@@ -207,9 +207,124 @@ class QAT:
         return model
 
 
+class EMAObserver:
+    """Moving-average absmax calibration (reference
+    FakeQuanterWithAbsMaxObserver's EMA, observe-only): scale tracks
+    ema <- rate*ema + (1-rate)*absmax(batch)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._ema = None
+
+    def observe(self, x):
+        import jax.numpy as jnp
+        v = x._value if hasattr(x, "_value") else jnp.asarray(x)
+        m = float(jnp.max(jnp.abs(v)))
+        self._ema = m if self._ema is None else \
+            self.moving_rate * self._ema + (1 - self.moving_rate) * m
+        return x
+
+    def scale(self):
+        # convention: scale == clip RANGE (absmax), as in AbsmaxObserver
+        return self._ema or 1e-9
+
+    def _instance(self, layer):
+        import copy
+        return copy.deepcopy(self)
+
+
+class PercentileObserver:
+    """Percentile calibration (reference KL/hist observers' purpose:
+    clip activation outliers instead of letting one spike set the
+    absmax scale). Keeps a bounded reservoir of |x| samples and uses
+    the q-th percentile as the clipping range."""
+
+    def __init__(self, quant_bits=8, percentile=99.9, max_samples=1 << 16):
+        self.quant_bits = quant_bits
+        self.percentile = percentile
+        self.max_samples = max_samples
+        self._samples = []
+        self._count = 0
+
+    def observe(self, x):
+        import numpy as np
+        v = np.abs(np.asarray(x._value if hasattr(x, "_value") else x)
+                   ).reshape(-1)
+        if v.size > 4096:                      # bound per-batch cost
+            idx = np.random.default_rng(self._count).choice(
+                v.size, 4096, replace=False)
+            v = v[idx]
+        self._count += 1
+        self._samples.append(v)
+        total = sum(s.size for s in self._samples)
+        while total > self.max_samples and len(self._samples) > 1:
+            total -= self._samples.pop(0).size
+        return x
+
+    def scale(self):
+        import numpy as np
+        if not self._samples:
+            return 1e-9
+        allv = np.concatenate(self._samples)
+        # convention: scale == clip RANGE (absmax), as in AbsmaxObserver
+        return max(float(np.percentile(allv, self.percentile)), 1e-9)
+
+    def _instance(self, layer):
+        import copy
+        return copy.deepcopy(self)
+
+
+class _CalibrationQuanter:
+    """Observe-only during calibration; fake-quant with the FROZEN scale
+    after freeze() (PTQ semantics: calibration must see the raw float
+    activations, reference ptq.py)."""
+
+    def __init__(self, observer):
+        self.observer = observer
+        self.frozen_scale = None
+        self.disabled = False
+
+    def __call__(self, x):
+        if self.disabled:
+            return x
+        if self.frozen_scale is None:
+            return self.observer.observe(x)
+        return _fake_quant_t(x, self.frozen_scale,
+                             self.observer.quant_bits)
+
+    def freeze(self):
+        scale = self.observer.scale()
+        if scale <= 2e-9:
+            # never observed (layer not exercised during calibration):
+            # quantizing with a degenerate scale would clamp activations
+            # to ~0 — pass through instead and tell the user
+            import warnings
+            warnings.warn(
+                "PTQ convert: an activation observer collected no "
+                "calibration data (layer never ran during calibrate()); "
+                "leaving that layer's activations UN-quantized",
+                RuntimeWarning, stacklevel=3)
+            self.disabled = True
+            return
+        self.frozen_scale = scale
+
+
+def _fake_quant_t(x, scale, bits):
+    from ..core.dispatch import apply_op
+    return apply_op("fake_quant",
+                    lambda v: _fake_quant(v, scale, bits), (x,), {})
+
+
 class PTQ:
     """reference ptq.py PTQ — observe activations on calibration data,
-    then convert with fixed scales."""
+    then convert with fixed scales. Workflow:
+
+        q = PTQ(QuantConfig(activation=PercentileObserver(), weight=...))
+        m = q.quantize(model)
+        q.calibrate(m, calib_batches)   # raw float forwards, observers see
+        m = q.convert(m)                # freeze scales + bake weights
+    """
 
     def __init__(self, config: QuantConfig):
         self.config = config
@@ -219,12 +334,40 @@ class PTQ:
             import copy
             model = copy.deepcopy(model)
         model = _wrap_layers(model, self.config)
-        # PTQ: weight scales fixed immediately; activation quanters observe
         for layer in model.sublayers(include_self=True):
             if isinstance(layer, QuantedLinear):
+                # weights: scale fixed immediately (data-independent)
                 if layer.weight_quanter is not None:
-                    layer.weight_quanter(layer.weight)  # set scale now
+                    layer.weight_quanter(layer.weight)
+                # activations: observe-only until convert()
+                aq = layer.activation_quanter
+                if aq is not None and hasattr(aq, "observe"):
+                    layer.activation_quanter = _CalibrationQuanter(aq)
+        return model
+
+    def calibrate(self, model, data, steps=None):
+        """Run calibration forwards (no quantization applied yet); the
+        activation observers collect ranges."""
+        from ..core import autograd
+        with autograd.no_grad():
+            for i, batch in enumerate(data):
+                xs = batch if isinstance(batch, (list, tuple)) else [batch]
+                model(*xs)
+                if steps is not None and i + 1 >= steps:
+                    break
         return model
 
     def convert(self, model, inplace=False):
-        return QAT(self.config).convert(model, inplace)
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, QuantedLinear):
+                aq = layer.activation_quanter
+                if isinstance(aq, _CalibrationQuanter):
+                    aq.freeze()                # fixed scales from here on
+                if layer.weight_quanter is not None:
+                    q = layer.weight_quanter(layer.weight)
+                    layer.weight._in_place_update(q._value)
+                    layer.weight_quanter = None
+        return model

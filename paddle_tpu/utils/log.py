@@ -1,0 +1,107 @@
+"""Structured logging / observability layer (SURVEY §5 item 57;
+reference: base/log_helper.py get_logger + glog VLOG levels + the
+launch/elastic loggers writing per-rank files).
+
+Two surfaces:
+- :func:`get_logger` — classic python logger with the reference's
+  format, level from ``GLOG_v`` (0=warning, 1=info, 2+=debug).
+- :class:`EventLog` — STRUCTURED JSON-lines events (step metrics, comm
+  timeouts, checkpoint saves/resumes, elastic transitions). One line per
+  event: {"ts": ..., "event": ..., "rank": ..., **fields}. Sinks:
+  stderr, a file (PADDLE_LOG_DIR/events.rank{N}.jsonl), or any callable;
+  in-memory ring buffer for tests/tools. Subsystems emit through
+  :func:`log_event` so operators can grep ONE stream for what the
+  runtime did."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from collections import deque
+
+__all__ = ["get_logger", "EventLog", "log_event", "default_event_log"]
+
+_FMT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def _glog_level() -> int:
+    try:
+        v = int(os.environ.get("GLOG_v", "0"))
+    except ValueError:
+        v = 0
+    return {0: logging.WARNING, 1: logging.INFO}.get(v, logging.DEBUG)
+
+
+def get_logger(name, level=None, fmt=_FMT):
+    """reference base/log_helper.py:20 — a configured logger that does
+    not propagate into the root logger."""
+    logger = logging.getLogger(name)
+    logger.setLevel(level if level is not None else _glog_level())
+    if not logger.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(fmt))
+        logger.addHandler(h)
+    logger.propagate = False
+    return logger
+
+
+class EventLog:
+    """JSON-lines structured event stream with an in-memory ring."""
+
+    def __init__(self, path=None, stream=None, ring_size=1024):
+        self._path = path
+        self._stream = stream
+        self._file = None
+        self.ring = deque(maxlen=ring_size)
+        self._sinks = []
+
+    def add_sink(self, fn):
+        """fn(record_dict) — e.g. a metrics exporter."""
+        self._sinks.append(fn)
+        return fn
+
+    def _rank(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    def emit(self, event: str, **fields):
+        rec = {"ts": round(time.time(), 3), "event": event,
+               "rank": self._rank(), **fields}
+        self.ring.append(rec)
+        line = json.dumps(rec, default=str)
+        if self._stream is not None:
+            print(line, file=self._stream, flush=True)
+        if self._path:
+            if self._file is None:
+                os.makedirs(os.path.dirname(self._path) or ".",
+                            exist_ok=True)
+                self._file = open(self._path, "a")
+            self._file.write(line + "\n")
+            self._file.flush()
+        for s in self._sinks:
+            try:
+                s(rec)
+            except Exception:  # noqa: BLE001 — sinks must not break training
+                pass
+        return rec
+
+    def events(self, event=None):
+        return [r for r in self.ring if event is None or r["event"] == event]
+
+
+def _default_path():
+    d = os.environ.get("PADDLE_LOG_DIR")
+    if not d:
+        return None
+    return os.path.join(
+        d, f"events.rank{os.environ.get('PADDLE_TRAINER_ID', '0')}.jsonl")
+
+
+default_event_log = EventLog(path=_default_path())
+
+
+def log_event(event: str, **fields):
+    """Emit to the process-default structured event log."""
+    return default_event_log.emit(event, **fields)

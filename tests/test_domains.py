@@ -376,3 +376,61 @@ class TestMoreVisionModels:
         lg = mobilenet_v3_large(scale=0.35, num_classes=2)
         lg.eval()
         assert list(lg(paddle.randn([1, 3, 64, 64])).shape) == [1, 2]
+
+
+class TestPTQCalibration:
+    """PTQ calibration (inventory item 33 depth): observe-only
+    calibration, frozen scales at convert, and outlier-robust observers."""
+
+    def _model(self):
+        paddle.seed(0)
+        return paddle.nn.Sequential(paddle.nn.Linear(16, 32),
+                                    paddle.nn.ReLU(),
+                                    paddle.nn.Linear(32, 4))
+
+    def test_ptq_calibrate_freeze_convert(self):
+        from paddle_tpu.quantization import (PTQ, QuantConfig, EMAObserver,
+                                             FakeQuanterWithAbsMaxObserver,
+                                             QuantedLinear)
+        from paddle_tpu.quantization import _CalibrationQuanter
+        m = self._model()
+        q = PTQ(QuantConfig(activation=EMAObserver(),
+                            weight=FakeQuanterWithAbsMaxObserver()))
+        qm = q.quantize(m)
+        x = paddle.randn([8, 16])
+        ref = _np(m(x))
+        # calibration forwards: weights fake-quanted (8-bit error only),
+        # activations OBSERVE-only (raw float through the matmuls)
+        out_cal = _np(qm(x))
+        assert np.abs(out_cal - ref).max() < 0.2
+        q.calibrate(qm, [(x,)] * 3)
+        qm = q.convert(qm)
+        for layer in qm.sublayers(include_self=True):
+            if isinstance(layer, QuantedLinear):
+                aq = layer.activation_quanter
+                assert isinstance(aq, _CalibrationQuanter)
+                assert aq.frozen_scale is not None and aq.frozen_scale > 0
+                assert layer.weight_quanter is None  # baked
+        # converted model still close to float reference (8-bit error)
+        out_q = _np(qm(x))
+        assert np.abs(out_q - ref).max() < 0.35
+
+    def test_percentile_observer_robust_to_outliers(self):
+        from paddle_tpu.quantization import (AbsmaxObserver,
+                                             PercentileObserver)
+        rng = np.random.RandomState(0)
+        data = rng.randn(4096).astype(np.float32)
+        data[0] = 1000.0                       # one spike
+        t = paddle.to_tensor(data)
+        absx = AbsmaxObserver()
+        absx.observe(t)
+        pct = PercentileObserver(percentile=99.0)
+        pct.observe(t)
+        # absmax range is blown up by the outlier; percentile is not
+        assert absx.scale() > 500.0
+        assert pct.scale() < 5.0
+        # and the percentile range quantizes the BULK better
+        def err(rng_):
+            q = np.clip(np.round(data / rng_ * 127), -127, 127) * rng_ / 127
+            return np.abs(q - data)[1:].mean()  # exclude the spike
+        assert err(pct.scale()) < err(absx.scale()) / 10

@@ -111,3 +111,49 @@ class TestBenchmarkTimer:
 
     def test_global_singleton(self):
         assert profiler.benchmark() is profiler.benchmark()
+
+
+class TestStructuredLogging:
+    """SURVEY §5 item 57: one structured JSON-lines event stream for the
+    runtime (comm timeouts, checkpoint lifecycle, custom events)."""
+
+    def test_event_log_ring_file_and_sinks(self, tmp_path):
+        import json
+        from paddle_tpu.utils.log import EventLog
+        p = str(tmp_path / "ev.jsonl")
+        log = EventLog(path=p)
+        seen = []
+        log.add_sink(seen.append)
+        log.emit("train_step", step=1, loss=2.5)
+        log.emit("train_step", step=2, loss=2.1)
+        log.emit("other", x=1)
+        assert len(log.events("train_step")) == 2
+        assert seen[0]["loss"] == 2.5 and "ts" in seen[0]
+        lines = [json.loads(l) for l in open(p)]
+        assert [l["event"] for l in lines] == ["train_step", "train_step",
+                                               "other"]
+
+    def test_checkpoint_events_emitted(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+        from paddle_tpu.distributed.fleet.elastic import FileKVStore
+        from paddle_tpu.utils.log import default_event_log
+        default_event_log.ring.clear()
+        m = paddle.nn.Linear(4, 2)
+        auto = AutoCheckpoint("ev", m, save_dir=str(tmp_path / "ck"),
+                              store=FileKVStore(str(tmp_path / "st")),
+                              every_n_steps=1)
+        auto.step(1)
+        auto.wait()
+        auto.resume()
+        evs = [r["event"] for r in default_event_log.ring]
+        assert "checkpoint_saved" in evs
+        assert "checkpoint_resume" in evs
+
+    def test_glog_level_logger(self, monkeypatch):
+        import logging
+        from paddle_tpu.utils import log as L
+        monkeypatch.setenv("GLOG_v", "2")
+        lg = L.get_logger("ptpu_test_logger")
+        assert lg.level == logging.DEBUG
+        assert lg.propagate is False
