@@ -289,19 +289,29 @@ class AutoCheckpoint:
             log_event("checkpoint_resume", name=self.name, step=0,
                       fresh=True)
             return 0
-        # candidate snapshots: the recorded one first, then any older
-        # on-disk dirs — a lost snapshot (cleaned node-local disk, cwd
-        # change) must degrade to an older one or a fresh start, NOT a
-        # crash loop inside the crash-recovery feature
-        candidates = [(int(rec["step"]), rec["path"])]
+        # candidate snapshots: the recorded one first, then OLDER on-disk
+        # dirs newest-first (numeric order — lexicographic would try
+        # step_8 before step_10). Dirs NEWER than the record are
+        # partial writes that were never advertised; never touch them.
+        # A lost snapshot must degrade to an older one or a fresh start,
+        # NOT a crash loop inside the crash-recovery feature.
+        rec_step = int(rec["step"])
+        candidates = [(rec_step, rec["path"])]
         try:
-            for d in sorted(os.listdir(self.save_dir), reverse=True):
+            older = []
+            for d in os.listdir(self.save_dir):
                 m = re.match(r"step_(\d+)$", d)
                 p = os.path.join(self.save_dir, d)
-                if m and p != rec["path"]:
-                    candidates.append((int(m.group(1)), p))
+                if m and p != rec["path"] and int(m.group(1)) < rec_step:
+                    older.append((int(m.group(1)), p))
+            candidates += sorted(older, reverse=True)
         except OSError:
             pass
+        # a failed partial restore must not leave mixed weights: snapshot
+        # the live arrays (immutable jax refs — cheap) for rollback
+        pre_state, _, _ = self._state()
+        pre_vals = {k: v._value for k, v in pre_state.items()
+                    if isinstance(v, Tensor)}
         for step, path in candidates:
             try:
                 state, _, opt_tensors = self._state()
@@ -309,14 +319,20 @@ class AutoCheckpoint:
             except Exception as e:  # noqa: BLE001 — try older snapshots
                 log_event("checkpoint_resume_failed", name=self.name,
                           step=step, path=path, error=str(e))
+                for k, v in pre_vals.items():  # roll back partial loads
+                    pre_state[k]._value = v
                 continue
             if self.optimizer is not None:
                 # the state_dict() wrappers now hold the restored arrays;
                 # set_state_dict writes them back into live accumulators
                 merged = dict(opt_tensors)
                 merged.update(rec.get("opt_scalars") or {})
-                if step != int(rec["step"]):
-                    merged["global_step"] = step  # older-snapshot fallback
+                if step != rec_step:
+                    # older-snapshot fallback: the record's scheduler
+                    # state belongs to the LOST step — drop it rather
+                    # than desynchronize weights and schedule
+                    merged["global_step"] = step
+                    merged.pop("LR_Scheduler", None)
                 self.optimizer.set_state_dict(merged)
             log_event("checkpoint_resume", name=self.name, step=step,
                       path=path, fresh=False)

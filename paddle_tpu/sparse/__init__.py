@@ -365,9 +365,45 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     return Tensor(u[:, :q]), Tensor(s[:q]), Tensor(vt[:q].T)
 
 
+__all__ += ["conjugate", "transjugate", "svd_lowrank"]
 __all__ += ["asin", "asinh", "atan", "atanh", "sinh", "tan", "expm1",
             "log1p", "square", "deg2rad", "rad2deg", "isnan", "cast",
             "reshape", "mv", "addmm", "pca_lowrank"]
+
+
+def conjugate(x, name=None):
+    """reference sparse/unary.py conjugate — elementwise conj on values."""
+    return _unary("conjugate", jnp.conjugate)(x)
+
+
+def transjugate(x, name=None):
+    """reference unary.py transjugate — conj(transpose(x))."""
+    nd = len(x.shape)
+    perm = list(range(nd - 2)) + [nd - 1, nd - 2]
+    return conjugate(transpose(x, perm))
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """reference unary.py svd_lowrank — randomized low-rank SVD (Halko);
+    like pca_lowrank without centering, optional mean subtraction M."""
+    a = x.to_dense()._value if isinstance(
+        x, (SparseCooTensor, SparseCsrTensor)) else _v(x)
+    if M is not None:
+        a = a - _v(M)
+    import jax as _jax
+    from ..ops import random as _random
+    m, n = a.shape[-2], a.shape[-1]
+    q = min(q, m, n)
+    p_over = min(n, q + 4)
+    omega = _jax.random.normal(_random.next_key(), (n, p_over),
+                               dtype=jnp.float32).astype(a.dtype)
+    y = a @ omega
+    for _ in range(max(niter, 1)):
+        y, _ = jnp.linalg.qr(a @ (a.T @ y))
+    qmat, _ = jnp.linalg.qr(y)
+    b = qmat.T @ a
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    return Tensor(qmat @ u_b[:, :q]), Tensor(s[:q]), Tensor(vt[:q].T)
 
 
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001

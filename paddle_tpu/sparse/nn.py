@@ -35,3 +35,144 @@ class Softmax:
             sm = sm / jnp.maximum(sm.sum(-1, keepdims=True), 1e-38)
             return SparseCsrTensor(jsparse.BCSR.fromdense(sm))
         raise TypeError("sparse.nn.Softmax expects a SparseCsrTensor")
+
+
+from . import nn_functional as functional  # noqa: E402
+
+
+from .. import nn as _dense_nn
+
+
+class _ConvNd(_dense_nn.Layer):
+    """Base for sparse conv layers (reference sparse/nn/layer/conv.py):
+    weight layout [*kernel, C_in/groups, C_out]. A real nn.Layer so the
+    parameters register with optimizers/state_dict."""
+
+    _nd = 3
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 key=None):
+        super().__init__()
+        import numpy as np
+        from ..core.tensor import Parameter
+        from ..ops import random as _random
+        import jax
+        k = (kernel_size,) * self._nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        shape = k + (in_channels // groups, out_channels)
+        fan_in = in_channels * int(np.prod(k))
+        w = jax.random.normal(_random.next_key(), shape) * (
+            2.0 / fan_in) ** 0.5
+        self.weight = Parameter(w.astype("float32"), trainable=True)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = Parameter(
+                jax.numpy.zeros((out_channels,), "float32"),
+                trainable=True)
+        self.stride, self.padding = stride, padding
+        self.dilation, self.groups = dilation, groups
+
+    def forward(self, x):
+        fn = {
+            (2, False): functional.conv2d, (3, False): functional.conv3d,
+            (2, True): functional.subm_conv2d,
+            (3, True): functional.subm_conv3d,
+        }[(self._nd, self._subm)]
+        return fn(x, self.weight, self.bias, self.stride, self.padding,
+                  self.dilation, self.groups)
+
+
+class Conv3D(_ConvNd):
+    """reference sparse/nn/layer/conv.py Conv3D:239 (NDHWC)."""
+    _nd, _subm = 3, False
+
+
+class Conv2D(_ConvNd):
+    """reference conv.py Conv2D:374."""
+    _nd, _subm = 2, False
+
+
+class SubmConv3D(_ConvNd):
+    """reference conv.py SubmConv3D:509 — output keeps input sparsity."""
+    _nd, _subm = 3, True
+
+
+class SubmConv2D(_ConvNd):
+    """reference conv.py SubmConv2D:649."""
+    _nd, _subm = 2, True
+
+
+class BatchNorm(_dense_nn.Layer):
+    """reference sparse/nn/layer/norm.py BatchNorm — normalizes the
+    ACTIVE values per channel (dense zeros excluded from statistics).
+    A real nn.Layer: weight/bias train, running stats checkpoint."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        import jax.numpy as jnp
+        from ..core.tensor import Parameter, Tensor
+        self.eps = epsilon
+        self.momentum = momentum
+        self.weight = Parameter(jnp.ones((num_features,)), trainable=True)
+        self.bias = Parameter(jnp.zeros((num_features,)), trainable=True)
+        self._mean = Tensor(jnp.zeros((num_features,)),
+                            stop_gradient=True)
+        self._variance = Tensor(jnp.ones((num_features,)),
+                                stop_gradient=True)
+        self.register_buffer("_mean", self._mean)
+        self.register_buffer("_variance", self._variance)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        from . import SparseCooTensor, _dense_to_coo
+        dense = x.to_dense()._value if isinstance(x, SparseCooTensor) \
+            else _v(x)
+        active = (dense != 0).any(axis=-1)
+        flat = dense.reshape(-1, dense.shape[-1])
+        amask = active.reshape(-1)
+        n = jnp.maximum(amask.sum(), 1)
+        if self.training:
+            mean = (flat * amask[:, None]).sum(0) / n
+            var = (((flat - mean) ** 2) * amask[:, None]).sum(0) / n
+            m = self.momentum
+            self._mean._value = m * self._mean._value + (1 - m) * mean
+            self._variance._value = (m * self._variance._value
+                                     + (1 - m) * var)
+        else:
+            mean, var = self._mean._value, self._variance._value
+        norm = (dense - mean) * jax.lax.rsqrt(var + self.eps)
+        out = norm * self.weight._value + self.bias._value
+        out = jnp.where(active[..., None], out, 0.0)
+        return _dense_to_coo(Tensor(out))
+
+
+class MaxPool3D(_dense_nn.Layer):
+    """reference sparse/nn/layer/pooling.py MaxPool3D (NDHWC)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+
+    def forward(self, x):
+        return functional.max_pool3d(x, self.k, self.s, self.p)
+
+
+def _v(x):
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+import jax  # noqa: E402
+
+__all__ += ["functional", "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D",
+            "BatchNorm", "MaxPool3D"]

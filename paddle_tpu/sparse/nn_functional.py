@@ -1,0 +1,152 @@
+"""paddle_tpu.sparse.nn.functional (reference:
+python/paddle/sparse/nn/functional/ — activation.py, transformer.py
+attention:22, conv.py, pooling.py).
+
+TPU-native: sparse attention masks the dense QK^T with the CSR layout
+(XLA fuses mask+softmax+matmul; the reference's CUDA csr kernels
+exist to avoid materializing QK^T — at TPU tile sizes the masked dense
+form IS the fast path for the seq lengths this API targets); sparse
+conv/pool run the dense lowering with active-site masking (SubmConv
+keeps the input's sparsity pattern, matching the submanifold
+semantics)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["attention", "relu", "softmax", "conv2d", "conv3d",
+           "subm_conv2d", "subm_conv3d", "max_pool3d"]
+
+
+def _v(x):
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+def relu(x, name=None):
+    from . import relu as _relu
+    return _relu(x)
+
+
+def softmax(x, axis=-1, name=None):
+    from .nn import Softmax
+    return Softmax(axis)(x)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """reference transformer.py attention:22 — softmax(QK^T/sqrt(d))V
+    restricted to ``sparse_mask``'s CSR layout. query/key/value:
+    [b, h, s, d] dense; sparse_mask: [b*h, s, s] or [s, s] CSR whose
+    NONZERO pattern is the allowed attention layout. Returns a dense
+    [b, h, s, d] Tensor."""
+    from ..core.tensor import Tensor
+    from . import SparseCsrTensor
+    q = _v(query)
+    k = _v(key)
+    v = _v(value)
+    b, h, s, d = q.shape
+    if not isinstance(sparse_mask, SparseCsrTensor):
+        raise TypeError("sparse_mask must be a SparseCsrTensor")
+    mask = sparse_mask._m.todense() != 0
+    mask = jnp.broadcast_to(mask.reshape((-1, s, s))[-(b * h):]
+                            if mask.ndim == 3 else mask, (b * h, s, s))
+    mask = mask.reshape(b, h, s, s)
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k) / jnp.sqrt(
+        jnp.asarray(d, jnp.float32)).astype(q.dtype)
+    if attn_mask is not None:
+        scores = scores + _v(attn_mask)
+    if key_padding_mask is not None:
+        kp = _v(key_padding_mask)  # [b, s]: 0 = masked out
+        scores = jnp.where(kp[:, None, None, :] != 0, scores, -jnp.inf)
+    scores = jnp.where(mask, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)     # fully-masked rows -> 0
+    out = jnp.einsum("bhst,bhtd->bhsd", p.astype(v.dtype), v)
+    return Tensor(out)
+
+
+# -- sparse conv / pool (dense lowering + active-site masking) --------------
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, subm,
+             nd):
+    """x: SparseCooTensor with dense layout [N, *spatial, C]; weight:
+    dense [*k, C_in, C_out] (paddle sparse conv layout)."""
+    from ..core.tensor import Tensor
+    from . import SparseCooTensor, _dense_to_coo
+    dense = x.to_dense()._value if isinstance(x, SparseCooTensor) \
+        else _v(x)
+    w = _v(weight)
+    n = dense.shape[0]
+    cin, cout = w.shape[-2], w.shape[-1]
+    # NHWC/NDHWC conv via lax.conv_general_dilated
+    lhs_spec = "N" + "DHW"[-nd:] + "C"
+    out = jax.lax.conv_general_dilated(
+        dense.astype(jnp.float32),
+        w.reshape(w.shape[:nd] + (cin, cout)).astype(jnp.float32),
+        window_strides=(stride,) * nd if isinstance(stride, int)
+        else tuple(stride),
+        padding=[(padding, padding)] * nd if isinstance(padding, int)
+        else [(p, p) for p in padding],
+        rhs_dilation=(dilation,) * nd if isinstance(dilation, int)
+        else tuple(dilation),
+        dimension_numbers=(lhs_spec, "DHW"[-nd:] + "IO", lhs_spec),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + _v(bias)
+    if subm:
+        # submanifold: output active sites == input active sites
+        active = (dense != 0).any(axis=-1, keepdims=True)
+        if out.shape[:-1] == dense.shape[:-1]:
+            out = jnp.where(active, out, 0.0)
+    return _dense_to_coo(Tensor(out.astype(dense.dtype)))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", name=None):
+    """reference sparse/nn/functional/conv.py conv3d."""
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    subm=False, nd=3)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NHWC", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    subm=False, nd=2)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold conv: computes only at INPUT active sites, so sparsity
+    does not dilate (reference SubmConv3D semantics)."""
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    subm=True, nd=3)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    subm=True, nd=2)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """reference sparse/nn/functional/pooling.py max_pool3d (NDHWC)."""
+    from ..core.tensor import Tensor
+    from . import SparseCooTensor, _dense_to_coo
+    dense = x.to_dense()._value if isinstance(x, SparseCooTensor) \
+        else _v(x)
+    k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    s = k if stride is None else ((stride,) * 3 if isinstance(stride, int)
+                                  else tuple(stride))
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    out = jax.lax.reduce_window(
+        dense, -jnp.inf, jax.lax.max,
+        window_dimensions=(1,) + k + (1,),
+        window_strides=(1,) + s + (1,),
+        padding=((0, 0),) + tuple((pi, pi) for pi in p) + ((0, 0),))
+    out = jnp.where(jnp.isinf(out), 0.0, out)
+    return _dense_to_coo(Tensor(out))

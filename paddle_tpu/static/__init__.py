@@ -22,6 +22,7 @@ from ..core.dispatch import OP_RECORDERS
 from ..core.tensor import Tensor
 
 __all__ = ["Program", "program_guard", "default_main_program",
+           "PassManager", "apply_pass",
            "default_startup_program", "data", "Executor", "InputSpec",
            "name_scope", "nn",
            "BuildStrategy", "ExecutionStrategy", "CompiledProgram",
@@ -238,6 +239,11 @@ class Executor:
 
     @staticmethod
     def _make_runner(program, feed_names, fetch_ids, ext_ids):
+        # CSE may have deduped a fetched tensor's producer — follow the
+        # program's alias map to the surviving output id
+        aliases = getattr(program, "_id_aliases", {})
+        fetch_ids = [aliases.get(f, f) for f in fetch_ids]
+
         def pure(feed_vals, ext_vals):
             env: dict[int, Any] = {}
             for n, v in zip(feed_names, feed_vals):
@@ -261,5 +267,8 @@ class Executor:
 
 
 from . import nn  # noqa: E402,F401
+from . import passes  # noqa: E402,F401
+from .passes import (PassManager, apply_pass,  # noqa: E402,F401
+                     PASS_REGISTRY)
 from .compat import *  # noqa: E402,F401,F403
 from ..framework.core import create_parameter  # noqa: E402,F401

@@ -1,0 +1,276 @@
+"""Program transformation passes (reference: paddle/pir pass manager —
+pir::Pass / PassManager over Operation graphs, and the fluid pass
+registry applied by apply_pass; e.g. dead_code_elimination,
+constant_folding_pass, the BuildStrategy fuse_* passes).
+
+TPU-native altitude: XLA owns codegen-level optimization (fusion,
+layout, scheduling), so these passes work at the PROGRAM level — the
+recorded op list — where XLA can't help: dropping dead ops (smaller
+trace, faster replay/retrace), folding constant subgraphs at build time,
+de-duplicating repeated computations, and annotating fusible chains for
+inspection/BuildStrategy parity. A pass takes and returns a Program;
+they compose through PassManager / apply_pass."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..core.tensor import Tensor
+
+__all__ = ["Pass", "PassManager", "apply_pass",
+           "DeadOpEliminationPass", "ConstantFoldingPass",
+           "CommonSubexpressionEliminationPass", "FuseElementwisePass",
+           "PASS_REGISTRY", "register_pass"]
+
+PASS_REGISTRY: dict[str, type] = {}
+
+
+def register_pass(name):
+    def deco(cls):
+        PASS_REGISTRY[name] = cls
+        cls.name = name
+        return cls
+    return deco
+
+
+class Pass:
+    """One Program→Program rewrite. Subclasses implement apply()."""
+
+    name = "pass"
+
+    def apply(self, program, fetch_ids=None):
+        raise NotImplementedError
+
+    def __call__(self, program, fetch_ids=None):
+        return self.apply(program, fetch_ids=fetch_ids)
+
+
+class PassManager:
+    """reference pir PassManager: ordered pipeline; run() applies each
+    pass and records per-pass statistics in .stats."""
+
+    def __init__(self, passes: Iterable[Pass] = ()):
+        self.passes = [p if isinstance(p, Pass) else PASS_REGISTRY[p]()
+                       for p in passes]
+        self.stats: list[dict] = []
+
+    def add_pass(self, p):
+        self.passes.append(p if isinstance(p, Pass)
+                           else PASS_REGISTRY[p]())
+        return self
+
+    def run(self, program, fetch_ids=None):
+        self.stats = []
+        for p in self.passes:
+            before = len(program.ops)
+            program = p.apply(program, fetch_ids=fetch_ids)
+            self.stats.append({"pass": p.name, "ops_before": before,
+                               "ops_after": len(program.ops)})
+        return program
+
+
+def apply_pass(program, name, fetch_ids=None, **kwargs):
+    """reference fluid apply_pass(main_program, startup, name, attrs)."""
+    return PASS_REGISTRY[name](**kwargs).apply(program,
+                                               fetch_ids=fetch_ids)
+
+
+def _default_fetch(program, fetch_ids):
+    if fetch_ids is not None:
+        return set(fetch_ids)
+    return set(program.ops[-1].out_ids) if program.ops else set()
+
+
+def _literal_external(ref):
+    """Externals that are LITERALS for folding purposes: plain
+    stop-gradient Tensors (results of eager creation ops like ones()*3
+    or wrapped python scalars). Parameters and trainable tensors are
+    mutable across runs — the replay reads their live values — so they
+    must never fold."""
+    from ..core.tensor import Parameter
+    return (isinstance(ref, Tensor) and not isinstance(ref, Parameter)
+            and ref.stop_gradient)
+
+
+@register_pass("dead_op_elimination")
+class DeadOpEliminationPass(Pass):
+    """Backward liveness scan: drop ops whose outputs reach neither the
+    fetch set nor any live op (reference dead_code_elimination_pass)."""
+
+    def apply(self, program, fetch_ids=None):
+        live = _default_fetch(program, fetch_ids)
+        kept = []
+        for op in reversed(program.ops):
+            if any(oid in live for oid in op.out_ids):
+                kept.append(op)
+                for kind, vid, _ in op.arg_slots:
+                    if kind == "var":
+                        live.add(vid)
+        program.ops = kept[::-1]
+        return program
+
+
+@register_pass("constant_folding")
+class ConstantFoldingPass(Pass):
+    """Execute ops whose every input is a build-time constant and replace
+    their outputs with const slots (reference constant_folding_pass).
+    Feed vars and external vars (parameters — they change between runs)
+    are NOT constants."""
+
+    def apply(self, program, fetch_ids=None):
+        feed_ids = {id(t) for t in program.feed_vars.values()}
+        const_vals: dict[int, object] = {}
+        # literal externals (eagerly-created constants) seed the fold
+        for vid, ref in program.external_vars().items():
+            if _literal_external(ref):
+                const_vals[vid] = ref._value
+        fetch = _default_fetch(program, fetch_ids)
+        new_ops = []
+        for op in program.ops:
+            if any(tok in op.name
+                   for tok in CommonSubexpressionEliminationPass._IMPURE):
+                # non-deterministic ops must re-run every replay, never
+                # freeze to a build-time draw
+                new_ops.append(op)
+                continue
+            args = []
+            foldable = True
+            for kind, vid, _ref in op.arg_slots:
+                if kind == "const":
+                    args.append(vid._value if isinstance(vid, Tensor)
+                                else vid)
+                elif kind == "var" and vid in feed_ids:
+                    foldable = False
+                    break
+                elif vid in const_vals:
+                    args.append(const_vals[vid])
+                else:
+                    foldable = False
+                    break
+            if foldable:
+                out = op.fn(*args, **op.kwargs)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                for oid, o in zip(op.out_ids, outs):
+                    const_vals[oid] = o
+                if not any(oid in fetch for oid in op.out_ids):
+                    continue                     # fully folded away
+            new_ops.append(op)
+        # rewrite remaining references to folded values as const slots
+        for op in new_ops:
+            op.arg_slots = [
+                ("const", const_vals[vid], None)
+                if kind == "var" and vid in const_vals else (kind, vid, ref)
+                for kind, vid, ref in op.arg_slots]
+        program.ops = new_ops
+        # fetched fold results must stay computable: keep their producer
+        # (handled above by the fetch check)
+        return program
+
+
+@register_pass("cse")
+class CommonSubexpressionEliminationPass(Pass):
+    """Identical (op, inputs, attrs) → single computation (reference
+    common_subexpression_elimination pass). Non-deterministic ops
+    (random/dropout) are excluded by name."""
+
+    _IMPURE = ("random", "dropout", "uniform", "normal", "randint",
+               "bernoulli", "multinomial")
+
+    def apply(self, program, fetch_ids=None):
+        import numpy as np
+        produced = set()
+        for op in program.ops:
+            produced.update(op.out_ids)
+
+        def slot_key(kind, vid, ref):
+            if kind != "var":
+                return ("const", repr(vid))
+            vid = replace.get(vid, vid)
+            # literal externals (e.g. each `x * 2.0` wraps a fresh Tensor
+            # for the 2.0) compare by VALUE, else duplicates never match
+            if vid not in produced and _literal_external(ref) \
+                    and ref._value.size <= 1024:
+                arr = np.asarray(ref._value)
+                return ("lit", arr.shape, str(arr.dtype), arr.tobytes())
+            return ("var", vid)
+
+        seen: dict[tuple, list[int]] = {}
+        replace: dict[int, int] = {}
+        new_ops = []
+        for op in program.ops:
+            slots = tuple(slot_key(*s) for s in op.arg_slots)
+            key = (op.name, slots, tuple(sorted(
+                (k, repr(v)) for k, v in op.kwargs.items())))
+            if any(tok in op.name for tok in self._IMPURE):
+                new_ops.append(op)
+                continue
+            if key in seen:
+                for old, new in zip(op.out_ids, seen[key]):
+                    replace[old] = new
+                continue                        # drop the duplicate op
+            seen[key] = op.out_ids
+            new_ops.append(op)
+        for op in new_ops:
+            op.arg_slots = [
+                ("var", replace.get(vid, vid), ref) if kind == "var"
+                else (kind, vid, ref) for kind, vid, ref in op.arg_slots]
+        program.ops = new_ops
+        # fetches may reference replaced ids — record the alias map ON
+        # THE PROGRAM so Executor fetch resolution follows it (the pass
+        # instance is throwaway under apply_pass/PassManager)
+        aliases = getattr(program, "_id_aliases", {})
+        aliases.update(replace)
+        program._id_aliases = aliases
+        self.replacements = replace
+        return program
+
+    def resolve_id(self, vid):
+        return self.replacements.get(vid, vid)
+
+
+_ELEMENTWISE = {
+    "add", "subtract", "multiply", "divide", "relu", "gelu", "silu",
+    "sigmoid", "tanh", "exp", "log", "abs", "maximum", "minimum", "pow",
+    "scale", "clip", "sqrt", "rsqrt", "floor", "ceil", "cast", "neg",
+}
+
+
+@register_pass("fuse_elementwise")
+class FuseElementwisePass(Pass):
+    """Annotate maximal producer→consumer chains of elementwise ops
+    (reference BuildStrategy fuse_elewise_add_act_ops and friends). XLA
+    performs the actual fusion during compilation; the annotation exposes
+    WHAT will fuse — written to program.fuse_groups as lists of op
+    indices — for inspection and BuildStrategy parity."""
+
+    def apply(self, program, fetch_ids=None):
+        producer: dict[int, int] = {}
+        for i, op in enumerate(program.ops):
+            for oid in op.out_ids:
+                producer[oid] = i
+        consumers: dict[int, list[int]] = {}
+        for i, op in enumerate(program.ops):
+            for kind, vid, _ in op.arg_slots:
+                if kind == "var" and vid in producer:
+                    consumers.setdefault(producer[vid], []).append(i)
+        groups = []
+        visited = set()
+        for i, op in enumerate(program.ops):
+            if i in visited or op.name not in _ELEMENTWISE:
+                continue
+            chain = [i]
+            visited.add(i)
+            cur = i
+            while True:
+                nxt = consumers.get(cur, [])
+                if len(nxt) == 1 and nxt[0] not in visited and \
+                        program.ops[nxt[0]].name in _ELEMENTWISE:
+                    cur = nxt[0]
+                    chain.append(cur)
+                    visited.add(cur)
+                else:
+                    break
+            if len(chain) > 1:
+                groups.append(chain)
+        program.fuse_groups = groups
+        return program

@@ -1286,3 +1286,119 @@ class TestAutoCheckpoint:
         assert cb2.start_step > 0
         # resumed fit must SKIP completed steps, not double-train
         assert cb2._global_step == cb1._global_step
+
+
+class TestReshardTaxonomy:
+    """Reshard-function taxonomy (SURVEY item 16; reference
+    phi/core/distributed/auto_parallel/*_reshard_function.cc: r_to_s,
+    s_to_r, s_to_s, same_status, nd_mesh, cross-mesh): each conversion
+    preserves the global value and lands the expected per-device shards."""
+
+    def _x(self):
+        return paddle.arange(0, 64, dtype="float32").reshape([8, 8])
+
+    def test_r_to_s_and_back(self):
+        m = dist.ProcessMesh(shape=[8], dim_names=["x"])
+        x = self._x()
+        xs = dist.shard_tensor(x, m, [dist.Shard(0)])       # r_to_s
+        assert xs._value.addressable_shards[0].data.shape == (1, 8)
+        xr = dist.reshard(xs, m, [dist.Replicate()])        # s_to_r
+        assert xr._value.addressable_shards[0].data.shape == (8, 8)
+        assert np.allclose(_np(xr), _np(x))
+
+    def test_s_to_s_dim_change(self):
+        m = dist.ProcessMesh(shape=[8], dim_names=["x"])
+        xs = dist.shard_tensor(self._x(), m, [dist.Shard(0)])
+        xt = dist.reshard(xs, m, [dist.Shard(1)])           # s0 -> s1
+        assert xt._value.addressable_shards[0].data.shape == (8, 1)
+        assert np.allclose(_np(xt), _np(self._x()))
+
+    def test_nd_mesh_both_dims(self):
+        m = dist.ProcessMesh(shape=[2, 4], dim_names=["a", "b"])
+        xs = dist.shard_tensor(self._x(), m,
+                               [dist.Shard(0), dist.Shard(1)])
+        assert xs._value.addressable_shards[0].data.shape == (4, 2)
+        flipped = dist.reshard(xs, m, [dist.Shard(1), dist.Shard(0)])
+        assert flipped._value.addressable_shards[0].data.shape == (2, 4)
+        assert np.allclose(_np(flipped), _np(self._x()))
+
+    def test_cross_mesh(self):
+        """reference nd_mesh/cross-mesh reshard: topology change 1D->2D."""
+        mA = dist.ProcessMesh(shape=[8], dim_names=["x"])
+        mB = dist.ProcessMesh(shape=[2, 4], dim_names=["a", "b"])
+        xs = dist.shard_tensor(self._x(), mA, [dist.Shard(0)])
+        xc = dist.reshard(xs, mB, [dist.Shard(1), dist.Shard(0)])
+        assert xc._value.addressable_shards[0].data.shape == (2, 4)
+        assert np.allclose(_np(xc), _np(self._x()))
+        assert xc.dist_attr.process_mesh is mB
+
+    def test_same_status_noop(self):
+        m = dist.ProcessMesh(shape=[8], dim_names=["x"])
+        xs = dist.shard_tensor(self._x(), m, [dist.Shard(0)])
+        again = dist.reshard(xs, m, [dist.Shard(0)])
+        assert np.allclose(_np(again), _np(self._x()))
+        assert again._value.sharding == xs._value.sharding
+
+
+class TestSpmdPropagationRules:
+    """Per-op sharding propagation (SURVEY item 15; reference
+    infermeta/spmd_rules/ matmul/elementwise/embedding/reduction/softmax/
+    transpose): GSPMD must derive the canonical output shardings from the
+    input shardings — the TPU substitute for hand-written InferSpmd."""
+
+    def _mesh(self):
+        return dist.ProcessMesh(shape=[2, 4], dim_names=["dp", "mp"])
+
+    def _spec_of(self, arr):
+        return arr.sharding.spec if hasattr(arr.sharding, "spec") else None
+
+    def _run(self, fn, *arrs_specs):
+        from jax.sharding import NamedSharding
+        m = self._mesh().jax_mesh
+        args = [jax.device_put(a, NamedSharding(m, s))
+                for a, s in arrs_specs]
+        return jax.jit(fn)(*args)
+
+    def test_matmul_rule(self):
+        # [b sharded dp, k] @ [k, n sharded mp] -> [dp, mp]
+        a = jnp.ones((8, 16))
+        b = jnp.ones((16, 32))
+        out = self._run(lambda x, w: x @ w, (a, P("dp", None)),
+                        (b, P(None, "mp")))
+        assert self._spec_of(out) == P("dp", "mp")
+
+    def test_matmul_contraction_partial_resolved(self):
+        # contraction over an mp-sharded dim: output must be materialized
+        # (GSPMD inserts the reduction; result spec has no mp on k)
+        a = jnp.ones((8, 16))
+        b = jnp.ones((16, 32))
+        out = self._run(lambda x, w: x @ w, (a, P(None, "mp")),
+                        (b, P("mp", None)))
+        assert np.allclose(np.asarray(out), 16.0)
+
+    def test_elementwise_and_softmax_keep_sharding(self):
+        a = jnp.ones((8, 32))
+        out = self._run(lambda x: jax.nn.softmax(x * 2.0, axis=-1),
+                        (a, P("dp", "mp")))
+        assert self._spec_of(out) == P("dp", "mp")
+
+    def test_reduction_rule(self):
+        a = jnp.ones((8, 32))
+        out = self._run(lambda x: x.sum(axis=1), (a, P("dp", "mp")))
+        # reduced dim's sharding disappears; batch dim's stays
+        assert self._spec_of(out)[:1] == P("dp")
+
+    def test_transpose_rule(self):
+        a = jnp.ones((8, 32))
+        out = self._run(lambda x: x.T, (a, P("dp", "mp")))
+        assert self._spec_of(out) == P("mp", "dp")
+
+    def test_embedding_rule(self):
+        # vocab-sharded table gather -> replicated-row output, correct
+        # values (reference embedding.h InferSpmd)
+        table = jnp.arange(64.0).reshape(32, 2)
+        ids = jnp.asarray(np.array([[1, 5], [7, 31]], np.int32))
+        out = self._run(lambda t, i: jnp.take(t, i, axis=0),
+                        (table, P("mp", None)), (ids, P(None, None)))
+        assert np.allclose(np.asarray(out),
+                           np.take(np.asarray(table), np.asarray(ids), 0))

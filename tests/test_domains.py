@@ -434,3 +434,80 @@ class TestPTQCalibration:
             q = np.clip(np.round(data / rng_ * 127), -127, 127) * rng_ / 127
             return np.abs(q - data)[1:].mean()  # exclude the spike
         assert err(pct.scale()) < err(absx.scale()) / 10
+
+
+class TestSparseDepth:
+    """Sparse depth (SURVEY item 34): attention, conv, norm, pooling,
+    low-rank and complex unary parity with the reference surface."""
+
+    def test_sparse_attention_matches_masked_dense(self):
+        from paddle_tpu.sparse.nn_functional import attention
+        rng = np.random.RandomState(0)
+        b, h, s, d = 1, 2, 8, 4
+        q = paddle.to_tensor(rng.randn(b, h, s, d).astype("float32"))
+        k = paddle.to_tensor(rng.randn(b, h, s, d).astype("float32"))
+        v = paddle.to_tensor(rng.randn(b, h, s, d).astype("float32"))
+        mask = np.tril(np.ones((s, s), np.float32))
+        smask = paddle.to_tensor(mask).to_sparse_csr()
+        out = attention(q, k, v, smask)
+        # dense oracle
+        sc = np.einsum("bhsd,bhtd->bhst", _np(q), _np(k)) / np.sqrt(d)
+        sc = np.where(mask[None, None] != 0, sc, -np.inf)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        want = np.einsum("bhst,bhtd->bhsd", p, _np(v))
+        np.testing.assert_allclose(_np(out), want, atol=1e-5)
+
+    def test_subm_conv_keeps_sparsity_pattern(self):
+        from paddle_tpu.sparse import nn as snn
+        paddle.seed(0)
+        x = np.zeros((1, 6, 6, 2), np.float32)
+        x[0, 1, 1] = 1.0
+        x[0, 4, 3] = 2.0
+        coo = paddle.to_tensor(x).to_sparse_coo()
+        conv = snn.SubmConv2D(2, 3, kernel_size=3, padding=1)
+        out = conv(coo)
+        dense = _np(out.to_dense())
+        active_in = (x != 0).any(-1)
+        active_out = (dense != 0).any(-1)
+        # submanifold: no dilation of the active set
+        assert (active_out <= active_in).all()
+        # regular conv DOES dilate
+        conv2 = snn.Conv2D(2, 3, kernel_size=3, padding=1)
+        d2 = _np(conv2(coo).to_dense())
+        assert ((d2 != 0).any(-1).sum() > active_in.sum())
+
+    def test_sparse_batchnorm_active_stats(self):
+        from paddle_tpu.sparse import nn as snn
+        x = np.zeros((2, 4, 4, 4, 3), np.float32)
+        x[0, 0, 0, 0] = [1.0, 2.0, 3.0]
+        x[1, 1, 2, 3] = [3.0, 4.0, 5.0]
+        coo = paddle.to_tensor(x).to_sparse_coo()
+        bn = snn.BatchNorm(3)
+        out = _np(bn(coo).to_dense())
+        active = (x != 0).any(-1)
+        assert (out[~active] == 0).all()
+        vals = out[active]
+        np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-4)
+
+    def test_max_pool3d(self):
+        from paddle_tpu.sparse import nn as snn
+        x = np.zeros((1, 4, 4, 4, 1), np.float32)
+        x[0, 0, 0, 0, 0] = 5.0
+        x[0, 3, 3, 3, 0] = 7.0
+        coo = paddle.to_tensor(x).to_sparse_coo()
+        out = _np(snn.MaxPool3D(2, stride=2)(coo).to_dense())
+        assert out.shape == (1, 2, 2, 2, 1)
+        assert out[0, 0, 0, 0, 0] == 5.0 and out[0, 1, 1, 1, 0] == 7.0
+
+    def test_svd_lowrank_and_complex_unary(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(12, 6).astype(np.float32)
+        u, s, v = sparse.svd_lowrank(paddle.to_tensor(X), q=3)
+        s_ref = np.linalg.svd(X, compute_uv=False)[:3]
+        np.testing.assert_allclose(_np(s), s_ref, rtol=1e-3)
+        z = (rng.randn(3, 3) + 1j * rng.randn(3, 3)).astype("complex64")
+        zc = sparse.conjugate(paddle.to_tensor(z))
+        np.testing.assert_allclose(_np(zc), z.conj())
+        zt = sparse.transjugate(paddle.to_tensor(z))
+        np.testing.assert_allclose(_np(zt), z.conj().T)
