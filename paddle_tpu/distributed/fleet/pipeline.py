@@ -172,7 +172,8 @@ def interleave_permutation(n_layers: int, n_stages: int,
 
 def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatch: int,
                   axis_name: str = "pp", interleave: int = 1,
-                  remat: bool = True, has_aux: bool = False):
+                  remat: bool = True, has_aux: bool = False,
+                  aux_mean_axes: tuple = ()):
     """Build a pipelined apply: ``stage_fn(chunk_params, x) -> y`` runs one
     virtual-stage chunk's layers; weights must be stacked
     [n_stages * chunk_layers * interleave, ...], sharded over ``axis_name``,
@@ -212,7 +213,6 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatch: int,
     def apply(stage_params, x_mb):
         stage = lax.axis_index(axis_name)
         n_ticks = v * n_microbatch + n_stages - 1
-        mb_shape = x_mb.shape[1:]
 
         def _pv(a):
             if hasattr(lax, "pcast"):
@@ -238,11 +238,16 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatch: int,
             # chunk internals recompute during backward (1F1B memory bound)
             chunk_apply = jax.checkpoint(chunk_apply)
 
-        state = _pv(jnp.zeros(mb_shape, x_mb.dtype))     # just-received act
-        outputs = _pv(jnp.zeros((n_microbatch,) + mb_shape, x_mb.dtype))
+        # carries derive from x_mb (zeroed) so they inherit its device-
+        # varying axes (e.g. a manual sep axis sharding the seq dim) —
+        # fresh jnp.zeros would be unvarying and break the scan's carry
+        # vma typing; _pv adds the pp axis
+        zero_mb = x_mb * jnp.zeros((), x_mb.dtype)
+        state = _pv(zero_mb[0])                          # just-received act
+        outputs = _pv(zero_mb)
         # chunk-boundary parking buffer (rank 0 reads chunk j>0 inputs)
-        inbuf = _pv(jnp.zeros((n_microbatch,) + mb_shape, x_mb.dtype))
-        aux_acc = _pv(jnp.zeros((), jnp.float32))
+        inbuf = _pv(zero_mb)
+        aux_acc = _pv(zero_mb.sum().astype(jnp.float32) * 0.0)
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
@@ -283,8 +288,13 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatch: int,
         mask = (stage == n_stages - 1).astype(outputs.dtype)
         outputs = safe_psum(outputs * mask, axis_name)
         if has_aux:
-            # every rank's active ticks contributed its own layers' aux
-            return outputs, lax.psum(aux_acc, axis_name)
+            # every rank's active ticks contributed its own layers' aux;
+            # aux_mean_axes (e.g. a manual sep axis) average the per-shard
+            # terms so the scalar is replicated for the P() out_spec
+            aux = lax.psum(aux_acc, axis_name)
+            for ax in aux_mean_axes:
+                aux = safe_psum(aux, ax) / jax.lax.axis_size(ax)
+            return outputs, aux
         return outputs
 
     return apply

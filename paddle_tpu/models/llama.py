@@ -147,22 +147,26 @@ def _rms(x, w, eps):
     return rms_norm_raw(x, w, eps)
 
 
-def _attention(q, k, v, causal=True):
+def _attention(q, k, v, causal=True, sep_manual=None):
     """[b, s, h, d] flash attention (Pallas on TPU). GQA-native: grouped
     K/V are consumed directly (kernel indexes KV by head//group) instead
     of materializing repeated heads on HBM. When the sequence is sharded
     over a sep axis (>1), attention runs as ring / all-to-all attention
-    over ICI neighbors (distributed.sep) instead of gathering K/V."""
+    over ICI neighbors (distributed.sep) instead of gathering K/V.
+    ``sep_manual=(axis, n)``: we are INSIDE a manual region that includes
+    the sep axis (the pp pipeline) — run the ring body directly."""
     from .. import flags
     from ..distributed.fleet.mp_layers import current_mesh
     from ..distributed.sep import _axis_size
+    if sep_manual is not None:
+        from ..distributed.sep import ring_attention_local
+        axis, n = sep_manual
+        return ring_attention_local(q, k, v, axis_name=axis, n_shards=n,
+                                    causal=causal)
     mesh = current_mesh()
     in_manual_region = bool(getattr(
         jax.sharding.get_abstract_mesh(), "manual_axes", ()))
     if _axis_size(mesh, "sep") > 1 and not in_manual_region:
-        # inside a manual region (the pp pipeline) a nested sep shard_map
-        # doesn't compose with the concrete mesh — the stage falls back to
-        # gathered attention there (activations are auto-sharded anyway)
         from ..distributed.sep import sep_attention
         return sep_attention(q, k, v, causal=causal, mesh=mesh)
     if flags.flag("use_pallas_kernels") and jax.default_backend() == "tpu":
@@ -173,7 +177,7 @@ def _attention(q, k, v, causal=True):
 
 
 def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint,
-                   mp_axis=None, return_kv=False):
+                   mp_axis=None, return_kv=False, sep_manual=None):
     """One decoder layer on raw arrays. lp = this layer's parameter dict.
 
     ``mp_axis``: inside the manual-pp region GSPMD cannot be steered (no
@@ -212,7 +216,7 @@ def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint,
     q = hint(_rope(q, positions, cfg.rope_theta, hd), "dp", "sep", "mp", None)
     k = hint(_rope(k, positions, cfg.rope_theta, hd), "dp", "sep", "mp", None)
     v = hint(v, "dp", "sep", "mp", None)
-    attn = _attention(q, k, v, causal=True)
+    attn = _attention(q, k, v, causal=True, sep_manual=sep_manual)
     attn = checkpoint_name(attn, "attn_out")
     attn = attn.reshape(b, s, h * hd)
     x = x + hint(_mp_sum(attn @ lp["wo"]), "dp", "sep", None)
@@ -273,7 +277,7 @@ def _moe_mlp(cfg: LlamaConfig, lp: dict, y, mesh_hint, mp_axis=None,
 
 
 def _scan_layers(cfg, stacked, x, positions, mesh_hint, mp_axis=None,
-                 collect_kv=False):
+                 collect_kv=False, sep_manual=None):
     """Scan the decoder over a stacked [n, ...] parameter tree (full depth
     in the GSPMD path, one stage's local slice inside the pipeline).
     Returns (x, penalty) with penalty the summed per-layer router aux;
@@ -286,7 +290,8 @@ def _scan_layers(cfg, stacked, x, positions, mesh_hint, mp_axis=None,
                 return_kv=True)
             return out, (penalty, kk, vv)
         out, penalty = _decoder_layer(cfg, lp, carry, positions, mesh_hint,
-                                      mp_axis=mp_axis)
+                                      mp_axis=mp_axis,
+                                      sep_manual=sep_manual)
         return out, penalty
 
     if cfg.recompute:
@@ -364,14 +369,29 @@ def _pipelined_layers(cfg, stacked, x, mesh, mesh_hint, stacked_specs=None):
     if mp > 1 and cfg.num_key_value_heads % mp == 0:
         manual_axes.add("mp")
         mp_axis = "mp"
+    # manual sep: seq stays sharded INSIDE the pipeline and attention
+    # runs the ring body over ICI neighbors (VERDICT weak #6: this
+    # composition used to fall back to gathered attention)
+    sep = _axis_size(mesh, "sep")
+    sep_manual = None
+    if sep > 1 and s % sep == 0:
+        manual_axes.add("sep")
+        sep_manual = ("sep", sep)
 
     def stage_fn(stage_params, xm):
-        pos = jnp.broadcast_to(jnp.arange(s)[None, :], (mb, s))
+        s_local = xm.shape[1]
+        if sep_manual is not None:
+            off = jax.lax.axis_index("sep") * s_local
+        else:
+            off = 0
+        pos = jnp.broadcast_to(off + jnp.arange(s_local)[None, :],
+                               (mb, s_local))
         # GSPMD hints don't apply inside the manual region — TP is the
-        # explicit psum-over-mp path in _decoder_layer; remaining auto
-        # axes (dp/sep/ep) ride GSPMD propagation
+        # explicit psum-over-mp path in _decoder_layer, long-context the
+        # explicit ring over sep; remaining auto axes (dp/ep) ride GSPMD
         return _scan_layers(cfg, stage_params, xm, pos,
-                            lambda a, spec: a, mp_axis=mp_axis)  # (x, aux)
+                            lambda a, spec: a, mp_axis=mp_axis,
+                            sep_manual=sep_manual)  # (x, aux)
 
     if v > 1:
         # reorder layers so each rank's contiguous [L/pp] slice holds its
@@ -381,7 +401,8 @@ def _pipelined_layers(cfg, stacked, x, mesh, mesh_hint, stacked_specs=None):
         stacked = jax.tree_util.tree_map(
             lambda a: jnp.take(a, perm, axis=0), stacked)
     apply = spmd_pipeline(stage_fn, pp, n_mb, axis_name="pp", interleave=v,
-                          has_aux=True)
+                          has_aux=True,
+                          aux_mean_axes=("sep",) if sep_manual else ())
     in_dtype = x.dtype
     if x.dtype == jnp.bfloat16 and jax.default_backend() == "cpu":
         # XLA CPU's AllReducePromotion pass check-fails on the bf16
@@ -408,13 +429,15 @@ def _pipelined_layers(cfg, stacked, x, mesh, mesh_hint, stacked_specs=None):
         # like ep stay local-full inside the region)
         return P(*[_manual_part(ax) for ax in spec])
 
+    x_spec = P(None, None, "sep", None) if sep_manual is not None else P()
     param_specs = {n: leaf_spec(n) for n in stacked}
     # jit: eager shard_map can't evaluate the scan-of-checkpoint schedule
     # (closed_call); under an outer jit this traces inline as usual. The
     # jitted callable is CACHED so repeated eager calls (generate loops,
     # eval) don't rebuild + recompile the pipeline program each time.
     cache_key = (
-        _freeze_cfg(cfg), mesh, n_mb, v, mp_axis, x.shape, str(x.dtype),
+        _freeze_cfg(cfg), mesh, n_mb, v, mp_axis, sep_manual, x.shape,
+        str(x.dtype),
         tuple(sorted((n, stacked[n].shape, str(stacked[n].dtype),
                       str(param_specs[n])) for n in stacked)))
     fn = _PIPELINE_CACHE.get(cache_key)
@@ -424,8 +447,8 @@ def _pipelined_layers(cfg, stacked, x, mesh, mesh_hint, stacked_specs=None):
         # check_vma must stay on: disabling it demotes the region to
         # full-manual over every mesh axis, breaking partial-manual specs
         fn = jax.jit(jax.shard_map(apply, mesh=mesh,
-                                   in_specs=(param_specs, P()),
-                                   out_specs=(P(), P()),
+                                   in_specs=(param_specs, x_spec),
+                                   out_specs=(x_spec, P()),
                                    axis_names=manual_axes))
         _PIPELINE_CACHE[cache_key] = fn
     out, aux = fn(stacked, x_mb)
