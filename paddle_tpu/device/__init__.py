@@ -138,11 +138,11 @@ class _CudaNamespace:
 
     @staticmethod
     def max_memory_allocated(device=None):
-        return 0
+        return max_memory_allocated(device)
 
     @staticmethod
     def memory_allocated(device=None):
-        return 0
+        return memory_allocated(device)
 
     Stream = Stream
     Event = Event
@@ -230,3 +230,125 @@ __all__ += ["IPUPlace", "XPUPlace", "current_stream", "set_stream",
             "stream_guard", "get_cudnn_version", "get_all_device_type",
             "get_all_custom_device_type", "is_compiled_with_cinn",
             "is_compiled_with_ipu"]
+
+
+# ---- round-3: allocator-facade stats + OOM diagnostics (reference:
+# fluid/memory/allocation/allocator_facade.h:45 + memory/stats.h
+# STAT_GPU_MEM peak tracking; device/cuda max_memory_allocated). PJRT owns
+# the real allocator; the facade here accounts LIVE jax arrays per device
+# (backend memory_stats() when the runtime exposes it) and keeps the
+# process-level peak the reference's Stat objects track.
+
+_MEM_PEAK: dict = {}
+
+
+def _device_key(device=None):
+    import jax
+    if device is None:
+        return jax.devices()[0]
+    return device
+
+
+def memory_stats(device=None) -> dict:
+    """Allocator stats: backend PJRT stats when available, else live-array
+    accounting. Keys mirror the reference's memory/stats.h naming."""
+    import jax
+    dev = _device_key(device)
+    backend = None
+    if hasattr(dev, "memory_stats"):
+        backend = dev.memory_stats()
+    live = [a for a in jax.live_arrays()
+            if dev in getattr(a, "devices", lambda: set())()]
+    in_use = sum(a.nbytes for a in live)
+    peak = max(_MEM_PEAK.get(dev, 0), in_use,
+               (backend or {}).get("peak_bytes_in_use", 0))
+    _MEM_PEAK[dev] = peak
+    largest = sorted(live, key=lambda a: a.nbytes, reverse=True)[:5]
+    return {
+        "bytes_in_use": (backend or {}).get("bytes_in_use", in_use),
+        "peak_bytes_in_use": peak,
+        "num_live_arrays": len(live),
+        "largest_arrays": [
+            {"shape": tuple(a.shape), "dtype": str(a.dtype),
+             "nbytes": a.nbytes} for a in largest],
+        "backend": backend,
+    }
+
+
+def memory_allocated(device=None) -> int:
+    """reference device/cuda memory_allocated — live bytes on device."""
+    return int(memory_stats(device)["bytes_in_use"])
+
+
+def max_memory_allocated(device=None) -> int:
+    """reference max_memory_allocated — process-lifetime peak, sampled
+    at every stats call (PJRT exposes no allocation callbacks)."""
+    return int(memory_stats(device)["peak_bytes_in_use"])
+
+
+def memory_reserved(device=None) -> int:
+    """PJRT reserves what it uses; reserved == allocated here."""
+    return memory_allocated(device)
+
+
+def max_memory_reserved(device=None) -> int:
+    return max_memory_allocated(device)
+
+
+def reset_max_memory_allocated(device=None):
+    dev = _device_key(device)
+    _MEM_PEAK[dev] = memory_allocated(device)
+
+
+def reset_max_memory_reserved(device=None):
+    reset_max_memory_allocated(device)
+
+
+def explain_oom(exc, model=None, optimizer=None) -> str:
+    """Build the OOM diagnostic the reference's allocator raises
+    (auto_growth_best_fit_allocator's 'Cannot allocate ... memory info'
+    block): what is resident, who owns it, and what to do about it."""
+    lines = ["Device out of memory (XLA RESOURCE_EXHAUSTED).",
+             f"  original: {str(exc).splitlines()[0][:200]}"]
+    try:
+        st = memory_stats()
+        lines.append(f"  live: {st['bytes_in_use'] / 2**30:.2f} GiB in "
+                     f"{st['num_live_arrays']} arrays "
+                     f"(peak {st['peak_bytes_in_use'] / 2**30:.2f} GiB)")
+        for a in st["largest_arrays"]:
+            lines.append(f"    largest: {a['shape']} {a['dtype']} "
+                         f"{a['nbytes'] / 2**20:.1f} MiB")
+    except Exception:  # noqa: BLE001 — diagnostics must not mask the OOM
+        pass
+    if model is not None:
+        try:
+            pb = sum(p._value.nbytes for p in model.parameters())
+            lines.append(f"  model parameters: {pb / 2**30:.2f} GiB")
+        except Exception:  # noqa: BLE001
+            pass
+    if optimizer is not None:
+        try:
+            ob = sum(a.nbytes for arrs in optimizer._accumulators.values()
+                     for a in arrs)
+            lines.append(f"  optimizer state: {ob / 2**30:.2f} GiB")
+        except Exception:  # noqa: BLE001
+            pass
+    lines.append("  remedies: enable recompute (cfg.recompute=True), "
+                 "shard optimizer state (ZeRO: apply_sharding_specs), "
+                 "reduce batch/sequence, or raise mp/pp degrees.")
+    return "\n".join(lines)
+
+
+def _wrap_oom(exc, model=None, optimizer=None):
+    """Re-raise an XLA RESOURCE_EXHAUSTED with the diagnostic attached;
+    returns False for non-OOM errors (caller re-raises the original)."""
+    if "RESOURCE_EXHAUSTED" not in str(exc) and \
+            "Out of memory" not in str(exc):
+        return False
+    raise RuntimeError(explain_oom(exc, model, optimizer)) from exc
+
+
+__all__ += ["memory_stats", "memory_allocated", "max_memory_allocated",
+            "memory_reserved", "max_memory_reserved",
+            "reset_max_memory_allocated", "reset_max_memory_reserved",
+            "explain_oom"]

@@ -269,3 +269,44 @@ def test_multi_precision_master_does_not_alias_fp32_param():
     master = opt._accumulators["master_weight"][0]
     assert master.unsafe_buffer_pointer() != \
         w._value.unsafe_buffer_pointer()
+
+
+class TestMemoryStatsAndOom:
+    """Allocator-facade stats + OOM diagnostics (SURVEY item 1 depth)."""
+
+    def test_memory_stats_accounts_live_arrays(self):
+        import paddle_tpu.device as D
+        st0 = D.memory_stats()
+        big = paddle.ones([256, 1024])          # 1 MiB
+        st1 = D.memory_stats()
+        assert st1["bytes_in_use"] >= st0["bytes_in_use"] + 1_000_000
+        assert st1["num_live_arrays"] > 0
+        assert D.max_memory_allocated() >= st1["bytes_in_use"]
+        assert any(a["nbytes"] >= 1_000_000
+                   for a in st1["largest_arrays"])
+        del big
+        D.reset_max_memory_allocated()
+        assert D.max_memory_allocated() <= st1["bytes_in_use"]
+        # cuda shim delegates
+        assert D.cuda.memory_allocated() == D.memory_allocated()
+
+    def test_oom_diagnostic_message(self):
+        import paddle_tpu.device as D
+        m = paddle.nn.Linear(8, 8)
+        o = paddle.optimizer.Adam(learning_rate=1e-3,
+                                  parameters=m.parameters())
+        x = paddle.randn([2, 8])
+        (m(x) ** 2).mean().backward()
+        o.step()
+        fake = RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                            "123 bytes")
+        msg = D.explain_oom(fake, model=m, optimizer=o)
+        assert "RESOURCE_EXHAUSTED" in msg
+        assert "model parameters" in msg
+        assert "optimizer state" in msg
+        assert "remedies" in msg
+        # non-OOM errors pass through _wrap_oom untouched
+        assert D._wrap_oom(ValueError("boom")) is False
+        import pytest
+        with pytest.raises(RuntimeError, match="remedies"):
+            D._wrap_oom(fake, m, o)
