@@ -240,12 +240,20 @@ __all__ += ["IPUPlace", "XPUPlace", "current_stream", "set_stream",
 # process-level peak the reference's Stat objects track.
 
 _MEM_PEAK: dict = {}
+_PEAK_BASE: dict = {}
 
 
 def _device_key(device=None):
     import jax
     if device is None:
         return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, str):
+        # "gpu:0" / "tpu:1" / "0" — reference device-string forms
+        idx = int(device.split(":")[-1]) if device.split(":")[-1].isdigit() \
+            else 0
+        return jax.devices()[idx]
     return device
 
 
@@ -257,20 +265,36 @@ def memory_stats(device=None) -> dict:
     backend = None
     if hasattr(dev, "memory_stats"):
         backend = dev.memory_stats()
-    live = [a for a in jax.live_arrays()
-            if dev in getattr(a, "devices", lambda: set())()]
-    in_use = sum(a.nbytes for a in live)
+
+    def _dev_bytes(a):
+        """Bytes of `a` RESIDENT ON dev — shard-level accounting so a
+        mesh-sharded array isn't charged its global size on every
+        device it touches."""
+        try:
+            return sum(sh.data.nbytes for sh in a.addressable_shards
+                       if sh.device == dev)
+        except Exception:  # noqa: BLE001 — fall back to whole-array
+            return a.nbytes if dev in getattr(
+                a, "devices", lambda: set())() else 0
+
+    pairs = [(a, _dev_bytes(a)) for a in jax.live_arrays()]
+    pairs = [(a, b) for a, b in pairs if b > 0]
+    in_use = sum(b for _, b in pairs)
+    # backend peak is process-lifetime and non-resettable; track a
+    # baseline so reset_max_memory_allocated() actually resets
+    backend_peak = (backend or {}).get("peak_bytes_in_use", 0)
+    base = _PEAK_BASE.get(dev, 0)
     peak = max(_MEM_PEAK.get(dev, 0), in_use,
-               (backend or {}).get("peak_bytes_in_use", 0))
+               max(backend_peak - base, 0))
     _MEM_PEAK[dev] = peak
-    largest = sorted(live, key=lambda a: a.nbytes, reverse=True)[:5]
+    largest = sorted(pairs, key=lambda p: p[1], reverse=True)[:5]
     return {
         "bytes_in_use": (backend or {}).get("bytes_in_use", in_use),
         "peak_bytes_in_use": peak,
-        "num_live_arrays": len(live),
+        "num_live_arrays": len(pairs),
         "largest_arrays": [
             {"shape": tuple(a.shape), "dtype": str(a.dtype),
-             "nbytes": a.nbytes} for a in largest],
+             "nbytes": b} for a, b in largest],
         "backend": backend,
     }
 
@@ -297,6 +321,10 @@ def max_memory_reserved(device=None) -> int:
 
 def reset_max_memory_allocated(device=None):
     dev = _device_key(device)
+    if hasattr(dev, "memory_stats"):
+        backend = dev.memory_stats() or {}
+        _PEAK_BASE[dev] = backend.get("peak_bytes_in_use", 0)
+    _MEM_PEAK[dev] = 0
     _MEM_PEAK[dev] = memory_allocated(device)
 
 
@@ -308,8 +336,9 @@ def explain_oom(exc, model=None, optimizer=None) -> str:
     """Build the OOM diagnostic the reference's allocator raises
     (auto_growth_best_fit_allocator's 'Cannot allocate ... memory info'
     block): what is resident, who owns it, and what to do about it."""
+    first = (str(exc).splitlines() or ["<no message>"])[0]
     lines = ["Device out of memory (XLA RESOURCE_EXHAUSTED).",
-             f"  original: {str(exc).splitlines()[0][:200]}"]
+             f"  original: {first[:200]}"]
     try:
         st = memory_stats()
         lines.append(f"  live: {st['bytes_in_use'] / 2**30:.2f} GiB in "
@@ -348,7 +377,25 @@ def _wrap_oom(exc, model=None, optimizer=None):
     raise RuntimeError(explain_oom(exc, model, optimizer)) from exc
 
 
+class oom_diagnostics:
+    """Context manager wrapping device execution: an OOM escapes with
+    the full diagnostic, everything else re-raises untouched. Shared by
+    TrainStep and DistTrainStep."""
+
+    def __init__(self, model=None, optimizer=None):
+        self.model = model
+        self.optimizer = optimizer
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None and isinstance(exc, Exception):
+            _wrap_oom(exc, self.model, self.optimizer)
+        return False
+
+
 __all__ += ["memory_stats", "memory_allocated", "max_memory_allocated",
             "memory_reserved", "max_memory_reserved",
             "reset_max_memory_allocated", "reset_max_memory_reserved",
-            "explain_oom"]
+            "explain_oom", "oom_diagnostics"]

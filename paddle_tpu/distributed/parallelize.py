@@ -356,15 +356,12 @@ class DistTrainStep:
         param_vals = [p._value for p in self._params]
         buffer_vals = [b._value for b in self._buffers]
         opt_state = {k: list(v) for k, v in opt._accumulators.items()}
-        try:
+        from ..device import oom_diagnostics
+        with oom_diagnostics(self.model, opt):
             loss_val, new_params, new_buffers, new_opt = self._jitted(
                 param_vals, buffer_vals, opt_state, R.next_key(),
                 jnp.asarray(opt._global_step, jnp.int32),
                 jnp.asarray(opt.get_lr(), jnp.float32), args_vals)
-        except Exception as e:  # noqa: BLE001 — OOM gets a diagnostic
-            from ..device import _wrap_oom
-            _wrap_oom(e, self.model, opt)
-            raise
         for p, v in zip(self._params, new_params):
             p._value = v
         for b, v in zip(self._buffers, new_buffers):

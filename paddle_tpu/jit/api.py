@@ -300,15 +300,12 @@ class TrainStep:
             lambda x: x._value if isinstance(x, Tensor) else
             (jnp.asarray(x) if isinstance(x, np.ndarray) else x), args,
             is_leaf=lambda x: isinstance(x, (Tensor, np.ndarray)))
-        try:
+        from ..device import oom_diagnostics
+        with oom_diagnostics(self.model, opt):
             loss_val, new_params, new_buffers, new_opt = self._jitted(
                 param_vals, buffer_vals, opt_state, R.next_key(),
                 jnp.asarray(opt._global_step, jnp.int32),
                 jnp.asarray(opt.get_lr(), jnp.float32), args_vals)
-        except Exception as e:  # noqa: BLE001 — OOM gets a diagnostic
-            from ..device import _wrap_oom
-            _wrap_oom(e, self.model, opt)
-            raise
         for p, v in zip(self._params, new_params):
             p._value = v
         for b, v in zip(self._buffers, new_buffers):
