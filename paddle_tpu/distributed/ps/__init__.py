@@ -19,6 +19,7 @@ import threading
 import numpy as np
 
 __all__ = ["MemorySparseTable", "MemoryDenseTable", "PsServer", "PsClient",
+           "GeoCommunicator",
            "SparseAccessor"]
 
 
@@ -53,16 +54,21 @@ class MemorySparseTable:
         self.accessor = accessor or SparseAccessor(embedding_dim,
                                                    **accessor_kwargs)
         self._rows: dict[int, np.ndarray] = {}
+        self._last_seen: dict[int, int] = {}
+        self._tick = 0
         self._lock = threading.Lock()
 
     def pull(self, ids):
         ids = np.asarray(ids).reshape(-1)
         with self._lock:
+            self._tick += 1
             out = []
             for i in ids:
-                row = self._rows.get(int(i))
+                i = int(i)
+                row = self._rows.get(i)
                 if row is None:     # lazy init only for cold ids
-                    row = self._rows[int(i)] = self.accessor.init_row()
+                    row = self._rows[i] = self.accessor.init_row()
+                self._last_seen[i] = self._tick
                 out.append(row)
         return np.stack(out)
 
@@ -70,12 +76,26 @@ class MemorySparseTable:
         ids = np.asarray(ids).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
         with self._lock:
+            self._tick += 1
             for i, g in zip(ids, grads):
                 i = int(i)
                 row = self._rows.get(i)
                 if row is None:
                     row = self._rows[i] = self.accessor.init_row()
                 self._rows[i] = self.accessor.update(row, g)
+                self._last_seen[i] = self._tick
+
+    def shrink(self, unseen_ticks=1000):
+        """Evict rows not pulled/pushed within ``unseen_ticks`` accesses
+        (reference ctr accessor delete_after_unseen_days / table Shrink).
+        Returns the number of evicted rows."""
+        with self._lock:
+            stale = [i for i, t in self._last_seen.items()
+                     if self._tick - t > unseen_ticks]
+            for i in stale:
+                self._rows.pop(i, None)
+                self._last_seen.pop(i, None)
+            return len(stale)
 
     def size(self):
         with self._lock:
@@ -92,6 +112,10 @@ class MemorySparseTable:
         with self._lock:
             self._rows = {int(i): r.astype(np.float32)
                           for i, r in zip(data["ids"], data["rows"])}
+            # restored rows start fresh in the eviction clock: stale
+            # pre-load timestamps would evict them instantly, and rows
+            # without an entry could never be evicted
+            self._last_seen = {i: self._tick for i in self._rows}
 
 
 class MemoryDenseTable:
@@ -110,6 +134,17 @@ class MemoryDenseTable:
     def push(self, grad):
         with self._lock:
             self._value -= self.lr * np.asarray(grad, np.float32)
+
+    def apply_delta(self, delta):
+        """Merge a worker's accumulated delta; returns the fresh value
+        (geo-SGD server op) — all _value mutation stays under _lock."""
+        with self._lock:
+            self._value = self._value + np.asarray(delta, np.float32)
+            return self._value.copy()
+
+    def set_value(self, value):
+        with self._lock:
+            self._value = np.asarray(value, np.float32).copy()
 
 
 # ---------------------------------------------------------------------------
@@ -148,6 +183,48 @@ def _srv_push_dense(table_id, grad):
 
 def _srv_table_size(table_id):
     return _SERVER_TABLES[table_id].size()
+
+
+def _srv_save_all(dirname):
+    """Persist every registered table (reference fleet save_persistables
+    -> table Save): sparse tables as npz id/row dumps, dense tables as
+    npy."""
+    import os
+    os.makedirs(dirname, exist_ok=True)
+    saved = []
+    for tid, table in _SERVER_TABLES.items():
+        if isinstance(table, MemorySparseTable):
+            table.save(os.path.join(dirname, f"sparse_{tid}"))
+            saved.append(("sparse", tid))
+        elif isinstance(table, MemoryDenseTable):
+            np.save(os.path.join(dirname, f"dense_{tid}.npy"),
+                    table.pull())
+            saved.append(("dense", tid))
+    return saved
+
+
+def _srv_load_all(dirname):
+    """Restore tables saved by _srv_save_all into the registered tables
+    (tables must be created first — the reference loads into configured
+    table schemas the same way)."""
+    import os
+    loaded = []
+    for tid, table in _SERVER_TABLES.items():
+        if isinstance(table, MemorySparseTable):
+            p = os.path.join(dirname, f"sparse_{tid}.npz")
+            if os.path.exists(p):
+                table.load(p)
+                loaded.append(("sparse", tid))
+        elif isinstance(table, MemoryDenseTable):
+            p = os.path.join(dirname, f"dense_{tid}.npy")
+            if os.path.exists(p):
+                table.set_value(np.load(p))
+                loaded.append(("dense", tid))
+    return loaded
+
+
+def _srv_shrink(table_id, unseen_ticks):
+    return _SERVER_TABLES[table_id].shrink(unseen_ticks)
 
 
 class PsServer:
@@ -212,3 +289,68 @@ class PsClient:
     def table_size(self, table_id):
         return self._rpc.rpc_sync(self.server, _srv_table_size,
                                   args=(table_id,))
+
+    def save_persistables(self, dirname):
+        """reference fleet.save_persistables → per-table Save on the
+        server side."""
+        return self._rpc.rpc_sync(self.server, _srv_save_all,
+                                  args=(dirname,))
+
+    def load_persistables(self, dirname):
+        return self._rpc.rpc_sync(self.server, _srv_load_all,
+                                  args=(dirname,))
+
+    def shrink(self, table_id, unseen_ticks=1000):
+        """Evict stale sparse rows server-side (reference table Shrink)."""
+        return self._rpc.rpc_sync(self.server, _srv_shrink,
+                                  args=(table_id, unseen_ticks))
+
+
+def _srv_geo_pull_and_add(table_id, delta):
+    """Geo-SGD server op: apply the worker's accumulated delta, return
+    the fresh global value (one round trip)."""
+    t = _SERVER_TABLES[table_id]
+    if not isinstance(t, MemoryDenseTable):
+        raise TypeError(
+            f"GeoCommunicator needs a DENSE table; table {table_id} is "
+            f"{type(t).__name__}")
+    return t.apply_delta(delta)
+
+
+class GeoCommunicator:
+    """Geo-SGD async dense communicator (reference
+    distributed/ps/communicator GeoCommunicator + a_sync_configs k_steps):
+    the worker trains on a LOCAL copy; every ``k_steps`` it ships the
+    accumulated delta (local − base) to the PS, which merges deltas from
+    all workers, and rebases on the merged value. Staleness is bounded by
+    k_steps; no per-step round trip."""
+
+    def __init__(self, client: "PsClient", table_id, k_steps=4):
+        self.client = client
+        self.table_id = table_id
+        self.k_steps = k_steps
+        self._local = np.asarray(client.pull_dense(table_id),
+                                 np.float32).copy()
+        self._base = self._local.copy()
+        self._step = 0
+
+    @property
+    def value(self):
+        return self._local
+
+    def step(self, grad, lr=0.05):
+        """One local SGD step; sync with the PS every k_steps."""
+        self._local = self._local - lr * np.asarray(grad, np.float32)
+        self._step += 1
+        if self._step % self.k_steps == 0:
+            self.sync()
+        return self._local
+
+    def sync(self):
+        delta = self._local - self._base
+        merged = self.client._rpc.rpc_sync(
+            self.client.server, _srv_geo_pull_and_add,
+            args=(self.table_id, delta))
+        self._local = np.asarray(merged, np.float32).copy()
+        self._base = self._local.copy()
+        return self._local

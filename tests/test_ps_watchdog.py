@@ -83,3 +83,66 @@ class TestWatchdog:
         with comm_guard("allgather"):
             assert get_watchdog()._inflight
         assert not get_watchdog()._inflight
+
+
+class TestPsPersistenceGeoShrink:
+    """PS depth (SURVEY item 18): server-side persistence, geo-SGD async
+    communicator, stale-row eviction."""
+
+    def test_save_load_persistables_roundtrip(self, tmp_path):
+        server = ps.PsServer("ps_persist", rank=0, world_size=1)
+        try:
+            client = ps.PsClient("ps_persist")
+            client.create_sparse_table(10, embedding_dim=4, init_std=0.01)
+            client.create_dense_table(11, [3], learning_rate=1.0)
+            client.push_sparse(10, [7], np.ones((1, 4)))
+            client.push_dense(11, np.ones(3))
+            v_sparse = client.pull_sparse(10, [7])
+            v_dense = client.pull_dense(11)
+            saved = client.save_persistables(str(tmp_path / "ck"))
+            assert ("sparse", 10) in saved and ("dense", 11) in saved
+            # trash the live state, then restore
+            client.push_sparse(10, [7], np.full((1, 4), 100.0))
+            client.push_dense(11, np.full(3, 100.0))
+            loaded = client.load_persistables(str(tmp_path / "ck"))
+            assert ("sparse", 10) in loaded and ("dense", 11) in loaded
+            np.testing.assert_allclose(client.pull_sparse(10, [7]),
+                                       v_sparse, rtol=1e-6)
+            np.testing.assert_allclose(client.pull_dense(11), v_dense,
+                                       rtol=1e-6)
+        finally:
+            server.stop()
+
+    def test_geo_communicator_bounded_staleness(self):
+        server = ps.PsServer("ps_geo", rank=0, world_size=1)
+        try:
+            client = ps.PsClient("ps_geo")
+            client.create_dense_table(20, [4], learning_rate=1.0)
+            geo = ps.GeoCommunicator(client, 20, k_steps=2)
+            base = geo.value.copy()
+            g = np.ones(4, np.float32)
+            geo.step(g, lr=0.1)         # local only
+            # server unchanged after 1 step
+            np.testing.assert_allclose(client.pull_dense(20), base,
+                                       rtol=1e-6)
+            geo.step(g, lr=0.1)         # k_steps reached -> sync
+            np.testing.assert_allclose(client.pull_dense(20),
+                                       base - 0.2, rtol=1e-5)
+            # two communicators (two workers) both merge their deltas
+            geo2 = ps.GeoCommunicator(client, 20, k_steps=1)
+            geo2.step(g, lr=0.1)
+            np.testing.assert_allclose(client.pull_dense(20),
+                                       base - 0.3, rtol=1e-5)
+        finally:
+            server.stop()
+
+    def test_shrink_evicts_stale_rows(self):
+        t = ps.MemorySparseTable(4, init_std=0.0)
+        t.pull([1, 2, 3])
+        for _ in range(10):
+            t.pull([1])                 # keep row 1 warm
+        assert t.size() == 3
+        n = t.shrink(unseen_ticks=5)
+        assert n == 2 and t.size() == 1
+        # evicted rows lazily re-init on next access
+        assert t.pull([2]).shape == (1, 4)
