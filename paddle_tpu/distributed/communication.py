@@ -455,12 +455,19 @@ def _recv_at(tensor, src, seq):
     from .watchdog import comm_guard
     with comm_guard("recv", f"src={src} seq={seq}"):
         payload = client.blocking_key_value_get(key, timeout_ms)
+        if isinstance(payload, bytes):
+            payload = payload.decode()
+        if payload == "@socket":
+            # sender routed the bytes over the direct TCP data plane
+            from .p2p_transport import get_transport
+            raw = get_transport().take(src, seq, timeout_ms / 1000.0)
+        else:
+            raw = base64.b64decode(payload)
     try:
         client.key_value_delete(key)  # free the coordinator's copy
     except Exception:  # noqa: BLE001 — cleanup is best-effort
         pass
-    arr = np.frombuffer(base64.b64decode(payload),
-                        dtype=np.asarray(tensor._value).dtype)
+    arr = np.frombuffer(raw, dtype=np.asarray(tensor._value).dtype)
     tensor._in_place_update(
         jnp.asarray(arr.reshape(np.asarray(tensor._value).shape)))
     return _Task(tensor._value)
@@ -504,13 +511,29 @@ class _AsyncTask(_Task):
         return not self._thread.is_alive()
 
 
+_P2P_SOCKET_MIN = 1 << 20     # >=1MB rides the direct TCP data plane
+
+
 def _send_at(tensor, dst, seq):
     import base64
     client = _kv_client()
     raw = np.asarray(tensor._value).tobytes()
+    key = f"ptpu_p2p/{get_rank()}/{dst}/{seq}"
+    if len(raw) >= _P2P_SOCKET_MIN:
+        # data plane (SURVEY item 17): direct worker->worker TCP; the KV
+        # store carries only the rendezvous marker, so the coordinator
+        # never sees tensor bytes and the control-plane cap is moot.
+        # Marker FIRST: the receiver lazily creates its listener (and
+        # publishes its address) when it sees "@socket" — connecting
+        # before the marker would deadlock against a receiver blocked on
+        # the message key
+        from .p2p_transport import get_transport
+        client.key_value_set(key, "@socket")
+        get_transport().send_bytes(dst, seq, raw)
+        return
     _check_payload_size(len(raw), "send")
     payload = base64.b64encode(raw).decode()
-    client.key_value_set(f"ptpu_p2p/{get_rank()}/{dst}/{seq}", payload)
+    client.key_value_set(key, payload)
 
 
 def isend(tensor, dst=0, group=None, sync_op=True):

@@ -1,0 +1,188 @@
+"""Data-plane p2p transport (SURVEY item 17; reference: the
+FleetExecutor's brpc MessageBus + ProcessGroup NCCL Send/Recv — a real
+byte channel between workers, not the coordination service).
+
+Design: each process lazily starts ONE listener thread on a free port
+and publishes ``ptpu_p2p_addr/{rank}`` in the coordinator KV store.
+send() opens (and caches) a direct TCP connection to the destination and
+streams [header | raw bytes]; the listener parks messages in an inbox
+keyed (src, seq) where recv() claims them. Ordering rides the existing
+per-(src, dst) sequence numbers; the KV store carries only the
+rendezvous marker, so activation-sized tensors never transit the
+coordinator (the control-plane cap in communication.py stays intact).
+
+Python-socket note: sendall/recv_into on large buffers are memcpy-bound
+(GB/s), far above DCN for the eager path's purposes; the compiled path
+(GSPMD/ppermute over ICI) remains the high-bandwidth data plane."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["P2PTransport", "get_transport"]
+
+_HDR = struct.Struct("!iiq")          # src, seq, nbytes
+
+
+class P2PTransport:
+    def __init__(self, rank: int, kv_client):
+        self.rank = rank
+        self._kv = kv_client
+        self._inbox: dict[tuple[int, int], bytes] = {}
+        self._cv = threading.Condition()
+        self._conns: dict[int, socket.socket] = {}
+        self._conn_lock = threading.Lock()      # guards the dicts only
+        self._dst_locks: dict[int, threading.Lock] = {}
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("", 0))                 # all interfaces: the
+        # advertised address is gethostbyname(hostname), which is
+        # non-loopback on multi-host setups
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        host = socket.gethostname()
+        try:
+            addr_ip = socket.gethostbyname(host)
+        except OSError:
+            addr_ip = "127.0.0.1"
+        self.addr = f"{addr_ip}:{self.port}"
+        kv_client.key_value_set(f"ptpu_p2p_addr/{rank}", self.addr)
+        self._stop = False
+        self._acceptor = threading.Thread(target=self._accept_loop,
+                                          daemon=True)
+        self._acceptor.start()
+
+    # -- receive side -------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn):
+        try:
+            while True:
+                hdr = self._read_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                src, seq, nbytes = _HDR.unpack(hdr)
+                buf = self._read_exact(conn, nbytes)
+                if buf is None:
+                    return
+                with self._cv:
+                    self._inbox[(src, seq)] = buf
+                    self._cv.notify_all()
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _read_exact(conn, n):
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            r = conn.recv_into(view[got:], n - got)
+            if r == 0:
+                return None
+            got += r
+        return bytes(buf)
+
+    def take(self, src: int, seq: int, timeout: float):
+        """Claim the (src, seq) message; blocks until it arrives."""
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: (src, seq) in self._inbox, timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"p2p socket recv from rank {src} seq {seq} timed "
+                    f"out after {timeout}s")
+            return self._inbox.pop((src, seq))
+
+    # -- send side ----------------------------------------------------------
+    def _dst_lock(self, dst):
+        with self._conn_lock:
+            lk = self._dst_locks.get(dst)
+            if lk is None:
+                lk = self._dst_locks[dst] = threading.Lock()
+            return lk
+
+    def _connect(self, dst: int, timeout: float):
+        """Caller must hold the per-destination lock. The global lock is
+        NOT held across the blocking KV get or the dial — sends to other
+        destinations stay independent."""
+        with self._conn_lock:
+            s = self._conns.get(dst)
+        if s is not None:
+            return s
+        addr = self._kv.blocking_key_value_get(
+            f"ptpu_p2p_addr/{dst}", int(timeout * 1000))
+        if isinstance(addr, bytes):
+            addr = addr.decode()
+        host, port = addr.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._conn_lock:
+            self._conns[dst] = s
+        return s
+
+    def send_bytes(self, dst: int, seq: int, payload: bytes,
+                   timeout: float = 60.0):
+        """Per-destination lock serializes writes on one socket (header+
+        body must be contiguous); a dead cached connection is evicted and
+        redialed once."""
+        with self._dst_lock(dst):
+            for attempt in (0, 1):
+                s = self._connect(dst, timeout)
+                try:
+                    s.sendall(_HDR.pack(self.rank, seq, len(payload)))
+                    s.sendall(payload)
+                    return
+                except OSError:
+                    with self._conn_lock:
+                        self._conns.pop(dst, None)
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                    if attempt == 1:
+                        raise
+
+    def close(self):
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for s in self._conns.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+_TRANSPORT: list[P2PTransport | None] = [None]
+
+
+_TRANSPORT_LOCK = threading.Lock()
+
+
+def get_transport():
+    """Process singleton, created lazily on first large send/recv (needs
+    the jax.distributed KV client for address exchange). Double-checked
+    under a lock: isend/irecv worker threads may race here, and two
+    instances would publish two addresses (last write wins, the other's
+    inbox orphaned)."""
+    if _TRANSPORT[0] is None:
+        with _TRANSPORT_LOCK:
+            if _TRANSPORT[0] is None:
+                from .communication import _kv_client
+                from .env import get_rank
+                _TRANSPORT[0] = P2PTransport(get_rank(), _kv_client())
+    return _TRANSPORT[0]

@@ -69,6 +69,20 @@ def main():
         dist.recv(buf, src=0)
         np.testing.assert_allclose(np.asarray(buf._value), np.arange(4.0))
 
+    # LARGE send/recv rides the direct TCP data plane (SURVEY item 17):
+    # 2M floats = 8MB, far above the coordinator-KV control-plane cap
+    big = np.arange(2_000_000, dtype=np.float32)
+    if rank == 0:
+        dist.send(paddle.to_tensor(big), dst=1)
+        # and a second one to exercise sequence ordering on the channel
+        dist.send(paddle.to_tensor(big * 2), dst=1)
+    else:
+        buf = paddle.zeros([2_000_000])
+        dist.recv(buf, src=0)
+        np.testing.assert_allclose(np.asarray(buf._value), big)
+        dist.recv(buf, src=0)
+        np.testing.assert_allclose(np.asarray(buf._value), big * 2)
+
     # batch_isend_irecv ring exchange
     from paddle_tpu.distributed.communication import P2POp, batch_isend_irecv
     send_t = paddle.to_tensor(np.full((2,), float(rank), np.float32))
