@@ -44,17 +44,34 @@ class P2PTransport:
         # non-loopback on multi-host setups
         self._srv.listen(16)
         self.port = self._srv.getsockname()[1]
-        host = socket.gethostname()
-        try:
-            addr_ip = socket.gethostbyname(host)
-        except OSError:
-            addr_ip = "127.0.0.1"
-        self.addr = f"{addr_ip}:{self.port}"
+        self.addr = f"{self._local_ip()}:{self.port}"
         kv_client.key_value_set(f"ptpu_p2p_addr/{rank}", self.addr)
         self._stop = False
         self._acceptor = threading.Thread(target=self._accept_loop,
                                           daemon=True)
         self._acceptor.start()
+
+    @staticmethod
+    def _local_ip():
+        """The IP peers can reach us on: the outbound-interface address
+        toward the coordinator (UDP-connect trick — gethostbyname(
+        hostname) resolves to 127.0.1.1 on stock Debian /etc/hosts,
+        which would break multi-host p2p)."""
+        try:
+            from jax._src import distributed
+            coord = distributed.global_state.coordinator_address
+            host = coord.rsplit(":", 1)[0] if coord else "8.8.8.8"
+        except Exception:  # noqa: BLE001
+            host = "8.8.8.8"
+        try:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                probe.connect((host, 1))
+                return probe.getsockname()[0]
+            finally:
+                probe.close()
+        except OSError:
+            return "127.0.0.1"
 
     # -- receive side -------------------------------------------------------
     def _accept_loop(self):
@@ -92,7 +109,7 @@ class P2PTransport:
             if r == 0:
                 return None
             got += r
-        return bytes(buf)
+        return buf              # bytearray: no redundant multi-MB copy
 
     def take(self, src: int, seq: int, timeout: float):
         """Claim the (src, seq) message; blocks until it arrives."""
@@ -133,7 +150,13 @@ class P2PTransport:
         return s
 
     def send_bytes(self, dst: int, seq: int, payload: bytes,
-                   timeout: float = 60.0):
+                   timeout: float | None = None):
+        if timeout is None:
+            # match the recv side's flag-derived budget (2x watchdog
+            # threshold) — a hardcoded short timeout would make the
+            # sender give up against a receiver still within its own
+            from .. import flags
+            timeout = 2.0 * float(flags.flag("comm_timeout_seconds"))
         """Per-destination lock serializes writes on one socket (header+
         body must be contiguous); a dead cached connection is evicted and
         redialed once."""

@@ -125,3 +125,28 @@ class GPTForCausalLM(nn.Layer):
         args = tuple(params[n] for n in names) + (
             params["wte"], params["wpe"], params["lnf_w"], params["lnf_b"])
         return apply_op("gpt_forward", fwd, args, {})
+
+
+def _gpt_generate_method(self, input_ids, max_new_tokens=32,
+                         temperature=1.0, top_k=0, seed=0):
+    """Autoregressive sampling (reference PaddleNLP generation_utils);
+    reuses llama's re-encode loop — GPT's learned position TABLE bounds
+    the total length (checked up front), and the KV-cache fused decode
+    lives on the llama family, whose decoder the serving path targets."""
+    from ..core import autograd
+    from .llama import _generate
+    ids = input_ids._value if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    total = ids.shape[1] + int(max_new_tokens)
+    if total > self.config.max_position_embeddings:
+        raise ValueError(
+            f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+            f"= {total} exceeds max_position_embeddings "
+            f"({self.config.max_position_embeddings})")
+    with autograd.no_grad():
+        out = _generate(self, ids, int(max_new_tokens), float(temperature),
+                        int(top_k), jax.random.PRNGKey(seed))
+    return Tensor(out, stop_gradient=True)
+
+
+GPTForCausalLM.generate = _gpt_generate_method

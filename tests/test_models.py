@@ -341,3 +341,18 @@ class TestBertAndQwen:
         for _ in range(3):
             l = float(step(ids, ids))
         assert l < l0
+
+
+class TestGPTGenerate:
+    def test_gpt_generate_greedy(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM
+        paddle.seed(0)
+        m = GPTForCausalLM("debug")
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (2, 8), dtype=np.int32))
+        out = _np(m.generate(ids, max_new_tokens=5, temperature=0.0))
+        assert out.shape == (2, 13)
+        np.testing.assert_array_equal(out[:, :8], _np(ids))
+        # deterministic under greedy
+        out2 = _np(m.generate(ids, max_new_tokens=5, temperature=0.0))
+        np.testing.assert_array_equal(out, out2)
