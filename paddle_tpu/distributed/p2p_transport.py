@@ -32,16 +32,15 @@ class P2PTransport:
     def __init__(self, rank: int, kv_client):
         self.rank = rank
         self._kv = kv_client
-        self._inbox: dict[tuple[int, int], bytes] = {}
+        self._inbox: dict[tuple[int, int], bytes | bytearray] = {}
         self._cv = threading.Condition()
         self._conns: dict[int, socket.socket] = {}
         self._conn_lock = threading.Lock()      # guards the dicts only
         self._dst_locks: dict[int, threading.Lock] = {}
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind(("", 0))                 # all interfaces: the
-        # advertised address is gethostbyname(hostname), which is
-        # non-loopback on multi-host setups
+        self._srv.bind(("", 0))   # all interfaces; see _local_ip for
+        # the address peers are told to dial
         self._srv.listen(16)
         self.port = self._srv.getsockname()[1]
         self.addr = f"{self._local_ip()}:{self.port}"
@@ -112,7 +111,9 @@ class P2PTransport:
         return buf              # bytearray: no redundant multi-MB copy
 
     def take(self, src: int, seq: int, timeout: float):
-        """Claim the (src, seq) message; blocks until it arrives."""
+        """Claim the (src, seq) message; blocks until it arrives.
+        Returns a MUTABLE buffer (bytearray — no copy on receive);
+        callers that need bytes semantics must copy."""
         with self._cv:
             ok = self._cv.wait_for(
                 lambda: (src, seq) in self._inbox, timeout)
@@ -151,15 +152,14 @@ class P2PTransport:
 
     def send_bytes(self, dst: int, seq: int, payload: bytes,
                    timeout: float | None = None):
+        """Per-destination lock serializes writes on one socket (header+
+        body must be contiguous); a dead cached connection is evicted
+        and redialed once. Default timeout matches the recv side's
+        flag-derived budget (2x watchdog threshold) so the sender never
+        gives up before a receiver still within its own."""
         if timeout is None:
-            # match the recv side's flag-derived budget (2x watchdog
-            # threshold) — a hardcoded short timeout would make the
-            # sender give up against a receiver still within its own
             from .. import flags
             timeout = 2.0 * float(flags.flag("comm_timeout_seconds"))
-        """Per-destination lock serializes writes on one socket (header+
-        body must be contiguous); a dead cached connection is evicted and
-        redialed once."""
         with self._dst_lock(dst):
             for attempt in (0, 1):
                 s = self._connect(dst, timeout)

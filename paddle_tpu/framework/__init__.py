@@ -18,3 +18,5 @@ __all__ = ["ParamAttr", "save", "load", "random",
            "disable_static", "create_parameter", "LazyGuard",
            "disable_signal_handler", "is_complex", "is_floating_point",
            "is_integer", "is_tensor", "flops"]
+
+from .selected_rows import SelectedRows, StringTensor  # noqa: E402,F401

@@ -1,0 +1,150 @@
+"""SelectedRows + StringTensor (reference: paddle/phi/core/
+selected_rows.h:27 — the sparse-gradient/sparse-table value type keyed
+by int64 row ids; paddle/phi/core/string_tensor.h — host-side string
+payloads for tokenizer/faster-tokenizer ops).
+
+TPU-native altitude: on TPU, embedding gradients materialize dense
+(XLA's scatter-add is MXU/HBM-efficient) and huge sparse tables live in
+the parameter server — SelectedRows here is the EXCHANGE format between
+those worlds: a {rows, value} pair with merge/to-dense/apply semantics,
+used to ship deduplicated embedding updates to distributed.ps without a
+vocab-sized dense buffer. StringTensor is a host-side object array (XLA
+has no string dtype; the reference keeps strings on CPU too)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["SelectedRows", "StringTensor"]
+
+
+class SelectedRows:
+    """{rows: int64[n], value: [n, ...]} with logical height (vocab
+    rows). Duplicate row ids are allowed until merge() (reference
+    merge_selected_rows op)."""
+
+    def __init__(self, rows, value, height):
+        import jax
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        self._rows = np.asarray(rows, np.int64).reshape(-1)
+        if isinstance(value, Tensor):
+            v = value._value
+        elif isinstance(value, jax.Array):
+            v = value               # zero-copy: merge()/from_dense_grad
+        else:
+            v = jnp.asarray(np.asarray(value))
+        if v.shape[0] != self._rows.size:
+            raise ValueError(
+                f"value rows ({v.shape[0]}) must match len(rows) "
+                f"({self._rows.size})")
+        self._value = v
+        self._height = int(height)
+
+    # -- reference surface --------------------------------------------------
+    def rows(self):
+        return self._rows
+
+    def value(self):
+        from ..core.tensor import Tensor
+        return Tensor(self._value)
+
+    def height(self):
+        return self._height
+
+    def set_height(self, h):
+        self._height = int(h)
+
+    def has_key(self, key):
+        return bool((self._rows == int(key)).any())
+
+    def sync_index(self):
+        return self  # index is implicit (rows array)
+
+    @property
+    def shape(self):
+        return [self._height] + list(self._value.shape[1:])
+
+    # -- semantics ----------------------------------------------------------
+    def merge(self):
+        """Sum duplicate row ids (reference merge_selected_rows): the
+        canonical form for applying a sparse gradient."""
+        import jax.numpy as jnp
+        uniq, inv = np.unique(self._rows, return_inverse=True)
+        merged = jnp.zeros((uniq.size,) + self._value.shape[1:],
+                           self._value.dtype)
+        merged = merged.at[jnp.asarray(inv)].add(self._value)
+        return SelectedRows(uniq, merged, self._height)
+
+    def to_dense(self):
+        """Materialize the [height, ...] dense tensor (zeros off-rows)."""
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        m = self.merge()
+        dense = jnp.zeros((self._height,) + self._value.shape[1:],
+                          self._value.dtype)
+        return Tensor(dense.at[jnp.asarray(m._rows)].set(m._value))
+
+    @classmethod
+    def from_dense_grad(cls, grad, touched_rows):
+        """Build the compact exchange form from a dense gradient and the
+        ids actually touched (an embedding lookup's unique input ids) —
+        the piece that keeps vocab-sized buffers off the wire."""
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        g = grad._value if isinstance(grad, Tensor) else jnp.asarray(grad)
+        rows = np.unique(np.asarray(touched_rows).reshape(-1))
+        return cls(rows, g[jnp.asarray(rows)], g.shape[0])
+
+    def push_to_ps(self, client, table_id, scale=1.0):
+        """Ship the (merged) sparse update to a parameter-server table —
+        the reference's sparse-grad path (push_sparse of SelectedRows)."""
+        m = self.merge()
+        client.push_sparse(table_id, m._rows,
+                           np.asarray(m._value, np.float32) * scale)
+        return m
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self._height}, "
+                f"nrows={self._rows.size}, "
+                f"value_shape={tuple(self._value.shape)})")
+
+
+class StringTensor:
+    """Host-side string array (reference string_tensor.h): numpy object
+    dtype, shape/slicing parity, numpy() accessor. Feeds tokenizer-style
+    host preprocessing; never enters XLA."""
+
+    def __init__(self, data, name=None):
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return "pstring"                    # reference dtype name
+
+    def numpy(self):
+        return self._data
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        o = other._data if isinstance(other, StringTensor) else other
+        return bool(np.array_equal(self._data, np.asarray(o, object)))
+
+    __hash__ = None  # mutable value semantics: == compares contents
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"

@@ -304,3 +304,48 @@ class TestFusedNN:
             q, q, q, paddle.to_tensor(np.array([2, 4])),
             paddle.to_tensor(np.array([2, 4])))
         np.testing.assert_allclose(_np(out)[0, :, 2:], 0.0)
+
+
+class TestSelectedRowsStringTensor:
+    """SURVEY item 2 gap notes: SelectedRows (sparse-gradient exchange
+    format, reference selected_rows.h:27) and StringTensor."""
+
+    def test_merge_and_to_dense(self):
+        from paddle_tpu.framework import SelectedRows
+        sr = SelectedRows([2, 0, 2], np.array(
+            [[1., 1.], [2., 2.], [3., 3.]], np.float32), height=4)
+        assert sr.shape == [4, 2] and sr.has_key(2) and not sr.has_key(1)
+        m = sr.merge()
+        np.testing.assert_array_equal(m.rows(), [0, 2])
+        np.testing.assert_allclose(_np(m.value()), [[2, 2], [4, 4]])
+        dense = _np(sr.to_dense())
+        np.testing.assert_allclose(
+            dense, [[2, 2], [0, 0], [4, 4], [0, 0]])
+
+    def test_from_dense_grad_and_ps_push(self):
+        from paddle_tpu.framework import SelectedRows
+        import paddle_tpu.distributed.ps as ps
+        # dense embedding grad where only rows {1, 3} were touched
+        g = np.zeros((8, 4), np.float32)
+        g[1] = 1.0
+        g[3] = 2.0
+        sr = SelectedRows.from_dense_grad(paddle.to_tensor(g), [3, 1, 3])
+        assert sr.rows().tolist() == [1, 3]
+        table = ps.MemorySparseTable(4, init_std=0.0, learning_rate=0.1)
+
+        class _Client:  # direct-table client shim
+            def push_sparse(self, tid, ids, grads):
+                table.push(ids, grads)
+        sr.push_to_ps(_Client(), 0)
+        np.testing.assert_allclose(table.pull([1]), -0.1, rtol=1e-5)
+        np.testing.assert_allclose(table.pull([3]), -0.2, rtol=1e-5)
+        np.testing.assert_allclose(table.pull([0]), 0.0)
+
+    def test_string_tensor(self):
+        from paddle_tpu.framework import StringTensor
+        st = StringTensor([["ab", "cd"], ["ef", "gh"]])
+        assert st.shape == [2, 2] and st.dtype == "pstring"
+        assert st[0][1] == "cd"
+        assert st[1].shape == [2]
+        assert len(st) == 2
+        assert st == StringTensor([["ab", "cd"], ["ef", "gh"]])
