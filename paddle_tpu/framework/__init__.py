@@ -20,3 +20,4 @@ __all__ = ["ParamAttr", "save", "load", "random",
            "is_integer", "is_tensor", "flops"]
 
 from .selected_rows import SelectedRows, StringTensor  # noqa: E402,F401
+__all__ += ["SelectedRows", "StringTensor"]

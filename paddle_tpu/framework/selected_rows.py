@@ -40,6 +40,13 @@ class SelectedRows:
                 f"({self._rows.size})")
         self._value = v
         self._height = int(height)
+        # fail loudly: JAX scatter silently DROPS out-of-bounds indices,
+        # which would lose updates in to_dense()
+        if self._rows.size and (self._rows.min() < 0
+                                or self._rows.max() >= self._height):
+            raise ValueError(
+                f"row ids must be in [0, height={self._height}); got "
+                f"range [{self._rows.min()}, {self._rows.max()}]")
 
     # -- reference surface --------------------------------------------------
     def rows(self):

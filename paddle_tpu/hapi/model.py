@@ -45,10 +45,20 @@ class Model:
 
     # -- configuration ------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
-        """reference model.py prepare."""
+                amp_configs=None, jit=None):
+        """reference model.py prepare. ``jit``: compile the whole train
+        batch (forward + loss + backward + optimizer) into ONE XLA
+        executable via TrainStep — None (default) auto-enables on the
+        TPU backend, where eager per-op dispatch pays a host round trip
+        per op; falls back to eager if the network doesn't trace."""
         self._optimizer = optimizer
         self._loss = loss
+        if jit is None:
+            import jax
+            jit = jax.default_backend() == "tpu"
+        self._jit = bool(jit)
+        self._jit_step = None
+        self._jit_sig = None
         if metrics is None:
             self._metrics = []
         elif isinstance(metrics, Metric):
@@ -68,6 +78,18 @@ class Model:
     def _train_one(self, inputs, labels, update=True):
         self.network.train()
         ins = _to_tensor_list(inputs)
+        if getattr(self, "_jit", False) and update \
+                and self._optimizer is not None \
+                and self._loss is not None \
+                and not any(p.grad is not None
+                            for p in self._optimizer._parameter_list):
+            # the pending-grad check keeps gradient ACCUMULATION correct:
+            # TrainStep computes grads inside its own program and would
+            # silently ignore (and never clear) grads accumulated by
+            # eager update=False steps
+            got = self._train_one_jit(ins, labels)
+            if got is not None:
+                return got
         outs = self.network(*ins)
         losses = self._compute_loss(outs, labels)
         total = losses[0]
@@ -77,6 +99,48 @@ class Model:
         if update and self._optimizer is not None:
             self._optimizer.step()
             self._optimizer.clear_grad()
+        return [float(lo) for lo in losses], outs
+
+    def _train_one_jit(self, ins, labels):
+        """One compiled train batch (TrainStep with aux): loss, metrics
+        inputs, backward, and optimizer update in a single device
+        execution. Returns None to signal eager fallback (untraceable
+        network)."""
+        labels_l = _to_tensor_list(labels) if labels is not None else []
+        sig = (len(ins), len(labels_l))
+        if self._jit_step is None or self._jit_sig != sig:
+            from ..jit.api import TrainStep
+            n_ins = sig[0]
+
+            def loss_and_outs(network, *flat):
+                xs, ys = flat[:n_ins], flat[n_ins:]
+                outs = network(*xs)
+                losses = self._compute_loss(outs, list(ys))
+                total = losses[0]
+                for extra in losses[1:]:
+                    total = total + extra
+                outs_l = list(outs) if isinstance(outs, (list, tuple)) \
+                    else [outs]
+                return total, (losses, outs_l)
+
+            self._jit_step = TrainStep(self.network, self._optimizer,
+                                       loss_and_outs, has_aux=True)
+            self._jit_sig = sig
+        import jax
+        try:
+            _, (losses, outs) = self._jit_step(*(list(ins) + labels_l))
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError):
+            import warnings
+            warnings.warn(
+                "hapi: network is not fully traceable; training falls "
+                "back to eager execution (pass prepare(jit=False) to "
+                "silence)", RuntimeWarning, stacklevel=3)
+            self._jit = False
+            self._jit_step = None
+            return None
         return [float(lo) for lo in losses], outs
 
     def eval_batch(self, inputs, labels=None):

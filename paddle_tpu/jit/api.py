@@ -231,10 +231,16 @@ class TrainStep:
         loss = step(x, y)          # compiled after first call
     """
 
-    def __init__(self, model, optimizer, loss_fn: Callable):
+    def __init__(self, model, optimizer, loss_fn: Callable,
+                 has_aux: bool = False):
+        """``has_aux``: loss_fn returns (loss, aux_pytree_of_Tensors);
+        the step returns (loss, aux) with aux materialized — lets
+        callers (hapi metrics) get batch outputs from the SAME compiled
+        program instead of a second forward."""
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
+        self.has_aux = has_aux
         self._jitted = None
         self._params: list[Parameter] = []
         self._buffers: list[Tensor] = []
@@ -268,13 +274,18 @@ class TrainStep:
                 for slot in opt._accumulators:
                     opt._accumulators[slot] = list(opt_state[slot])
                 opt._global_step = step_count
-                loss = self.loss_fn(self.model, *args)
+                res = self.loss_fn(self.model, *args)
+                loss, aux = res if self.has_aux else (res, None)
                 loss.backward()
                 opt.step()
                 new_params = [t._value for t in self._params]
                 new_buffers = [t._value for t in self._buffers]
                 new_opt = {k: list(v) for k, v in opt._accumulators.items()}
-                return loss._value, new_params, new_buffers, new_opt
+                aux_vals = jax.tree_util.tree_map(
+                    lambda x: x._value if isinstance(x, Tensor) else x,
+                    aux, is_leaf=lambda x: isinstance(x, Tensor))
+                return loss._value, aux_vals, new_params, new_buffers, \
+                    new_opt
             finally:
                 for t, v, n, i, g in originals:
                     t._value = v
@@ -302,10 +313,11 @@ class TrainStep:
             is_leaf=lambda x: isinstance(x, (Tensor, np.ndarray)))
         from ..device import oom_diagnostics
         with oom_diagnostics(self.model, opt):
-            loss_val, new_params, new_buffers, new_opt = self._jitted(
-                param_vals, buffer_vals, opt_state, R.next_key(),
-                jnp.asarray(opt._global_step, jnp.int32),
-                jnp.asarray(opt.get_lr(), jnp.float32), args_vals)
+            loss_val, aux_vals, new_params, new_buffers, new_opt = \
+                self._jitted(
+                    param_vals, buffer_vals, opt_state, R.next_key(),
+                    jnp.asarray(opt._global_step, jnp.int32),
+                    jnp.asarray(opt.get_lr(), jnp.float32), args_vals)
         for p, v in zip(self._params, new_params):
             p._value = v
         for b, v in zip(self._buffers, new_buffers):
@@ -315,4 +327,9 @@ class TrainStep:
         opt._global_step += 1
         if opt._lr_scheduler is not None:
             pass  # user steps the scheduler explicitly, as in the reference
+        if self.has_aux:
+            aux = jax.tree_util.tree_map(
+                lambda x: Tensor(x) if isinstance(x, jax.Array) else x,
+                aux_vals)
+            return Tensor(loss_val), aux
         return Tensor(loss_val)
