@@ -72,6 +72,10 @@ class LlamaConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     moe_capacity_factor: float = 1.25
+    # per-expert FFN width (0 = same as intermediate_size); real MoE
+    # checkpoints use a much narrower expert than the dense FFN
+    # (ERNIE-4.5: 1536 vs 12288)
+    moe_intermediate_size: int = 0
     # load-balancing aux loss weight (reference gshard_gate.py applies the
     # GShard me*ce objective; moe_layer.py:263 surfaces it as l_aux) and
     # router z-loss weight (ST-MoE: penalizes logsumexp^2 drift)
@@ -126,6 +130,19 @@ LLAMA_PRESETS = {
                         num_attention_heads=4, num_key_value_heads=2,
                         max_position_embeddings=256, attention_bias=True,
                         tie_word_embeddings=True),
+    # BASELINE config 4 anchor (ERNIE-4.5 family = llama-style decoder
+    # with MoE FFN; reference: ERNIE 4.5 release configs)
+    "ernie-4.5-lite": dict(vocab_size=103424, hidden_size=2560,
+                           intermediate_size=12288, num_hidden_layers=28,
+                           num_attention_heads=20, num_key_value_heads=4,
+                           rope_theta=500000.0, num_experts=64,
+                           num_experts_per_tok=6,
+                           moe_intermediate_size=1536),
+    "ernie-debug": dict(vocab_size=128, hidden_size=64,
+                        intermediate_size=172, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        max_position_embeddings=256, num_experts=4,
+                        num_experts_per_tok=2),
 }
 
 
@@ -525,10 +542,11 @@ class LlamaForCausalLM(nn.Layer):
         mk("post_ln", [L, d], ("pp", None), ones=True)
         if cfg.num_experts > 0:
             E = cfg.num_experts
+            eff = cfg.moe_intermediate_size or ff
             mk("router", [L, d, E], ("pp", None, None))
-            mk("we_gate", [L, E, d, ff], ("pp", "ep", None, "mp"))
-            mk("we_up", [L, E, d, ff], ("pp", "ep", None, "mp"))
-            mk("we_down", [L, E, ff, d], ("pp", "ep", "mp", None))
+            mk("we_gate", [L, E, d, eff], ("pp", "ep", None, "mp"))
+            mk("we_up", [L, E, d, eff], ("pp", "ep", None, "mp"))
+            mk("we_down", [L, E, eff, d], ("pp", "ep", "mp", None))
         else:
             mk("w_gate", [L, d, ff], ("pp", None, "mp"))
             mk("w_up", [L, d, ff], ("pp", None, "mp"))

@@ -464,6 +464,92 @@ class TestShardedCheckpoint:
         assert np.allclose(_np(model.weight), ref)
 
 
+class TestBaselineConfig4SFT:
+    """BASELINE config 4 end to end: Qwen2 SFT under ZeRO-3 (GroupSharded
+    Stage3 analogue) with cross-topology checkpoint reshard — train,
+    snapshot, relaunch on a DIFFERENT mesh, resume, keep training."""
+
+    def test_qwen2_zero3_sft_checkpoint_cross_topology(self, tmp_path):
+        from paddle_tpu.distributed.fleet.sharding import (
+            apply_sharding_specs)
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_loss_fn)
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (4, 32), dtype=np.int32))
+
+        # phase 1: mesh A (dp4 x mp2), ZeRO-3 over dp
+        paddle.seed(8)
+        m1 = LlamaForCausalLM("qwen2-debug")
+        o1 = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                    parameters=m1.parameters())
+        apply_sharding_specs(m1, stage=3, axis="dp", min_size_to_shard=64)
+        meshA = dist.ProcessMesh(shape=[4, 1, 1, 1, 2],
+                                 dim_names=["dp", "pp", "sep", "ep", "mp"])
+        dist.shard_model_state(m1, meshA)
+        step1 = dist.DistTrainStep(m1, o1, llama_loss_fn, meshA,
+                                   donate=False)
+        losses1 = [float(step1(ids, ids)) for _ in range(3)]
+        assert losses1[-1] < losses1[0]
+        path = str(tmp_path / "sft")
+        state1 = {f"model.{k}": v for k, v in m1.state_dict().items()}
+        for k, v in o1.state_dict().items():          # ZeRO-3's point:
+            if hasattr(v, "_value"):                  # sharded moments
+                state1[f"opt.{k}"] = v                # must survive too
+        dist.save_state_dict(state1, path)
+        w_ref = _np(m1._parameters["wq"])
+        mom_ref = np.asarray(o1._accumulators["moment1"][0])
+
+        # phase 2: fresh model on mesh B (dp2 x mp4) — reshard on load
+        paddle.seed(99)  # different init proves the load works
+        m2 = LlamaForCausalLM("qwen2-debug")
+        o2 = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                    parameters=m2.parameters())
+        apply_sharding_specs(m2, stage=3, axis="dp", min_size_to_shard=64)
+        meshB = dist.ProcessMesh(shape=[2, 1, 1, 1, 4],
+                                 dim_names=["dp", "pp", "sep", "ep", "mp"])
+        dist.shard_model_state(m2, meshB)
+        o2._ensure_state()
+        state2 = {f"model.{k}": v for k, v in m2.state_dict().items()}
+        opt_wrap = {}
+        for k, v in o2.state_dict().items():
+            if hasattr(v, "_value"):
+                state2[f"opt.{k}"] = v
+                opt_wrap[k] = v
+        dist.load_state_dict(state2, path)
+        o2.set_state_dict(opt_wrap)                   # wrappers -> slots
+        np.testing.assert_allclose(_np(m2._parameters["wq"]), w_ref,
+                                   atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(o2._accumulators["moment1"][0]), mom_ref,
+            atol=1e-6)
+        step2 = dist.DistTrainStep(m2, o2, llama_loss_fn, meshB,
+                                   donate=False)
+        l = float(step2(ids, ids))
+        assert np.isfinite(l) and l < losses1[0]
+
+    def test_ernie_moe_preset_trains(self):
+        """BASELINE config 4's ERNIE-4.5 anchor: llama-family decoder
+        with MoE FFN — debug-scale train step descends with the router
+        aux loss in the objective."""
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_loss_fn)
+        paddle.seed(0)
+        m = LlamaForCausalLM("ernie-debug")
+        o = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                   parameters=m.parameters())
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (4, 32), dtype=np.int32))
+        first = None
+        for _ in range(6):
+            loss = llama_loss_fn(m, ids, ids)
+            if first is None:
+                first = float(loss)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        assert float(loss) < first
+
+
 class TestZeroStage12:
     """ZeRO-1/2: optimizer state sharded over 'sharding' while params stay
     replicated (reference dygraph_sharding_optimizer.py:39,
