@@ -59,6 +59,7 @@ class Model:
         self._jit = bool(jit)
         self._jit_step = None
         self._jit_sig = None
+        self._fwd_static = None
         if metrics is None:
             self._metrics = []
         elif isinstance(metrics, Metric):
@@ -143,12 +144,27 @@ class Model:
             return None
         return [float(lo) for lo in losses], outs
 
+    def _forward_maybe_jit(self, ins):
+        """Network forward for eval/predict: compiled via StaticFunction
+        when jit is on (its full_graph=False fallback handles untraceable
+        networks), eager otherwise."""
+        if getattr(self, "_jit", False):
+            if getattr(self, "_fwd_static", None) is None:
+                from ..jit.api import StaticFunction
+                # trace through Layer.__call__ (forward hooks included,
+                # matching the eager path) with a FIXED rng key (eval
+                # must not perturb the global random stream)
+                self._fwd_static = StaticFunction(self.network.__call__,
+                                                  advance_rng=False)
+            return self._fwd_static(*ins)
+        return self.network(*ins)
+
     def eval_batch(self, inputs, labels=None):
         from ..core import autograd
         self.network.eval()
         with autograd.no_grad():
             ins = _to_tensor_list(inputs)
-            outs = self.network(*ins)
+            outs = self._forward_maybe_jit(ins)
             losses = self._compute_loss(outs, labels)
         return [float(lo) for lo in losses], outs
 
@@ -156,7 +172,7 @@ class Model:
         from ..core import autograd
         self.network.eval()
         with autograd.no_grad():
-            outs = self.network(*_to_tensor_list(inputs))
+            outs = self._forward_maybe_jit(_to_tensor_list(inputs))
         return outs if isinstance(outs, (list, tuple)) else [outs]
 
     def _compute_loss(self, outs, labels):

@@ -64,8 +64,13 @@ class StaticFunction:
     (reference program_translator.py:316 StaticFunction)."""
 
     def __init__(self, function: Callable, input_spec=None, full_graph=False,
-                 **kwargs):
+                 advance_rng=True, **kwargs):
+        """``advance_rng=False``: trace with a FIXED key instead of
+        consuming the global generator per call — for no-grad eval
+        forwards whose callers must not perturb the shared random
+        stream (hapi jit eval)."""
         self._raw_fn = function
+        self._advance_rng = advance_rng
         from ..nn.layer.layers import Layer
         self._layer = function if isinstance(function, Layer) else None
         # capture the ORIGINAL forward now: to_static may later rebind
@@ -147,8 +152,9 @@ class StaticFunction:
         kwargs_vals = jax.tree_util.tree_map(
             lambda x: x._value if isinstance(x, Tensor) else x, kwargs,
             is_leaf=lambda x: isinstance(x, Tensor))
+        key = R.next_key() if self._advance_rng else jax.random.PRNGKey(0)
         try:
-            out_vals, new_state = self._jitted(state_vals, R.next_key(),
+            out_vals, new_state = self._jitted(state_vals, key,
                                                args_vals, kwargs_vals)
         except (jax.errors.TracerBoolConversionError,
                 jax.errors.TracerArrayConversionError,

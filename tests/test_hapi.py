@@ -226,3 +226,25 @@ class TestHapiJitFit:
         assert model._jit is False               # permanent fallback
         losses2 = model.train_batch([x], [y])    # now silent eager
         assert np.isfinite(losses2[0])
+
+    def test_jit_eval_predict_match_eager(self):
+        import paddle_tpu.hapi as hapi
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 1))
+        x = np.random.randn(4, 8).astype(np.float32)
+        y = np.random.randn(4, 1).astype(np.float32)
+
+        me = hapi.Model(net)
+        me.prepare(loss=nn.MSELoss(), jit=False)
+        l_e, o_e = me.eval_batch([x], [y])
+        p_e = me.predict_batch([x])
+
+        mj = hapi.Model(net)
+        mj.prepare(loss=nn.MSELoss(), jit=True)
+        l_j, o_j = mj.eval_batch([x], [y])
+        p_j = mj.predict_batch([x])
+        np.testing.assert_allclose(l_e, l_j, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(o_e._value),
+                                   np.asarray(o_j._value), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(p_e[0]._value),
+                                   np.asarray(p_j[0]._value), atol=1e-6)
