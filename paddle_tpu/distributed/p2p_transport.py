@@ -21,8 +21,6 @@ import socket
 import struct
 import threading
 
-import numpy as np
-
 __all__ = ["P2PTransport", "get_transport"]
 
 _HDR = struct.Struct("!iiq")          # src, seq, nbytes
