@@ -19,10 +19,13 @@ __all__ = ["Model"]
 
 
 def _to_tensor_list(data):
+    # Tensor() handles np arrays, scalars, jax arrays AND jax tracers —
+    # np.asarray here would raise TracerArrayConversionError when labels
+    # flow through a traced TrainStep (this function sits inside
+    # _compute_loss, which runs under jit when prepare(jit=True))
     if isinstance(data, (list, tuple)):
-        return [d if isinstance(d, Tensor) else Tensor(np.asarray(d))
-                for d in data]
-    return [data if isinstance(data, Tensor) else Tensor(np.asarray(data))]
+        return [d if isinstance(d, Tensor) else Tensor(d) for d in data]
+    return [data if isinstance(data, Tensor) else Tensor(data)]
 
 
 def _as_loader(data, batch_size, shuffle):
@@ -59,6 +62,7 @@ class Model:
         self._jit = bool(jit)
         self._jit_step = None
         self._jit_sig = None
+        self._jit_steps_run = 0   # compiled train batches (tests assert >0)
         self._fwd_static = None
         if metrics is None:
             self._metrics = []
@@ -142,6 +146,7 @@ class Model:
             self._jit = False
             self._jit_step = None
             return None
+        self._jit_steps_run += 1
         return [float(lo) for lo in losses], outs
 
     def _forward_maybe_jit(self, ins):

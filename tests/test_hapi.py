@@ -188,8 +188,18 @@ class TestHapiJitFit:
         model.prepare(paddle.optimizer.SGD(learning_rate=0.05,
                                            parameters=net.parameters()),
                       nn.MSELoss(), jit=jit)
-        model.fit(DS(), batch_size=8, epochs=2, verbose=0,
-                  shuffle=False)
+        import warnings
+        with warnings.catch_warnings():
+            # vacuity guard: the silent eager fallback emits a
+            # RuntimeWarning — promote it so a broken jit path FAILS
+            # instead of comparing eager vs eager
+            warnings.simplefilter("error", RuntimeWarning)
+            model.fit(DS(), batch_size=8, epochs=2, verbose=0,
+                      shuffle=False)
+        if jit:
+            assert model._jit is True, "jit fit silently fell back to eager"
+            assert model._jit_steps_run == 8, \
+                f"expected 8 compiled batches, ran {model._jit_steps_run}"
         return [np.asarray(p._value) for p in net.parameters()]
 
     def test_jit_matches_eager(self):
