@@ -86,13 +86,36 @@ def _batch_spec(jax_mesh, ndim: int) -> P:
     return P(*([first] + [None] * (ndim - 1)))
 
 
+def _ensure_global(v, sharding):
+    """Multi-controller (one process per host, SURVEY §3.4): turn a
+    host-replicated value — every process holds the SAME full array, the
+    natural state after identically-seeded init or identical input
+    batches — into a global jax.Array laid out by ``sharding``.
+    jax.jit rejects host-local values against multi-process shardings
+    (reference analogue: each rank feeding its slice to NCCL).
+    Single-process commits via device_put; already-global arrays pass
+    through untouched."""
+    if sharding is None:
+        return v
+    if jax.process_count() == 1:
+        return jax.device_put(v, sharding)
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        return v  # already a global array (a previous step's output)
+    if getattr(sharding, "is_fully_replicated", False) \
+            and not isinstance(v, jax.Array):
+        return v  # numpy + replicated sharding is accepted directly
+    npv = np.asarray(v)
+    return jax.make_array_from_callback(npv.shape, sharding,
+                                        lambda idx: npv[idx])
+
+
 def shard_model_state(model, mesh: ProcessMesh):
     """device_put every parameter/buffer to its annotated sharding so memory
     is distributed before the first step (ZeRO-3 param placement)."""
     jm = mesh.jax_mesh
     for _, t in model.state_dict().items():
         spec = param_partition_spec(t, jm)
-        t._in_place_update(jax.device_put(t._value, NamedSharding(jm, spec)))
+        t._in_place_update(_ensure_global(t._value, NamedSharding(jm, spec)))
     return model
 
 
@@ -122,11 +145,19 @@ class DistTrainStep:
                                  INSIDE the jitted step (avg honored)
     - pipeline accumulate_steps→ model pp_num_microbatches;
       virtual_pp_degree        → model pp_interleave
-    - sharding stage           → ZeRO spec pass over the dp axis"""
+    - sharding stage           → ZeRO spec pass over the dp axis
+
+    Multi-controller data contract (process_count > 1): by default every
+    process must feed the SAME global batch (each keeps only its mesh
+    shard — replicated-loader semantics). Feeding per-process LOCAL
+    shards (e.g. from DistributedBatchSampler) requires
+    ``local_batch=True``, which assembles the global batch from each
+    process's slice via jax.make_array_from_process_local_data —
+    mixing the two silently trains on wrong data."""
 
     def __init__(self, model, optimizer, loss_fn: Callable, mesh: ProcessMesh,
                  input_specs: Sequence | None = None, donate: bool = True,
-                 strategy=None):
+                 strategy=None, local_batch: bool = False):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -134,6 +165,7 @@ class DistTrainStep:
         self.input_specs = input_specs
         self.donate = donate
         self.strategy = strategy
+        self.local_batch = bool(local_batch)
         self._jitted = None
         self._params: list[Parameter] = []
         self._buffers: list[Tensor] = []
@@ -232,7 +264,7 @@ class DistTrainStep:
         # (committed outputs fed back in) recompiles
         for slot, arrs in opt._accumulators.items():
             opt._accumulators[slot] = [
-                jax.device_put(a, s)
+                _ensure_global(a, s)
                 for a, s in zip(arrs, opt_shardings[slot])]
         if self.input_specs is not None:
             in_specs = [NamedSharding(jm, s) if isinstance(s, P) else s
@@ -242,6 +274,17 @@ class DistTrainStep:
                 lambda v: NamedSharding(jm, _batch_spec(jm, np.ndim(v))),
                 args_vals)
         repl = NamedSharding(jm, P())
+        # saved for __call__'s multi-controller input conversion; the arg
+        # shardings are normalized to one-per-leaf (None = mismatch, which
+        # __call__ reports loudly instead of silently skipping conversion)
+        self._param_shardings = param_shardings
+        self._buffer_shardings = buffer_shardings
+        self._opt_shardings = opt_shardings
+        flat_sh = jax.tree_util.tree_leaves(in_specs,
+                                            is_leaf=lambda x: x is None)
+        n_leaves = len(jax.tree_util.tree_leaves(args_vals))
+        self._arg_shardings_flat = flat_sh if len(flat_sh) == n_leaves \
+            else None
 
         def pure(param_vals, buffer_vals, opt_state, rng_key, step_count,
                  lr, args):
@@ -338,30 +381,78 @@ class DistTrainStep:
                 R.default_generator._key = old_key
 
         in_shardings = (param_shardings, buffer_shardings, opt_shardings,
-                        None, repl, repl, in_specs)
+                        repl, repl, repl, in_specs)
         out_shardings = (repl, param_shardings, buffer_shardings,
                          opt_shardings)
         self._jitted = jax.jit(
             pure, in_shardings=in_shardings, out_shardings=out_shardings,
             donate_argnums=(0, 2) if self.donate else ())
 
+    def _arg_global(self, v, sharding):
+        """Batch-arg conversion for multi-controller runs: global batch
+        (default) vs per-process local shards (local_batch=True)."""
+        if self.local_batch and sharding is not None:
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                return v
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(v))
+        return _ensure_global(v, sharding)
+
     def __call__(self, *args):
         opt = self.optimizer
+        multi = jax.process_count() > 1
         args_vals = jax.tree_util.tree_map(
+            # multi-controller keeps numpy on host: the global-assembly
+            # helpers consume numpy directly, so an eager jnp.asarray here
+            # would just add an H2D+D2H round trip per step
             lambda x: x._value if isinstance(x, Tensor) else
-            (jnp.asarray(x) if isinstance(x, np.ndarray) else x), args,
+            (x if multi else jnp.asarray(x)) if isinstance(x, np.ndarray)
+            else x, args,
             is_leaf=lambda x: isinstance(x, (Tensor, np.ndarray)))
         if self._jitted is None:
             self._build(args_vals)
         param_vals = [p._value for p in self._params]
         buffer_vals = [b._value for b in self._buffers]
         opt_state = {k: list(v) for k, v in opt._accumulators.items()}
+        rng_key = R.next_key()
+        if multi:
+            # multi-controller: every process must hand jit GLOBAL arrays.
+            # Params/opt state are host-identical (same seed) or already
+            # global from the previous step. Batch args: the same global
+            # batch on every process by default (each keeps its shard), or
+            # per-process shards when local_batch=True. The rng key is
+            # identical by the same-seed contract. Scalars ride the
+            # numpy-with-replicated-sharding fast path (no device round
+            # trip).
+            param_vals = [_ensure_global(v, s) for v, s in
+                          zip(param_vals, self._param_shardings)]
+            buffer_vals = [_ensure_global(v, s) for v, s in
+                           zip(buffer_vals, self._buffer_shardings)]
+            opt_state = {k: [_ensure_global(v, s) for v, s in
+                             zip(vs, self._opt_shardings[k])]
+                         for k, vs in opt_state.items()}
+            flat_args, treedef = jax.tree_util.tree_flatten(args_vals)
+            flat_sh = self._arg_shardings_flat
+            if flat_sh is None or len(flat_sh) != len(flat_args):
+                raise ValueError(
+                    "multi-controller DistTrainStep could not align input "
+                    "specs with the batch args: pass input_specs with "
+                    "exactly one entry per array argument (prefix pytrees "
+                    "are not supported across processes)")
+            args_vals = jax.tree_util.tree_unflatten(
+                treedef, [self._arg_global(v, s) for v, s in
+                          zip(flat_args, flat_sh)])
+            rng_key = np.asarray(rng_key)
+            step_v = np.asarray(opt._global_step, np.int32)
+            lr_v = np.asarray(opt.get_lr(), np.float32)
+        else:
+            step_v = jnp.asarray(opt._global_step, jnp.int32)
+            lr_v = jnp.asarray(opt.get_lr(), jnp.float32)
         from ..device import oom_diagnostics
         with oom_diagnostics(self.model, opt):
             loss_val, new_params, new_buffers, new_opt = self._jitted(
-                param_vals, buffer_vals, opt_state, R.next_key(),
-                jnp.asarray(opt._global_step, jnp.int32),
-                jnp.asarray(opt.get_lr(), jnp.float32), args_vals)
+                param_vals, buffer_vals, opt_state, rng_key, step_v, lr_v,
+                args_vals)
         for p, v in zip(self._params, new_params):
             p._value = v
         for b, v in zip(self._buffers, new_buffers):

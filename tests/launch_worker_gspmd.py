@@ -1,0 +1,96 @@
+"""Multi-controller compiled-collective proof worker (VERDICT r3 #2).
+
+Run two ways with IDENTICAL seeds/data so losses must match:
+- single process, 8 local CPU devices (GSPMD_LOCAL_DEVICES=8, no launch)
+- 2 processes × 4 CPU devices under ``python -m
+  paddle_tpu.distributed.launch --nproc_per_node 2`` — ONE shared
+  8-device mesh, jax.distributed rendezvous, GSPMD collectives compiled
+  ACROSS the process boundary (gloo CPU data plane).
+
+This is the JAX analogue of the reference's multi-process-on-localhost
+harness (test/legacy_test/test_parallel_dygraph_dataparallel.py:157) and
+the shape that matches a v5p pod's one-process-per-host reality.
+"""
+
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices",
+                  int(os.environ.get("GSPMD_LOCAL_DEVICES", "4")))
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import json  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402  (import-time hook connects ranks)
+import paddle_tpu.distributed as dist  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+
+
+class TPNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.col = dist.fleet.ColumnParallelLinear(
+            16, 32, has_bias=True, gather_output=False)
+        self.row = dist.fleet.RowParallelLinear(
+            32, 4, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.row(F.relu(self.col(x)))
+
+
+def loss_fn(model, x, y):
+    return F.cross_entropy(model(x), y)
+
+
+def main():
+    dist.init_parallel_env()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    paddle.seed(11)
+    net = TPNet()
+    opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                 parameters=net.parameters())
+    # ZeRO-2 over dp composed with Megatron TP over mp — the compiled
+    # program contains dp grad-reduce, mp allreduce and the ZeRO
+    # reduce-scatter, all riding the cross-process mesh
+    from paddle_tpu.distributed.fleet.sharding import apply_sharding_specs
+    apply_sharding_specs(net, stage=2, axis="dp", min_size_to_shard=0)
+    mesh = dist.ProcessMesh(shape=[2, 4], dim_names=["dp", "mp"])
+    dist.shard_model_state(net, mesh)
+    step = dist.DistTrainStep(net, opt, loss_fn, mesh, donate=False)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    y = rng.randint(0, 4, (8,))
+    losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+              for _ in range(3)]
+    assert losses[-1] < losses[0], losses
+    print("GSPMD_LOSSES", json.dumps(losses), flush=True)
+
+    # second run: per-process LOCAL batch shards (DistributedBatchSampler
+    # semantics) assembled into the global batch via local_batch=True —
+    # must reproduce the same losses as the replicated-loader run
+    paddle.seed(11)
+    net2 = TPNet()
+    opt2 = paddle.optimizer.AdamW(learning_rate=0.05,
+                                  parameters=net2.parameters())
+    apply_sharding_specs(net2, stage=2, axis="dp", min_size_to_shard=0)
+    dist.shard_model_state(net2, mesh)
+    step2 = dist.DistTrainStep(net2, opt2, loss_fn, mesh, donate=False,
+                               local_batch=True)
+    nproc = jax.process_count()
+    rows = x.shape[0] // nproc
+    lo = jax.process_index() * rows
+    xl, yl = x[lo:lo + rows], y[lo:lo + rows]
+    losses_l = [float(step2(paddle.to_tensor(xl), paddle.to_tensor(yl)))
+                for _ in range(3)]
+    print("GSPMD_LOSSES_LOCAL", json.dumps(losses_l), flush=True)
+
+
+if __name__ == "__main__":
+    main()

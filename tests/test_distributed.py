@@ -1230,6 +1230,46 @@ class TestLaunchCLI:
             log = (tmp_path / f"workerlog.{i}").read_text()
             assert "SUBGROUP_OK" in log, (i, log)
 
+    def test_two_process_compiled_gspmd_parity(self, tmp_path):
+        """VERDICT r3 #2: compiled GSPMD collectives ACROSS a process
+        boundary. The same worker runs (a) single-process on 8 local CPU
+        devices and (b) 2 processes × 4 CPU devices under the launch CLI
+        sharing ONE 8-device mesh via jax.distributed — a DistTrainStep
+        with dp×mp + ZeRO-2 must produce identical losses. This is the
+        one-process-per-host shape of a real v5p pod (reference
+        test_parallel_dygraph_dataparallel.py:157)."""
+        import json, subprocess, sys, os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "launch_worker_gspmd.py")
+
+        def losses_from(text, tag="GSPMD_LOSSES "):
+            for line in text.splitlines():
+                if line.startswith(tag):
+                    return json.loads(line[len(tag):])
+            raise AssertionError(f"no {tag!r} in:\n{text}")
+
+        env = dict(os.environ, GSPMD_LOCAL_DEVICES="8",
+                   PYTHONPATH=root)
+        single = subprocess.run([sys.executable, worker], cwd=root,
+                                env=env, capture_output=True, text=True,
+                                timeout=300)
+        assert single.returncode == 0, single.stdout + single.stderr
+        ref = losses_from(single.stdout)
+
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", str(tmp_path), worker],
+            cwd=root, capture_output=True, text=True, timeout=420)
+        assert r.returncode == 0, r.stdout + r.stderr
+        ref_local = losses_from(single.stdout, "GSPMD_LOSSES_LOCAL ")
+        np.testing.assert_allclose(ref_local, ref, rtol=1e-6)
+        for i in range(2):
+            text = (tmp_path / f"workerlog.{i}").read_text()
+            np.testing.assert_allclose(losses_from(text), ref, rtol=1e-6)
+            np.testing.assert_allclose(
+                losses_from(text, "GSPMD_LOSSES_LOCAL "), ref, rtol=1e-6)
+
     def test_launch_propagates_failure(self, tmp_path):
         import subprocess, sys
         bad = tmp_path / "bad.py"
