@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from .. import flags
 from . import autograd
+from . import lazy as _lazy
 from .tensor import Tensor
 
 __all__ = ["apply_op", "defop", "OP_REGISTRY", "register_op"]
@@ -73,16 +74,48 @@ def _check_nan_inf(name: str, arrays) -> None:
 
 
 def apply_op(name: str, fn: Callable, args: tuple, kwargs: dict,
-             differentiable: bool = True):
+             differentiable: bool = True, lazy_key: str | None = None):
     """Run op ``fn`` on mixed Tensor/raw args, recording autograd if needed.
 
     Non-Tensor args (ints, shapes, axes, python floats) are closed over;
     Tensor args become vjp primals. Outputs are Tensors. ``fn`` must be pure
-    and jax-traceable.
+    and jax-traceable. ``lazy_key``: closure-carrying call sites (fn is not
+    the registered op function) must pass a string that, with the op name,
+    uniquely identifies the computation — or the op is excluded from
+    mixed-mode segment capture (its cache would replay the wrong closure).
     """
     for obs in OP_OBSERVERS:
         obs(name)
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+    # Mixed-mode graph capture (core/lazy.py): while a SegmentEngine is
+    # active, grad-free ops accumulate into a compiled segment instead of
+    # executing. Anything the lazy path can't honor (autograd, AMP casts,
+    # program recorders, nan checks, unidentified closures) flushes and
+    # falls through to the normal eager dispatch below.
+    if _lazy._ACTIVE:
+        eng = _lazy._ACTIVE[-1]
+        from ..amp.auto_cast import _STATE as _amp_state
+        wants_grad = (differentiable and autograd.is_grad_enabled()
+                      and any(not args[i].stop_gradient
+                              for i in tensor_idx))
+        is_reg = OP_REGISTRY.get(name) is fn
+        if (wants_grad or _amp_state.enabled or OP_RECORDERS
+                or flags.flag("check_nan_inf")
+                or not (is_reg or lazy_key is not None)):
+            eng.flush()
+            for i in tensor_idx:
+                v = args[i]._value
+                if isinstance(v, _lazy.LazyValue):
+                    args[i]._value = v.force()
+        else:
+            raw = [a._value if isinstance(a, Tensor) else a for a in args]
+            fn_sig = ("reg",) if is_reg else ("key", lazy_key)
+            out = eng.record(name, fn, tuple(raw), kwargs, fn_sig)
+            outs = out if isinstance(out, tuple) else (out,)
+            wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
+            return wrapped if len(wrapped) > 1 else wrapped[0]
+
     arrays = [a._value if isinstance(a, Tensor) else a for a in args]
 
     # AMP autocast (reference eager_gen.py AMP_LOGIC_TEMPLATE): cast float

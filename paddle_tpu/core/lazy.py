@@ -1,0 +1,386 @@
+"""Mixed-mode graph capture: compiled subgraphs stitched around host Python.
+
+The SOT analogue (reference: python/paddle/jit/sot/opcode_translator/
+executor/opcode_executor.py — execute traced subgraphs between graph
+breaks, guards in guard.py). The reference interposes at the BYTECODE
+level because its ops run eagerly in C++; here every op already funnels
+through ``apply_op`` (core/dispatch.py), so mixed mode interposes THERE:
+
+- while a ``SegmentEngine`` is active, ops do not execute — they append
+  nodes to the current segment and return ``LazyValue`` placeholders that
+  carry shape/dtype (via jax.eval_shape);
+- the moment host Python needs a concrete value (``float``/``bool``/
+  ``int``/``np.asarray`` — the graph-break point), the pending segment is
+  FLUSHED: compiled as ONE XLA executable and executed, placeholders
+  become concrete arrays, and recording resumes in a fresh segment;
+- Python between flushes runs natively — data-dependent branching,
+  prints, host math — which is exactly SOT's "execute the untraceable
+  fragment eagerly" with the function's own Python as the guard: the
+  branch re-evaluates every call, so no guard table is needed.
+
+Re-trace avoidance: each flushed segment is keyed by its op sequence
+(op name + static args) and input avals; the compiled executable is
+cached on the engine, so repeated calls with stable shapes skip tracing
+AND compilation and pay only Python-side op recording (the SOT analogue
+of guard evaluation).
+
+Capture degrades safely rather than breaking semantics: grad-requiring
+ops, AMP autocast, program recorders, and the check_nan_inf flag all
+force a flush and fall back to the normal eager dispatch for that op.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+__all__ = ["LazyValue", "SegmentEngine", "active_engine", "activate",
+           "deactivate"]
+
+_ACTIVE: list = []
+
+
+def active_engine():
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def concrete(v):
+    """Unwrap a (possibly lazy) raw value to a jax-compatible array —
+    used at jit leaf-extraction sites (TrainStep/StaticFunction) where a
+    LazyValue that escaped a mixed-mode call via a plain attribute would
+    otherwise fail abstractification."""
+    return v.force() if isinstance(v, LazyValue) else v
+
+
+def activate(engine: "SegmentEngine"):
+    _ACTIVE.append(engine)
+
+
+def deactivate(engine: "SegmentEngine"):
+    assert _ACTIVE and _ACTIVE[-1] is engine
+    _ACTIVE.pop()
+
+
+class LazyValue:
+    """Placeholder for a not-yet-executed op output. Duck-types the array
+    metadata Tensor reads (shape/dtype/ndim/size) and forces a segment
+    flush on any concrete access."""
+
+    __slots__ = ("_engine", "_aval", "_node_id", "_slot", "_concrete",
+                 "_aborted", "__weakref__")
+    _is_lazy_value = True
+
+    def __init__(self, engine, aval, node_id, slot):
+        self._engine = engine
+        self._aval = aval
+        self._node_id = node_id
+        self._slot = slot
+        self._concrete = None
+        self._aborted = False
+
+    # -- metadata (no flush) -----------------------------------------------
+    @property
+    def shape(self):
+        return self._aval.shape
+
+    @property
+    def dtype(self):
+        return self._aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self._aval.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for d in self._aval.shape:
+            n *= d
+        return n
+
+    # -- concrete access (graph break: flush the pending segment) ----------
+    def force(self):
+        if self._concrete is None:
+            if self._aborted:
+                raise RuntimeError(
+                    "this value came from a mixed-mode call that failed "
+                    "before it was computed; re-run the computation")
+            self._engine.flush()
+        if self._concrete is None:
+            raise RuntimeError(
+                "lazy value could not be materialized (its segment was "
+                "discarded)")
+        return self._concrete
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.force())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.force())
+
+    def __int__(self):
+        return int(self.force())
+
+    def __bool__(self):
+        return bool(self.force())
+
+    def __index__(self):
+        return int(self.force())
+
+    def item(self, *args):
+        return self.force().item(*args)
+
+    def __repr__(self):
+        state = "concrete" if self._concrete is not None else "pending"
+        return (f"LazyValue({state}, shape={tuple(self._aval.shape)}, "
+                f"dtype={self._aval.dtype})")
+
+
+class _Node:
+    __slots__ = ("name", "fn", "arg_kinds", "kwargs", "n_outs", "out_refs",
+                 "static_sig")
+
+    def __init__(self, name, fn, arg_kinds, kwargs, n_outs, static_sig):
+        self.name = name
+        self.fn = fn
+        self.arg_kinds = arg_kinds      # ("ext", j) | ("val", nid, slot) | ("static", v)
+        self.kwargs = kwargs
+        self.n_outs = n_outs
+        self.static_sig = static_sig
+        self.out_refs: list = []        # weakrefs to produced LazyValues
+
+
+def _static_repr(v) -> str:
+    """Hashable signature for a non-array op argument."""
+    try:
+        return repr(v)
+    except Exception:
+        return f"<{type(v).__name__}@{id(v)}>"
+
+
+class SegmentEngine:
+    """Accumulates op nodes and flushes them as cached compiled programs.
+
+    One engine per StaticFunction: the executable cache persists across
+    calls (``compile_count`` only grows on a genuinely new segment
+    signature); node/segment state resets per flush.
+    """
+
+    def __init__(self):
+        self.cache: dict[tuple, Any] = {}
+        self._aval_cache: dict[tuple, tuple] = {}
+        self.compile_count = 0
+        self.executable_calls = 0
+        self.recorded_ops = 0
+        self.failures = 0
+        self._nodes: list[_Node] = []
+        self._node_seq = 0
+
+    # -- recording ----------------------------------------------------------
+    def record(self, name: str, fn: Callable, args: tuple, kwargs: dict,
+               fn_sig: tuple = ("reg",)):
+        """Append one op to the pending segment; returns LazyValue outputs
+        (tuple when the op is multi-output, single LazyValue otherwise).
+
+        ``fn_sig`` identifies WHICH computation ``fn`` performs beyond the
+        op name — ("reg",) for the stable registry function, or
+        ("key", k) supplied by closure-carrying call sites (getitem's
+        index, for example). The cache is only sound if equal
+        (name, fn_sig, static args) implies equal computation, which is
+        why dispatch refuses to record unidentified closures."""
+        arg_kinds = []
+        ext_inputs = []          # concrete arrays feeding this node
+        in_avals = []
+        sig_parts = []
+        for a in args:
+            if isinstance(a, LazyValue) and a._concrete is None \
+                    and a._engine is self:
+                arg_kinds.append(("val", a._node_id, a._slot))
+                in_avals.append(a._aval)
+                sig_parts.append(("val",))
+            elif isinstance(a, LazyValue):
+                c = a.force()
+                arg_kinds.append(("ext", None))
+                ext_inputs.append(c)
+                in_avals.append(jax.ShapeDtypeStruct(c.shape, c.dtype))
+                sig_parts.append(("ext",))
+            elif isinstance(a, (jax.Array, np.ndarray)):
+                arg_kinds.append(("ext", None))
+                ext_inputs.append(a)
+                in_avals.append(jax.ShapeDtypeStruct(a.shape,
+                                                     np.asarray(a).dtype
+                                                     if isinstance(a, np.ndarray)
+                                                     else a.dtype))
+                sig_parts.append(("ext",))
+            else:
+                arg_kinds.append(("static", a))
+                sig_parts.append(("static", _static_repr(a)))
+        static_sig = (name, fn_sig, tuple(sig_parts),
+                      tuple(sorted((k, _static_repr(v))
+                                   for k, v in kwargs.items())))
+
+        out_avals = self._infer(static_sig, fn, arg_kinds, kwargs, in_avals)
+        node = _Node(name, fn, tuple(arg_kinds), dict(kwargs),
+                     len(out_avals), static_sig)
+        node_id = self._node_seq
+        self._node_seq += 1
+        self._nodes.append((node, node_id, tuple(ext_inputs)))
+        self.recorded_ops += 1
+
+        outs = []
+        for slot, av in enumerate(out_avals):
+            lv = LazyValue(self, av, node_id, slot)
+            node.out_refs.append(weakref.ref(lv))
+            outs.append(lv)
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    def _infer(self, static_sig, fn, arg_kinds, kwargs, in_avals):
+        """Output avals via jax.eval_shape, cached on the op signature +
+        input avals so steady-state recording skips abstract tracing."""
+        key = (static_sig, tuple((tuple(a.shape), str(a.dtype))
+                                 for a in in_avals))
+        hit = self._aval_cache.get(key)
+        if hit is not None:
+            return hit
+        dyn_template = [a for a in in_avals]
+
+        def shaped(*dyn):
+            it = iter(dyn)
+            call_args = [next(it) if k[0] != "static" else k[1]
+                         for k in arg_kinds]
+            out = fn(*call_args, **kwargs)
+            return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+        outs = jax.eval_shape(shaped, *dyn_template)
+        result = tuple(jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs)
+        self._aval_cache[key] = result
+        return result
+
+    # -- flushing -----------------------------------------------------------
+    def abort(self):
+        """Discard the pending segment (the surrounding mixed-mode call
+        failed): its placeholders can never be materialized, so mark them
+        to raise a clear error instead of a dangling-assert."""
+        for node, _nid, _ in self._nodes:
+            for ref in node.out_refs:
+                lv = ref()
+                if lv is not None:
+                    lv._aborted = True
+        self._nodes = []
+
+    def _run_eager(self, nodes):
+        """Materialize a segment op-by-op without compiling — the safety
+        net when a segment fails to compile or execute as one program."""
+        env = {}
+        for node, node_id, ext_inputs in nodes:
+            it = iter(ext_inputs)
+            call_args = []
+            for kind in node.arg_kinds:
+                if kind[0] == "ext":
+                    call_args.append(next(it))
+                elif kind[0] == "val":
+                    call_args.append(env[(kind[1], kind[2])])
+                else:
+                    call_args.append(kind[1])
+            out = node.fn(*call_args, **node.kwargs)
+            outs = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+            for s, o in enumerate(outs):
+                env[(node_id, s)] = o
+                ref = node.out_refs[s]
+                lv = ref()
+                if lv is not None:
+                    lv._concrete = o
+
+    def flush(self):
+        """Compile-or-reuse the pending segment as one executable, run it,
+        and materialize the still-referenced LazyValues. A segment that
+        fails to compile/run as one program (and any later segment with
+        the same signature) falls back to op-by-op eager materialization."""
+        if not self._nodes:
+            return
+        nodes = self._nodes
+        self._nodes = []
+        try:
+            self._flush_compiled(nodes)
+        except Exception:
+            self.failures += 1
+            self._run_eager(nodes)
+
+    def _flush_compiled(self, nodes):
+
+        # node ids are global (monotonic across the engine's lifetime);
+        # remap to segment-local positions so the cache key and the replay
+        # wiring are stable across calls
+        pos_of = {node_id: pos for pos, (_, node_id, _) in enumerate(nodes)}
+        ext_flat = []
+        spec = []        # (fn, resolved_arg_kinds, kwargs, n_outs, pos, live_mask)
+        key_parts = []
+        for pos, (node, node_id, ext_inputs) in enumerate(nodes):
+            it = iter(ext_inputs)
+            resolved = []
+            for kind in node.arg_kinds:
+                if kind[0] == "ext":
+                    resolved.append(("ext", len(ext_flat)))
+                    ext_flat.append(next(it))
+                elif kind[0] == "val":
+                    resolved.append(("val", pos_of[kind[1]], kind[2]))
+                else:
+                    resolved.append(kind)
+            live = tuple(r() is not None for r in node.out_refs)
+            spec.append((node.fn, tuple(resolved), node.kwargs, node.n_outs,
+                         pos, live))
+            key_parts.append((node.static_sig,
+                              tuple(k if k[0] != "static" else ("static",)
+                                    for k in resolved), live))
+        key = (tuple(key_parts),
+               tuple((tuple(np.shape(e)), str(getattr(e, "dtype",
+                                                      np.asarray(e).dtype)))
+                     for e in ext_flat))
+
+        hit = self.cache.get(key)
+        if hit == "eager":      # this segment shape failed to compile once
+            self._run_eager(nodes)
+            return
+        if hit is None:
+            out_keys = [(pos, s)
+                        for (_, _, _, n_outs, pos, live) in spec
+                        for s in range(n_outs) if live[s]]
+
+            def replay(ext):
+                env = {}
+                for fn, resolved, kw, n_outs, pos, _live in spec:
+                    call_args = [
+                        ext[k[1]] if k[0] == "ext" else
+                        env[(k[1], k[2])] if k[0] == "val" else k[1]
+                        for k in resolved]
+                    out = fn(*call_args, **kw)
+                    outs = (tuple(out) if isinstance(out, (tuple, list))
+                            else (out,))
+                    for s, o in enumerate(outs):
+                        env[(pos, s)] = o
+                return [env[k] for k in out_keys]
+
+            jitted = jax.jit(replay)
+            self.compile_count += 1
+        else:
+            jitted, out_keys = hit
+
+        try:
+            results = jitted(ext_flat)
+        except Exception:
+            self.failures += 1
+            self.cache[key] = "eager"
+            self._run_eager(nodes)
+            return
+        self.cache[key] = (jitted, out_keys)
+        self.executable_calls += 1
+        by_key = dict(zip(out_keys, results))
+        for pos, (node, _node_id, _) in enumerate(nodes):
+            for s, ref in enumerate(node.out_refs):
+                lv = ref()
+                if lv is not None:
+                    lv._concrete = by_key[(pos, s)]

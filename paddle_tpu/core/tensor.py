@@ -31,7 +31,8 @@ class Tensor:
     def __init__(self, value, stop_gradient: bool = True, name: str | None = None):
         if isinstance(value, Tensor):
             value = value._value
-        elif not isinstance(value, jax.Array):
+        elif not isinstance(value, jax.Array) \
+                and not getattr(value, "_is_lazy_value", False):
             value = jnp.asarray(value)
         self._value = value
         self.stop_gradient = stop_gradient

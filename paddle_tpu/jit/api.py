@@ -86,7 +86,9 @@ class StaticFunction:
         # retraces (see _trace_count); full_graph=False additionally arms
         # the eager fallback for non-traceable Python
         self._full_graph = full_graph
-        self._fallback = False
+        self._fallback = False      # broke once: route through mixed mode
+        self._eager = False         # mixed mode also failed: plain eager
+        self._mixed_engine = None
         self._trace_count = 0
         functools.update_wrapper(self, self._callable)
 
@@ -139,18 +141,71 @@ class StaticFunction:
             state_vals, [a._value if isinstance(a, Tensor) else jnp.asarray(a)
                          for a in example_args])
 
-    def __call__(self, *args, **kwargs):
-        if not _to_static_enabled[0] or self._fallback:
+    def _call_mixed(self, *args, **kwargs):
+        """Mixed-mode execution after a graph break (core/lazy.py): the
+        function's Python runs natively while grad-free op chains
+        accumulate into cached compiled segments, flushed at each host
+        read. Any failure demotes permanently to plain eager."""
+        from ..core import lazy
+        if self._mixed_engine is None:
+            self._mixed_engine = lazy.SegmentEngine()
+        eng = self._mixed_engine
+        # snapshot layer state so a failed capture can be rolled back and
+        # re-run eagerly WITHOUT double-applying buffer mutations (BN
+        # running stats etc.)
+        snapshot = [(t, t._value, t._version) for _, t in self._state_items]
+        failure = None
+        lazy.activate(eng)
+        try:
+            out = self._callable(*args, **kwargs)
+            eng.flush()
+        except Exception as e:  # noqa: BLE001 — any break demotes to eager
+            failure = e
+            eng.abort()         # pending placeholders can't materialize
+        finally:
+            lazy.deactivate(eng)
+        if failure is not None:
+            for t, v, ver in snapshot:
+                t._value = v
+                t._version = ver
+            import warnings
+            warnings.warn(
+                f"to_static: mixed-mode capture of "
+                f"{getattr(self._callable, '__name__', '?')} failed "
+                f"({type(failure).__name__}: {failure}); falling back to "
+                f"eager execution for this function.",
+                RuntimeWarning, stacklevel=2)
+            self._eager = True
             return self._callable(*args, **kwargs)
+        # layer buffers mutated mid-call (BN stats) hold flushed lazies
+        for _, t in self._state_items:
+            if isinstance(t._value, lazy.LazyValue):
+                t._value = t._value.force()
+
+        def _force(x):
+            if isinstance(x, Tensor) and isinstance(x._value,
+                                                    lazy.LazyValue):
+                x._value = x._value.force()
+            return x
+
+        return jax.tree_util.tree_map(
+            _force, out, is_leaf=lambda x: isinstance(x, Tensor))
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled[0] or self._eager:
+            return self._callable(*args, **kwargs)
+        if self._fallback:
+            return self._call_mixed(*args, **kwargs)
         if self._jitted is None:
             self._build()
+        from ..core.lazy import concrete as _conc
         state_objs = [t for _, t in self._state_items]
-        state_vals = [t._value for t in state_objs]
+        state_vals = [_conc(t._value) for t in state_objs]
         args_vals = jax.tree_util.tree_map(
-            lambda x: x._value if isinstance(x, Tensor) else x, args,
+            lambda x: _conc(x._value) if isinstance(x, Tensor) else x, args,
             is_leaf=lambda x: isinstance(x, Tensor))
         kwargs_vals = jax.tree_util.tree_map(
-            lambda x: x._value if isinstance(x, Tensor) else x, kwargs,
+            lambda x: _conc(x._value) if isinstance(x, Tensor) else x, kwargs,
             is_leaf=lambda x: isinstance(x, Tensor))
         key = R.next_key() if self._advance_rng else jax.random.PRNGKey(0)
         try:
@@ -161,21 +216,22 @@ class StaticFunction:
                 jax.errors.TracerIntegerConversionError,
                 jax.errors.ConcretizationTypeError) as e:
             # graph break: non-traceable Python (data-dependent control
-            # flow, host round trips). The reference's SOT would fall back
-            # to executing the offending bytecode eagerly between traced
-            # subgraphs (opcode_executor.py); the conservative TPU
-            # analogue runs the WHOLE function eagerly from now on.
+            # flow, host round trips). The reference's SOT executes traced
+            # subgraphs between breaks (opcode_executor.py); the TPU
+            # analogue is mixed-mode capture (core/lazy.py) — compiled
+            # segments stitched around the function's own host Python.
             if self._full_graph:
                 raise
             import warnings
             warnings.warn(
                 f"to_static: {getattr(self._callable, '__name__', '?')} is "
-                f"not fully traceable ({type(e).__name__}); falling back "
-                f"to eager execution for this function. Use static-safe "
-                f"control flow (paddle.static.nn.cond / lax.cond) to keep "
-                f"it compiled.", RuntimeWarning, stacklevel=2)
+                f"not fully traceable ({type(e).__name__}); switching to "
+                f"mixed-mode capture (compiled subgraphs around the host-"
+                f"dependent Python). Use static-safe control flow "
+                f"(paddle.static.nn.cond / lax.cond) to keep the whole "
+                f"function in one program.", RuntimeWarning, stacklevel=2)
             self._fallback = True
-            return self._callable(*args, **kwargs)
+            return self._call_mixed(*args, **kwargs)
         # buffer updates (e.g. BN running stats) land back in the objects
         for t, v in zip(state_objs, new_state):
             t._value = v
@@ -310,11 +366,12 @@ class TrainStep:
         if self._jitted is None:
             self._build()
         opt = self.optimizer
+        from ..core.lazy import concrete as _conc
         param_vals = [p._value for p in self._params]
         buffer_vals = [b._value for b in self._buffers]
         opt_state = {k: list(v) for k, v in opt._accumulators.items()}
         args_vals = jax.tree_util.tree_map(
-            lambda x: x._value if isinstance(x, Tensor) else
+            lambda x: _conc(x._value) if isinstance(x, Tensor) else
             (jnp.asarray(x) if isinstance(x, np.ndarray) else x), args,
             is_leaf=lambda x: isinstance(x, (Tensor, np.ndarray)))
         from ..device import oom_diagnostics

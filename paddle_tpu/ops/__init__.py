@@ -153,6 +153,28 @@ def _install_tensor_methods():
     # ---- indexing ----
     from ..core.dispatch import apply_op
 
+    def _static_idx_key(i):
+        """repr-key for an index with no array parts (arrays are dynamic
+        DATA, so closures over them can't be identified by a string) —
+        lets getitem/setitem join mixed-mode compiled segments."""
+        import builtins
+        import jax as _jax
+        import numpy as _np
+
+        def has_array(e):
+            # NB: `any` and `slice` here are shadowed by paddle ops —
+            # use explicit loops / builtins
+            if isinstance(e, (tuple, list)):
+                for x in e:
+                    if has_array(x):
+                        return True
+                return False
+            if isinstance(e, builtins.slice):
+                return has_array(e.start) or has_array(e.stop) \
+                    or has_array(e.step)
+            return isinstance(e, (_jax.Array, _np.ndarray))
+        return None if has_array(i) else repr(i)
+
     def _getitem(self, idx):
         def unwrap(i):
             if isinstance(i, Tensor):
@@ -161,7 +183,8 @@ def _install_tensor_methods():
                 return tuple(unwrap(e) for e in i)
             return i
         idx = unwrap(idx)
-        return apply_op("getitem", lambda x: x[idx], (self,), {})
+        return apply_op("getitem", lambda x: x[idx], (self,), {},
+                        lazy_key=_static_idx_key(idx))
 
     def _setitem(self, idx, value):
         if not self.stop_gradient and self._grad_node is None:
@@ -180,11 +203,16 @@ def _install_tensor_methods():
         if varg is not None:
             out = apply_op("setitem",
                            lambda x, v: x.at[jidx].set(v.astype(x.dtype)),
-                           (self, varg), {})
+                           (self, varg), {},
+                           lazy_key=_static_idx_key(jidx))
         else:
+            ikey = _static_idx_key(jidx)
+            vkey = _static_idx_key(value)  # None when value is an array
             out = apply_op("setitem",
                            lambda x: x.at[jidx].set(value),
-                           (self,), {})
+                           (self,), {},
+                           lazy_key=None if ikey is None or vkey is None
+                           else f"{ikey}={vkey}")
         # in-place semantics: adopt the new value and graph position
         # (shadow substitution prevents the self-loop — see inplace._adopt)
         _inplace_mod._adopt(self, out)

@@ -96,6 +96,100 @@ class TestToStatic:
         assert np.allclose(_np(out2), -2.0)
         assert sfn._fallback
 
+    def test_mixed_mode_stitches_compiled_subgraphs(self):
+        """VERDICT r3 #3 (SOT analogue): after a graph break the function
+        is NOT demoted to permanent eager — op chains before and after
+        the host-dependent Python run as compiled segments
+        (core/lazy.py), cached so repeated calls neither re-trace nor
+        re-compile, and the break's branch re-evaluates per call."""
+        import pytest
+        from paddle_tpu.core import autograd
+        import paddle_tpu.nn as nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 32)
+                self.fc2 = nn.Linear(32, 4)
+
+            def forward(self, x):
+                h = self.fc1(x)
+                if float(paddle.sum(h)) > 0:     # host round trip: break
+                    h = h * 2.0
+                return self.fc2(h)
+
+        paddle.seed(7)
+        net = Net()
+        x = paddle.to_tensor(
+            np.abs(np.random.RandomState(0).randn(4, 8)).astype(np.float32))
+        xneg = paddle.to_tensor(np.full((4, 8), -2.0, np.float32))
+        with autograd.no_grad():
+            ref = _np(net.forward(x))
+            refneg = _np(net.forward(xneg))
+
+        sfn = paddle.jit.to_static(net)
+        eng_of = lambda: net._static_function._mixed_engine
+        with autograd.no_grad():
+            with pytest.warns(RuntimeWarning, match="mixed-mode"):
+                out1 = sfn(x)
+            eng = eng_of()
+            # prefix (fc1+sum) and suffix (mul+fc2) each ran as ONE
+            # compiled executable — the matmuls did NOT run eager
+            assert eng.compile_count == 2
+            assert eng.executable_calls == 2
+            np.testing.assert_allclose(_np(out1), ref, rtol=1e-5)
+
+            out2 = sfn(x)                         # cache hit: no re-trace
+            assert eng.compile_count == 2
+            assert eng.executable_calls == 4
+            np.testing.assert_allclose(_np(out2), ref, rtol=1e-5)
+
+            out3 = sfn(xneg)                      # other branch: one new
+            assert eng.compile_count == 3         # suffix segment only
+            np.testing.assert_allclose(_np(out3), refneg, rtol=1e-5)
+
+            sfn(xneg)                             # and it is cached too
+            assert eng.compile_count == 3
+        assert not net._static_function._eager    # never demoted
+
+    def test_mixed_mode_getitem_keyed_and_failure_demotes(self):
+        """Closure-carrying ops join segments only when identified: two
+        different static indices must NOT share a cache entry; and a
+        mixed-mode call that raises demotes to plain eager with buffers
+        rolled back (no double-applied side effects)."""
+        import pytest
+        from paddle_tpu.core import autograd
+
+        def fn(x):
+            a = x[0] * 2            # getitem closure, lazy_key = repr(0)
+            if float(paddle.sum(a)) > -1e9:   # break
+                b = x[1] * 2        # different index: different key
+            return a + b
+
+        sfn = paddle.jit.to_static(fn)
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(2, 4))
+        with autograd.no_grad():
+            with pytest.warns(RuntimeWarning, match="mixed-mode"):
+                out = sfn(x)
+            np.testing.assert_allclose(
+                _np(out), (np.arange(4) * 2 + (np.arange(4) + 4) * 2))
+            out2 = sfn(x)
+            np.testing.assert_allclose(_np(out), _np(out2))
+
+        def bad(x):
+            y = x * 2
+            if float(paddle.sum(y)) > 0:
+                raise ValueError("host-side failure")
+            return y
+
+        sbad = paddle.jit.to_static(bad)
+        xp = paddle.ones([3])
+        with autograd.no_grad():
+            with pytest.warns(RuntimeWarning):
+                with pytest.raises(ValueError, match="host-side failure"):
+                    sbad(xp)
+            assert sbad._eager        # demoted: subsequent calls run eager
+
     def test_graph_break_full_graph_raises(self):
         import pytest
 
