@@ -85,17 +85,17 @@ def moe_slots(logits, num_experts, capacity, top_k, drop2_mask=None):
     """Slot metadata only — top_k on RAW logits (softmax is monotonic, so
     indices match) to keep the eager pre-pass cheap. Returns slot [N, k]
     int: flat position in the [E*C] buffer, E*C meaning 'dropped'.
-    ``drop2_mask`` [N] bool: GShard random routing — choices >= 2nd are
-    force-dropped (and don't consume capacity) where True."""
+    ``drop2_mask`` [N] bool: GShard random routing — the 2ND choice (and
+    only it: gshard_gate.py applies the min(1, 2*g2) keep test to the
+    second expert, lower-ranked choices route normally) is force-dropped
+    (and doesn't consume capacity) where True."""
     _, topi = jax.lax.top_k(logits, top_k)
     n = logits.shape[0]
     flat_e = topi.reshape(-1)
     onehot = jax.nn.one_hot(flat_e, num_experts, dtype=jnp.int32)
     if drop2_mask is not None and top_k >= 2:
-        forced = jnp.concatenate(
-            [jnp.zeros((n, 1), bool),
-             jnp.broadcast_to(drop2_mask[:, None], (n, top_k - 1))],
-            axis=1).reshape(-1)
+        forced = jnp.zeros((n, top_k), bool).at[:, 1].set(
+            drop2_mask).reshape(-1)
         onehot = onehot * (~forced[:, None]).astype(jnp.int32)
     else:
         forced = None
@@ -137,6 +137,44 @@ def moe_route(logits, num_experts, capacity, top_k):
     ce = jax.nn.one_hot(topi, num_experts, dtype=jnp.float32).sum(1).mean(0)
     aux = (me * ce).sum() * num_experts
     return topi, gates, slot, aux
+
+
+def moe_route_dropless(logits, num_experts, top_k):
+    """Dropless routing (no capacity truncation): every (token, choice)
+    is served. Returns (topi [N,k], gates [N,k] normalized over the full
+    top-k, order [N*k] expert-sorted permutation, group_sizes [E], aux).
+    The reference's capacity semantics exist for fixed-size all-to-all
+    buffers; on TPU lax.ragged_dot keeps shapes static with ragged
+    per-expert groups instead (MegaBlocks-style dropless)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, top_k)
+    gates = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    flat_e = topi.reshape(-1)
+    order = jnp.argsort(flat_e, stable=True)         # expert-major stream
+    group_sizes = jnp.bincount(flat_e, length=num_experts).astype(jnp.int32)
+    me = probs.mean(axis=0)
+    ce = jax.nn.one_hot(topi, num_experts, dtype=jnp.float32).sum(1).mean(0)
+    aux = (me * ce).sum() * num_experts
+    return topi, gates, order, group_sizes, aux
+
+
+def moe_dropless_ffn(tokens, topi, gates, order, group_sizes,
+                     we_gate, we_up, we_down):
+    """SwiGLU expert FFN over the expert-sorted ragged stream: three
+    lax.ragged_dot grouped GEMMs, then unsort + gate-combine. tokens
+    [N, d]; we_* [E, d, f]/[E, f, d]; returns [N, d]."""
+    n, d = tokens.shape
+    k = topi.shape[1]
+    stream = jnp.repeat(tokens, k, axis=0) if k > 1 else tokens
+    stream = jnp.take(stream, order, axis=0)              # [N*k, d]
+    dt = we_gate.dtype
+    gate = jax.nn.silu(jax.lax.ragged_dot(stream.astype(dt), we_gate,
+                                          group_sizes))
+    up = jax.lax.ragged_dot(stream.astype(dt), we_up, group_sizes)
+    out_sorted = jax.lax.ragged_dot(gate * up, we_down, group_sizes)
+    unsorted = jnp.zeros_like(out_sorted).at[order].set(out_sorted)
+    picked = unsorted.reshape(n, k, d)
+    return jnp.sum(picked * gates[..., None].astype(picked.dtype), axis=1)
 
 
 def moe_permute(x, slot, num_experts, capacity):

@@ -33,9 +33,10 @@ from ..core.dispatch import defop
 from ..core.tensor import Tensor
 from ..nn import functional as F
 from ..distributed.fleet.mp_layers import shard_hint
+from ..distributed.fleet.pipeline import safe_psum  # the ONE bf16-psum shim
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "llama_loss_fn",
-           "LLAMA_PRESETS"]
+           "LLAMA_PRESETS", "quantize_weights_int8"]
 
 
 @dataclass
@@ -76,6 +77,15 @@ class LlamaConfig:
     # checkpoints use a much narrower expert than the dense FFN
     # (ERNIE-4.5: 1536 vs 12288)
     moe_intermediate_size: int = 0
+    # always-on dense experts beside the routed ones (ERNIE-4.5 /
+    # DeepSeekMoE shape; reference moe_layer.py:263 + ERNIE 4.5 release
+    # configs): one SwiGLU FFN of width S*moe_intermediate_size applied
+    # to every token, summed with the routed output
+    moe_num_shared_experts: int = 0
+    # dropless TRAINING dispatch (sorted ragged grouped-GEMM via
+    # lax.ragged_dot) instead of GShard capacity truncation; decode-time
+    # routing is always dropless (SURVEY §7.5)
+    moe_dropless: bool = False
     # load-balancing aux loss weight (reference gshard_gate.py applies the
     # GShard me*ce objective; moe_layer.py:263 surfaces it as l_aux) and
     # router z-loss weight (ST-MoE: penalizes logsumexp^2 drift)
@@ -137,12 +147,14 @@ LLAMA_PRESETS = {
                            num_attention_heads=20, num_key_value_heads=4,
                            rope_theta=500000.0, num_experts=64,
                            num_experts_per_tok=6,
-                           moe_intermediate_size=1536),
+                           moe_intermediate_size=1536,
+                           moe_num_shared_experts=2),
     "ernie-debug": dict(vocab_size=128, hidden_size=64,
                         intermediate_size=172, num_hidden_layers=2,
                         num_attention_heads=4, num_key_value_heads=2,
                         max_position_embeddings=256, num_experts=4,
-                        num_experts_per_tok=2),
+                        num_experts_per_tok=2, moe_intermediate_size=86,
+                        moe_num_shared_experts=1),
 }
 
 
@@ -164,7 +176,31 @@ def _rms(x, w, eps):
     return rms_norm_raw(x, w, eps)
 
 
-def _attention(q, k, v, causal=True, sep_manual=None):
+def _attention_keymask(q, k, v, key_mask):
+    """Causal attention with an additional per-row VALID-KEY mask
+    (serving prefill over a left-padded batch: pad positions must not be
+    attended; reference masked_multihead_attention's mask input). XLA
+    path — serving prompts are short; the training path never pays for
+    the mask branch."""
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    qh = jnp.swapaxes(q, 1, 2).reshape(B, Hkv, G, S, D)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    s = jnp.einsum("bngsd,bntd->bngst", qh, kh).astype(jnp.float32)
+    s = s / (D ** 0.5)
+    causal = jnp.tril(jnp.ones((S, S), bool))
+    valid = causal[None, :, :] & key_mask[:, None, :].astype(bool)
+    s = jnp.where(valid[:, None, None, :, :], s, -jnp.inf)
+    # fully-masked rows (pad queries) would softmax over -inf: zero them
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isfinite(s).any(-1, keepdims=True), p, 0.0)
+    out = jnp.einsum("bngst,bntd->bngsd", p.astype(q.dtype), vh)
+    return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
+
+
+def _attention(q, k, v, causal=True, sep_manual=None, key_mask=None):
     """[b, s, h, d] flash attention (Pallas on TPU). GQA-native: grouped
     K/V are consumed directly (kernel indexes KV by head//group) instead
     of materializing repeated heads on HBM. When the sequence is sharded
@@ -175,6 +211,8 @@ def _attention(q, k, v, causal=True, sep_manual=None):
     from .. import flags
     from ..distributed.fleet.mp_layers import current_mesh
     from ..distributed.sep import _axis_size
+    if key_mask is not None:
+        return _attention_keymask(q, k, v, key_mask)
     if sep_manual is not None:
         from ..distributed.sep import ring_attention_local
         axis, n = sep_manual
@@ -194,7 +232,8 @@ def _attention(q, k, v, causal=True, sep_manual=None):
 
 
 def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint,
-                   mp_axis=None, return_kv=False, sep_manual=None):
+                   mp_axis=None, return_kv=False, sep_manual=None,
+                   key_mask=None):
     """One decoder layer on raw arrays. lp = this layer's parameter dict.
 
     ``mp_axis``: inside the manual-pp region GSPMD cannot be steered (no
@@ -213,7 +252,6 @@ def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint,
         return mesh_hint(a, spec)
 
     def _mp_sum(a):
-        from ..distributed.fleet.pipeline import safe_psum
         return safe_psum(a, mp_axis) if mp_axis is not None else a
 
     # attention block
@@ -233,7 +271,8 @@ def _decoder_layer(cfg: LlamaConfig, lp: dict, x, positions, mesh_hint,
     q = hint(_rope(q, positions, cfg.rope_theta, hd), "dp", "sep", "mp", None)
     k = hint(_rope(k, positions, cfg.rope_theta, hd), "dp", "sep", "mp", None)
     v = hint(v, "dp", "sep", "mp", None)
-    attn = _attention(q, k, v, causal=True, sep_manual=sep_manual)
+    attn = _attention(q, k, v, causal=True, sep_manual=sep_manual,
+                      key_mask=key_mask)
     attn = checkpoint_name(attn, "attn_out")
     attn = attn.reshape(b, s, h * hd)
     x = x + hint(_mp_sum(attn @ lp["wo"]), "dp", "sep", None)
@@ -261,27 +300,47 @@ def _moe_mlp(cfg: LlamaConfig, lp: dict, y, mesh_hint, mp_axis=None,
     into the [E, C, d] buffer and gather back by slot, no [N, E, C] dense
     intermediate (0.5G elements at Mixtral scale); the expert dim shards
     over 'ep' so GSPMD inserts the all-to-all."""
-    from ..distributed.fleet.moe import (moe_permute, moe_route,
+    from ..distributed.fleet.moe import (moe_dropless_ffn, moe_permute,
+                                         moe_route, moe_route_dropless,
                                          moe_unpermute)
     b, s, d = y.shape
     E = cfg.num_experts
     tokens = y.reshape(b * s, d)
     logits = tokens @ lp["router"]
-    capacity = capacity_override or max(
-        1, int(cfg.moe_capacity_factor * b * s
-               * cfg.num_experts_per_tok / E))
-    _, gates, slot, aux = moe_route(logits, E, capacity,
-                                    cfg.num_experts_per_tok)
-    expert_in = moe_permute(tokens, slot, E, capacity)
-    expert_in = mesh_hint(expert_in, ("ep", None, None))
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["we_gate"]))
-    up = jnp.einsum("ecd,edf->ecf", expert_in, lp["we_up"])
-    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["we_down"])
-    if mp_axis is not None:  # manual row-parallel over the ff contraction
-        from ..distributed.fleet.pipeline import safe_psum
-        expert_out = safe_psum(expert_out, mp_axis)
-    expert_out = mesh_hint(expert_out, ("ep", None, None))
-    out = moe_unpermute(expert_out, slot, gates, b * s).astype(y.dtype)
+    if cfg.moe_dropless:
+        # dropless training: ragged grouped GEMMs, nothing truncated
+        topi, gates, order, group_sizes, aux = moe_route_dropless(
+            logits, E, cfg.num_experts_per_tok)
+        out = moe_dropless_ffn(tokens, topi, gates, order, group_sizes,
+                               lp["we_gate"], lp["we_up"],
+                               lp["we_down"]).astype(y.dtype)
+        if mp_axis is not None:
+            out = safe_psum(out, mp_axis)
+    else:
+        capacity = capacity_override or max(
+            1, int(cfg.moe_capacity_factor * b * s
+                   * cfg.num_experts_per_tok / E))
+        _, gates, slot, aux = moe_route(logits, E, capacity,
+                                        cfg.num_experts_per_tok)
+        expert_in = moe_permute(tokens, slot, E, capacity)
+        expert_in = mesh_hint(expert_in, ("ep", None, None))
+        gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
+                                      lp["we_gate"]))
+        up = jnp.einsum("ecd,edf->ecf", expert_in, lp["we_up"])
+        expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["we_down"])
+        if mp_axis is not None:  # manual row-parallel over ff contraction
+            expert_out = safe_psum(expert_out, mp_axis)
+        expert_out = mesh_hint(expert_out, ("ep", None, None))
+        out = moe_unpermute(expert_out, slot, gates, b * s).astype(y.dtype)
+    if cfg.moe_num_shared_experts > 0:
+        # always-on shared experts (ERNIE-4.5/DeepSeekMoE): dense SwiGLU
+        # beside the routed path, same token stream, summed outputs
+        sg = jax.nn.silu(tokens @ lp["ws_gate"])
+        su = tokens @ lp["ws_up"]
+        shared = (sg * su) @ lp["ws_down"]
+        if mp_axis is not None:
+            shared = safe_psum(shared, mp_axis)
+        out = out + shared.astype(y.dtype)
     # router penalty (VERDICT #2: the aux loss was computed then DROPPED):
     # GShard load-balance term + optional ST-MoE router z-loss, weighted
     # here so the loss fn can add it directly
@@ -294,7 +353,7 @@ def _moe_mlp(cfg: LlamaConfig, lp: dict, y, mesh_hint, mp_axis=None,
 
 
 def _scan_layers(cfg, stacked, x, positions, mesh_hint, mp_axis=None,
-                 collect_kv=False, sep_manual=None):
+                 collect_kv=False, sep_manual=None, key_mask=None):
     """Scan the decoder over a stacked [n, ...] parameter tree (full depth
     in the GSPMD path, one stage's local slice inside the pipeline).
     Returns (x, penalty) with penalty the summed per-layer router aux;
@@ -304,7 +363,7 @@ def _scan_layers(cfg, stacked, x, positions, mesh_hint, mp_axis=None,
         if collect_kv:
             out, penalty, kk, vv = _decoder_layer(
                 cfg, lp, carry, positions, mesh_hint, mp_axis=mp_axis,
-                return_kv=True)
+                return_kv=True, key_mask=key_mask)
             return out, (penalty, kk, vv)
         out, penalty = _decoder_layer(cfg, lp, carry, positions, mesh_hint,
                                       mp_axis=mp_axis,
@@ -547,6 +606,13 @@ class LlamaForCausalLM(nn.Layer):
             mk("we_gate", [L, E, d, eff], ("pp", "ep", None, "mp"))
             mk("we_up", [L, E, d, eff], ("pp", "ep", None, "mp"))
             mk("we_down", [L, E, eff, d], ("pp", "ep", "mp", None))
+            S = cfg.moe_num_shared_experts
+            if S > 0:
+                # shared experts = one dense SwiGLU of width S*eff,
+                # column/row mp-sharded like the dense FFN
+                mk("ws_gate", [L, d, S * eff], ("pp", None, "mp"))
+                mk("ws_up", [L, d, S * eff], ("pp", None, "mp"))
+                mk("ws_down", [L, S * eff, d], ("pp", "mp", None))
         else:
             mk("w_gate", [L, d, ff], ("pp", None, "mp"))
             mk("w_up", [L, d, ff], ("pp", None, "mp"))
@@ -562,27 +628,47 @@ class LlamaForCausalLM(nn.Layer):
         if self.config.attention_bias:
             base = base + ["bq", "bk", "bv"]
         if self.config.num_experts > 0:
-            return base + ["router", "we_gate", "we_up", "we_down"]
+            moe = base + ["router", "we_gate", "we_up", "we_down"]
+            if self.config.moe_num_shared_experts > 0:
+                moe += ["ws_gate", "ws_up", "ws_down"]
+            return moe
         return base + ["w_gate", "w_up", "w_down"]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=0, seed=0, use_cache=True):
+                 top_k=0, seed=0, use_cache=True, attention_mask=None):
         """Autoregressive sampling (greedy when temperature=0); returns
         the full [b, s + max_new_tokens] id array as a Tensor. With
         ``use_cache`` (default) each new token is an O(1) jitted decode
         step against a per-layer KV cache (VERDICT #5); the re-encode
-        path remains for pp>1 meshes and as the parity oracle."""
+        path remains for pp>1 meshes and as the parity oracle.
+
+        ``attention_mask`` [b, s] (1 = real token, LEFT-padded rows):
+        lets one compiled program serve mixed prompt lengths — pad
+        positions are excluded from attention and rope positions are
+        pad-relative (reference masked_multihead_attention mask input).
+        Requires the cached path."""
         from ..core import autograd
         from ..distributed.fleet.mp_layers import current_mesh
         ids = input_ids._value if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         if _pp_degree(current_mesh()) > 1:
             use_cache = False  # decode cache is a single-program path
-        gen = _generate_cached if use_cache else _generate
+        if attention_mask is not None and not use_cache:
+            raise ValueError(
+                "attention_mask requires the KV-cache generate path "
+                "(use_cache=True, pp=1)")
         with autograd.no_grad():
-            out = gen(self, ids, int(max_new_tokens),
-                      float(temperature), int(top_k),
-                      jax.random.PRNGKey(seed))
+            if use_cache:
+                am = attention_mask._value \
+                    if isinstance(attention_mask, Tensor) else attention_mask
+                out = _generate_cached(self, ids, int(max_new_tokens),
+                                       float(temperature), int(top_k),
+                                       jax.random.PRNGKey(seed),
+                                       attention_mask=am)
+            else:
+                out = _generate(self, ids, int(max_new_tokens),
+                                float(temperature), int(top_k),
+                                jax.random.PRNGKey(seed))
         return Tensor(out, stop_gradient=True)
 
     def forward(self, input_ids):
@@ -657,7 +743,69 @@ def _sample(logits, temperature, top_k, key, greedy=None):
     return key, jax.random.categorical(sub, logits, axis=-1)
 
 
-def _decode_layer_step(cfg, lp, x, ck, cv, t):
+# decode attention goes chunked above this cache length: bounds the
+# per-step working set to O(chunk) instead of O(S_max) f32 (VERDICT r3
+# #4b — the full-cache einsum is the thing the reference's masked MHA
+# kernel exists to avoid); tests shrink it to force the chunked path
+_DECODE_CHUNK = 2048
+
+
+def _decode_attention(qg, ck, cv, mask):
+    """Single-token grouped attention over the KV cache. qg [b, kvh, g,
+    hd]; ck/cv [b, s_max, kvh, hd]; mask [b|1, s_max] valid-slot mask.
+    Short caches: one masked softmax. Long caches: lax.scan over
+    _DECODE_CHUNK-sized cache chunks with an online (flash-style)
+    max/sum rescale — per-step memory stays flat in S_max."""
+    b, s_max, kvh, hd = ck.shape
+    g = qg.shape[2]
+    scale = hd ** 0.5
+    qf = qg.astype(jnp.float32)
+    if s_max <= _DECODE_CHUNK:
+        s = jnp.einsum("bngd,btnd->bngt", qf,
+                       ck.astype(jnp.float32)) / scale
+        s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bngt,btnd->bngd", p, cv.astype(jnp.float32))
+
+    n_chunks = -(-s_max // _DECODE_CHUNK)
+    pad = n_chunks * _DECODE_CHUNK - s_max
+    maskb = jnp.broadcast_to(mask, (b, s_max))
+    if pad:
+        ck = jnp.pad(ck, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        cv = jnp.pad(cv, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        maskb = jnp.pad(maskb, ((0, 0), (0, pad)))
+    kcs = ck.reshape(b, n_chunks, _DECODE_CHUNK, kvh, hd)
+    vcs = cv.reshape(b, n_chunks, _DECODE_CHUNK, kvh, hd)
+    mcs = maskb.reshape(b, n_chunks, _DECODE_CHUNK)
+
+    def body(carry, xs):
+        m_prev, l_prev, acc = carry
+        kc, vc, mc = xs                     # [b, C, kvh, hd], [b, C]
+        s = jnp.einsum("bngd,btnd->bngt", qf,
+                       kc.astype(jnp.float32)) / scale
+        s = jnp.where(mc[:, None, None, :], s, -jnp.inf)
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        # all-masked-so-far guard: exp(-inf - -inf) would be NaN
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        corr = jnp.exp(m_prev - m_safe)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(mc[:, None, None, :], p, 0.0)   # -inf-max guard
+        l_new = l_prev * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bngt,btnd->bngd", p, vc.astype(jnp.float32))
+        return (m_new, l_new, acc_new), None
+
+    init = (jnp.full((b, kvh, g), -jnp.inf, jnp.float32),
+            jnp.zeros((b, kvh, g), jnp.float32),
+            jnp.zeros((b, kvh, g, hd), jnp.float32))
+    (m, l, acc), _ = jax.lax.scan(
+        body, init,
+        (jnp.moveaxis(kcs, 1, 0), jnp.moveaxis(vcs, 1, 0),
+         jnp.moveaxis(mcs, 1, 0)))
+    return acc / jnp.maximum(l, 1e-30)[..., None]
+
+
+def _decode_layer_step(cfg, lp, x, ck, cv, t, pad_len=None):
     """One decoder layer for ONE token at position t against the KV cache
     (reference: incubate masked_multihead_attention — the serving decode
     kernel — with a STATIC [b, S_max, kvh, hd] cache updated in place via
@@ -668,7 +816,10 @@ def _decode_layer_step(cfg, lp, x, ck, cv, t):
     b = x.shape[0]
     s_max = ck.shape[1]
     g = h // kvh
-    pos = jnp.broadcast_to(t, (b, 1))
+    if pad_len is None:
+        pos = jnp.broadcast_to(t, (b, 1))
+    else:
+        pos = (t - pad_len)[:, None]        # pad-relative rope position
 
     y = _rms(x, lp["input_ln"], cfg.rms_norm_eps)
     q = y @ lp["wq"]
@@ -685,12 +836,11 @@ def _decode_layer_step(cfg, lp, x, ck, cv, t):
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, t, 0, 0))
     # grouped single-token attention over the cache, masked to <= t
     qg = q[:, 0].reshape(b, kvh, g, hd)
-    s = jnp.einsum("bngd,btnd->bngt", qg.astype(jnp.float32),
-                   ck.astype(jnp.float32)) / (hd ** 0.5)
-    mask = jnp.arange(s_max) <= t
-    s = jnp.where(mask[None, None, None, :], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    attn = jnp.einsum("bngt,btnd->bngd", p, cv.astype(jnp.float32))
+    mask = (jnp.arange(s_max) <= t)[None, :]
+    if pad_len is not None:
+        # left-padded rows: cache slots before pad_len[b] are invalid
+        mask = mask & (jnp.arange(s_max)[None, :] >= pad_len[:, None])
+    attn = _decode_attention(qg, ck, cv, mask)
     attn = attn.astype(x.dtype).reshape(b, 1, h * hd)
     x = x + attn @ lp["wo"]
 
@@ -710,14 +860,15 @@ def _decode_layer_step(cfg, lp, x, ck, cv, t):
 
 
 def _decode_step(cfg, stacked, embed, final_norm, lm_head, token, cache_k,
-                 cache_v, t):
+                 cache_v, t, pad_len=None):
     """Jittable single-token step: [b] token ids + [L, b, S_max, kvh, hd]
     caches -> (logits [b, V], updated caches). O(1) work per token."""
     x = jnp.take(embed, token, axis=0)[:, None, :]       # [b, 1, d]
 
     def layer_fn(carry, xs):
         lp, ck, cv = xs
-        out, ck, cv = _decode_layer_step(cfg, lp, carry, ck, cv, t)
+        out, ck, cv = _decode_layer_step(cfg, lp, carry, ck, cv, t,
+                                         pad_len=pad_len)
         return out, (ck, cv)
 
     x, (cks, cvs) = jax.lax.scan(layer_fn, x, (stacked, cache_k, cache_v))
@@ -729,23 +880,67 @@ def _decode_step(cfg, stacked, embed, final_norm, lm_head, token, cache_k,
 _GEN_CACHE: dict = {}
 
 
-def _generate_all(cfg, max_new_tokens, greedy, top_k, stacked, embed,
-                  final_norm, lm_head, ids, key, temperature):
+def quantize_weights_int8(model):
+    """Weight-only int8 for serving (VERDICT r3 #4c; reference: PTQ
+    convert + weight_quantize in the inference pass pipeline): the big
+    matmul weights become per-output-channel symmetric int8 in HBM
+    (4x/2x less weight traffic per decode step) and are dequantized
+    inside the compiled program, fused into their consumers by XLA.
+    Embedding / norms / biases / router stay in float."""
+    names = [n for n in model._stacked_names()
+             if not n.endswith(("_ln", "bq", "bk", "bv", "router"))]
+    head = model._parameters.get("lm_head")
+    scales = {}
+    for n in names + (["lm_head"] if head is not None else []):
+        pp = model._parameters[n]
+        w = pp._value.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)
+        scale = jnp.maximum(amax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        pp._in_place_update(q)
+        scales[n] = scale
+    model._quant_scales = scales
+    return model
+
+
+def _generate_all(cfg, max_new_tokens, greedy, top_k, has_mask, stacked,
+                  embed, final_norm, lm_head, ids, key, temperature,
+                  pad_len, scales):
     """One jitted program for the WHOLE generation: prefill (collecting
     per-layer K/V), then a lax.scan of O(1) decode steps with sampling
     fused in — a single device execution per generate() call (the
     per-token host round trip through the TPU tunnel costs ~100ms,
     dwarfing the 2ms step)."""
     b, s0 = ids.shape
+    if scales:
+        # int8 weight-only serving: dequantize INSIDE the program — the
+        # int8 arrays are what lives in HBM; XLA fuses the convert+scale
+        # into the consuming matmuls
+        dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        stacked = {n: (v.astype(jnp.float32) * scales[n]).astype(dt)
+                   if n in scales else v for n, v in stacked.items()}
+        if lm_head is not None and "lm_head" in scales:
+            lm_head = (lm_head.astype(jnp.float32)
+                       * scales["lm_head"]).astype(dt)
     s_max = s0 + max_new_tokens
-    positions = jnp.broadcast_to(jnp.arange(s0)[None, :], (b, s0))
+    if has_mask:
+        # left-padded batch (serving): pad-relative rope positions and a
+        # valid-key attention mask over the prefill
+        positions = jnp.maximum(
+            jnp.arange(s0)[None, :] - pad_len[:, None], 0)
+        key_mask = jnp.arange(s0)[None, :] >= pad_len[:, None]
+    else:
+        positions = jnp.broadcast_to(jnp.arange(s0)[None, :], (b, s0))
+        key_mask = None
+        pad_len = None
     if lm_head is None:
         lm_head = embed.T  # tied embeddings: transpose fuses inside jit
     temperature = 0.0 if greedy else temperature
 
     x = jnp.take(embed, ids, axis=0)
     x, _, ks, vs = _scan_layers(cfg, stacked, x, positions,
-                                lambda a, spec: a, collect_kv=True)
+                                lambda a, spec: a, collect_kv=True,
+                                key_mask=key_mask)
     x = _rms(x, final_norm, cfg.rms_norm_eps)
     logits = (x[:, -1] @ lm_head).astype(jnp.float32)
     L = cfg.num_hidden_layers
@@ -760,7 +955,8 @@ def _generate_all(cfg, max_new_tokens, greedy, top_k, stacked, embed,
     def body(carry, i):
         tok, ck, cv, key = carry
         logits, ck, cv = _decode_step(cfg, stacked, embed, final_norm,
-                                      lm_head, tok, ck, cv, s0 + i)
+                                      lm_head, tok, ck, cv, s0 + i,
+                                      pad_len=pad_len)
         key, nxt = _sample(logits, temperature, top_k, key, greedy=greedy)
         return (nxt, ck, cv, key), nxt
 
@@ -775,7 +971,7 @@ def _generate_all(cfg, max_new_tokens, greedy, top_k, stacked, embed,
 
 
 def _generate_cached(model, input_ids, max_new_tokens, temperature, top_k,
-                     key):
+                     key, attention_mask=None):
     """KV-cache generation (VERDICT #5): one prefill forward captures the
     per-layer post-rope K/V stacks; decoding is a fused jitted scan of
     O(1) steps against the static-shape cache. Dense models are
@@ -796,17 +992,24 @@ def _generate_cached(model, input_ids, max_new_tokens, temperature, top_k,
     lm_head = head._value if head is not None else None  # None: tied
 
     greedy = temperature == 0.0
+    scales = getattr(model, "_quant_scales", None) or {}
+    has_mask = attention_mask is not None
+    if has_mask:
+        m = jnp.asarray(attention_mask)
+        pad_len = (m.shape[1] - m.sum(axis=1)).astype(jnp.int32)
+    else:
+        pad_len = jnp.zeros((input_ids.shape[0],), jnp.int32)
     cache_key = (_freeze_cfg(cfg), input_ids.shape, max_new_tokens,
-                 greedy, top_k, head is None)
+                 greedy, top_k, head is None, has_mask, bool(scales))
     fn = _GEN_CACHE.get(cache_key)
     if fn is None:
         if len(_GEN_CACHE) >= 16:  # FIFO bound: dicts preserve order
             _GEN_CACHE.pop(next(iter(_GEN_CACHE)))
         fn = jax.jit(functools.partial(_generate_all, cfg, max_new_tokens,
-                                       greedy, top_k))
+                                       greedy, top_k, has_mask))
         _GEN_CACHE[cache_key] = fn
     return fn(stacked, embed, final_norm, lm_head, input_ids, key,
-              jnp.asarray(temperature, jnp.float32))
+              jnp.asarray(temperature, jnp.float32), pad_len, scales)
 
 
 def llama_loss_fn(model, input_ids, labels):
