@@ -30,7 +30,12 @@ class GenerationPredictor:
     family) for serving. ``bf16=True`` casts weights to bf16 storage
     (half the HBM, faster decode)."""
 
-    def __init__(self, model, bf16=False, pad_id=0):
+    def __init__(self, model, bf16=False, pad_id=0, int8=False):
+        """``int8=True`` (VERDICT r3 #4c): weight-only int8 PTQ — the
+        matmul weights live in HBM as per-channel int8 and dequantize
+        inside the compiled program (models.llama.quantize_weights_int8).
+        Composes with ``bf16`` (int8 weights, bf16 activations). The
+        model becomes serving-only (its float weights are gone)."""
         self.model = model
         self.pad_id = int(pad_id)
         if bf16:
@@ -40,14 +45,29 @@ class GenerationPredictor:
                     p._in_place_update(p._value.astype(jnp.bfloat16))
             if hasattr(model, "config"):
                 model.config.dtype = "bfloat16"
+        if int8:
+            from ..models.llama import quantize_weights_int8
+            quantize_weights_int8(model)
         model.eval()
 
+    def supports_mask(self) -> bool:
+        """attention_mask rides the KV-cache generate path, which a pp>1
+        mesh forces off — BatchingServer falls back to per-length
+        grouping there."""
+        try:
+            from ..distributed.fleet.mp_layers import current_mesh
+            from ..models.llama import _pp_degree
+            return _pp_degree(current_mesh()) <= 1
+        except Exception:  # noqa: BLE001 — unknown model family
+            return False
+
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_k=0, seed=0):
+                 top_k=0, seed=0, attention_mask=None):
         """input_ids: [b, s] int array (right-aligned, pad with pad_id on
         the LEFT if rows differ — decode appends on the right). Returns
-        np [b, s + max_new_tokens]. Emits a ``serve_generate`` event with
-        measured tokens/s."""
+        np [b, s + max_new_tokens]. ``attention_mask`` [b, s] (1 = real
+        token) lets mixed-length prompts share ONE compiled program.
+        Emits a ``serve_generate`` event with measured tokens/s."""
         from ..core.tensor import Tensor
         from ..utils.log import log_event
         ids = np.asarray(input_ids)
@@ -55,7 +75,7 @@ class GenerationPredictor:
         out = self.model.generate(Tensor(ids),
                                   max_new_tokens=max_new_tokens,
                                   temperature=temperature, top_k=top_k,
-                                  seed=seed)
+                                  seed=seed, attention_mask=attention_mask)
         arr = np.asarray(out._value)
         dt = time.perf_counter() - t0
         log_event("serve_generate", batch=int(ids.shape[0]),
@@ -150,11 +170,43 @@ class BatchingServer:
                     r.error = e
                     r.event.set()
 
+    @staticmethod
+    def _bucket_len(n: int) -> int:
+        """Pad the prompt length up to a coarse bucket so mixed traffic
+        reuses a few compiled programs instead of one per exact length
+        (the mask makes the extra pads free)."""
+        b = 16
+        while b < n:
+            b *= 2
+        return b
+
     def _run_batch(self, batch):
-        # group by prompt length: padding without an attention mask would
-        # corrupt positions/attention, so equal-length requests share a
-        # generate call and lengths run as separate sub-batches (the
-        # compiled program is cached per (batch, len) bucket anyway)
+        if not self.predictor.supports_mask():
+            return self._run_batch_grouped(batch)
+        # ONE program for the whole tick (VERDICT r3 #4a): left-pad every
+        # prompt to a common bucketed length and pass the attention mask;
+        # positions/attention stay correct for every row, so mixed-length
+        # traffic no longer degenerates into per-length singleton batches
+        max_new = max(r.max_new for r in batch)
+        lens = [r.ids.reshape(-1).size for r in batch]
+        s0 = self._bucket_len(max(lens))
+        pad_id = self.predictor.pad_id
+        rows = np.full((len(batch), s0), pad_id, np.int32)
+        mask = np.zeros((len(batch), s0), np.int32)
+        for i, (r, n) in enumerate(zip(batch, lens)):
+            rows[i, s0 - n:] = r.ids.reshape(-1)
+            mask[i, s0 - n:] = 1
+        out = self.predictor.generate(rows, max_new_tokens=max_new,
+                                      temperature=0.0,
+                                      attention_mask=mask)
+        for i, (r, n) in enumerate(zip(batch, lens)):
+            # strip this row's left padding, trim to ITS asked length
+            r.result = out[i, s0 - n:s0 + r.max_new]
+            r.event.set()
+
+    def _run_batch_grouped(self, batch):
+        """pp>1 fallback: equal-length requests share a generate call,
+        lengths run as separate sub-batches (the pre-mask behavior)."""
         by_len: dict[int, list[_Request]] = {}
         for r in batch:
             by_len.setdefault(r.ids.reshape(-1).size, []).append(r)
@@ -164,6 +216,5 @@ class BatchingServer:
             out = self.predictor.generate(rows, max_new_tokens=max_new,
                                           temperature=0.0)
             for i, r in enumerate(group):
-                # trim to THIS request's asked length
                 r.result = out[i, :rows.shape[1] + r.max_new]
                 r.event.set()

@@ -549,6 +549,85 @@ class TestBaselineConfig4SFT:
             o.clear_grad()
         assert float(loss) < first
 
+    def test_shared_experts_active_and_trained(self):
+        """VERDICT r3 #5: ERNIE-4.5/DeepSeekMoE shared experts — the
+        always-on dense FFN beside the routed experts. The ernie preset
+        now carries them; they must change the forward and receive
+        gradients."""
+        from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                             llama_loss_fn)
+        paddle.seed(1)
+        m = LlamaForCausalLM("ernie-debug")
+        assert m.config.moe_num_shared_experts == 1
+        assert any(n.endswith("ws_gate") for n, _ in m.named_parameters())
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (2, 16), dtype=np.int32))
+        loss = llama_loss_fn(m, ids, ids)
+        loss.backward()
+        grads = {n: p.grad for n, p in m.named_parameters()}
+        for nm in ("ws_gate", "ws_up", "ws_down"):
+            g = next(g for n, g in grads.items() if n.endswith(nm))
+            assert g is not None and float(paddle.abs(g).sum()) > 0, nm
+        # ablation: zeroing the shared experts changes the logits
+        before = np.asarray(m(ids)._value)
+        for n, p in m.named_parameters():
+            if n.endswith(("ws_gate", "ws_up", "ws_down")):
+                p._in_place_update(p._value * 0)
+        after = np.asarray(m(ids)._value)
+        assert not np.allclose(before, after)
+
+    def test_dropless_matches_capacity_when_nothing_drops(self):
+        """VERDICT r3 #5: dropless training (ragged grouped GEMMs via
+        lax.ragged_dot). With capacity >= N*k the capacity path drops
+        nothing, so both dispatches must agree; under a tight capacity
+        they diverge (capacity really truncates) while dropless still
+        serves every token."""
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        ids = np.random.randint(0, 128, (2, 16), dtype=np.int32)
+
+        def build(dropless, cap=8.0):
+            paddle.seed(3)
+            cfg = dict(vocab_size=128, hidden_size=64,
+                       intermediate_size=172, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=2,
+                       max_position_embeddings=256, num_experts=4,
+                       num_experts_per_tok=2, moe_capacity_factor=cap,
+                       moe_dropless=dropless)
+            return LlamaForCausalLM(LlamaConfig(**cfg))
+
+        out_cap = np.asarray(build(False)(paddle.to_tensor(ids))._value)
+        out_drop = np.asarray(build(True)(paddle.to_tensor(ids))._value)
+        np.testing.assert_allclose(out_drop, out_cap, atol=2e-4)
+        out_tight = np.asarray(
+            build(False, cap=0.3)(paddle.to_tensor(ids))._value)
+        assert not np.allclose(out_tight, out_drop, atol=2e-4)
+
+    def test_dropless_trains(self):
+        """Dropless gradients flow through the ragged dispatch and the
+        step descends."""
+        from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                             llama_loss_fn)
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                          intermediate_size=172, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=256, num_experts=4,
+                          num_experts_per_tok=2, moe_dropless=True)
+        m = LlamaForCausalLM(cfg)
+        o = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                   parameters=m.parameters())
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (4, 32), dtype=np.int32))
+        first = None
+        for _ in range(6):
+            loss = llama_loss_fn(m, ids, ids)
+            if first is None:
+                first = float(loss)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        assert float(loss) < first
+
 
 class TestZeroStage12:
     """ZeRO-1/2: optimizer state sharded over 'sharding' while params stay
@@ -1128,6 +1207,36 @@ class TestStrategyDrivenCompilation:
 
         assert "bf16" in lowered_text(True)
         assert "bf16" not in lowered_text(False)
+
+    def test_inert_knob_warns_once(self):
+        """VERDICT r3 weak #8: a stored-but-unconsumed knob set to a
+        non-default value produces a one-time warning when the strategy
+        is applied; consumed knobs never warn."""
+        st = dist.fleet.DistributedStrategy()
+        st.pipeline = True
+        st.pipeline_configs = {"accumulate_steps": 2,
+                               "schedule_mode": "FThenB"}
+        st.sharding = True
+        st.sharding_configs = {"stage": 2, "optimize_offload": True}
+        with pytest.warns(RuntimeWarning, match="NOT consumed") as rec:
+            st._warn_inert_knobs()
+        msg = str(rec[0].message)
+        assert "pipeline_configs.schedule_mode" in msg
+        assert "sharding_configs.optimize_offload" in msg
+        assert "accumulate_steps" not in msg
+        import warnings as _w
+        with _w.catch_warnings(record=True) as again:
+            _w.simplefilter("always")
+            st._warn_inert_knobs()
+        assert not again
+
+        clean = dist.fleet.DistributedStrategy()
+        clean.gradient_merge = True
+        clean.gradient_merge_configs = {"k_steps": 2}
+        with _w.catch_warnings(record=True) as none:
+            _w.simplefilter("always")
+            clean._warn_inert_knobs()
+        assert not none
 
     def test_proto_surface_accepts_reference_recipe_keys(self):
         st = dist.fleet.DistributedStrategy()

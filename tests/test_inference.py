@@ -85,6 +85,117 @@ class TestServing:
         evs = default_event_log.events("serve_generate")
         assert evs and evs[0]["tokens_per_s"] > 0
 
+    def test_masked_generate_matches_per_row(self):
+        """attention_mask + left padding: each row of a mixed-length
+        masked batch must reproduce its solo unpadded greedy decode
+        exactly (positions pad-relative, pad keys excluded)."""
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        paddle.seed(0)
+        m = LlamaForCausalLM("debug")
+        m.eval()
+        rng = np.random.RandomState(0)
+        p1 = rng.randint(1, 128, (1, 5)).astype(np.int32)
+        p2 = rng.randint(1, 128, (1, 9)).astype(np.int32)
+        r1 = np.asarray(m.generate(p1, max_new_tokens=6,
+                                   temperature=0.0)._value)
+        r2 = np.asarray(m.generate(p2, max_new_tokens=6,
+                                   temperature=0.0)._value)
+        s0 = 9
+        batch = np.zeros((2, s0), np.int32)
+        mask = np.zeros((2, s0), np.int32)
+        batch[0, s0 - 5:] = p1[0]
+        mask[0, s0 - 5:] = 1
+        batch[1] = p2[0]
+        mask[1] = 1
+        out = np.asarray(m.generate(batch, max_new_tokens=6,
+                                    temperature=0.0,
+                                    attention_mask=mask)._value)
+        np.testing.assert_array_equal(out[0, s0 - 5:], r1[0])
+        np.testing.assert_array_equal(out[1], r2[0])
+
+    def test_chunked_decode_attention_parity(self):
+        """VERDICT r3 #4b: the chunked (online-softmax) decode path is
+        bit-identical to the single-pass full-cache softmax."""
+        from paddle_tpu.models import llama
+        paddle.seed(0)
+        m = llama.LlamaForCausalLM("debug")
+        m.eval()
+        ids = np.random.RandomState(0).randint(
+            1, 128, (2, 12)).astype(np.int32)
+        ref = np.asarray(m.generate(ids, max_new_tokens=8,
+                                    temperature=0.0)._value)
+        old = llama._DECODE_CHUNK
+        llama._GEN_CACHE.clear()
+        llama._DECODE_CHUNK = 8      # force chunking on the tiny cache
+        try:
+            got = np.asarray(m.generate(ids, max_new_tokens=8,
+                                        temperature=0.0)._value)
+        finally:
+            llama._DECODE_CHUNK = old
+            llama._GEN_CACHE.clear()
+        np.testing.assert_array_equal(ref, got)
+
+    def test_int8_weight_only_parity(self):
+        """VERDICT r3 #4c: int8 PTQ weights wired into the predictor —
+        generation with in-program dequant matches a float model carrying
+        the same quantization error exactly; weights live as int8."""
+        import jax.numpy as jnp
+        from paddle_tpu.inference.serving import GenerationPredictor
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        rng = np.random.RandomState(0)
+        ids = rng.randint(1, 128, (2, 10)).astype(np.int32)
+
+        paddle.seed(4)
+        m_ref = LlamaForCausalLM("debug")
+        names = [x for x in m_ref._stacked_names()
+                 if not x.endswith(("_ln", "bq", "bk", "bv", "router"))]
+        for n in names + ["lm_head"]:
+            p = m_ref._parameters[n]
+            w = p._value.astype(jnp.float32)
+            amax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)
+            scale = jnp.maximum(amax, 1e-8) / 127.0
+            q = jnp.clip(jnp.round(w / scale), -127, 127)
+            p._in_place_update((q * scale).astype(jnp.float32))
+        ref = np.asarray(m_ref.generate(ids, max_new_tokens=6,
+                                        temperature=0.0)._value)
+
+        paddle.seed(4)
+        m_q = LlamaForCausalLM("debug")
+        pred = GenerationPredictor(m_q, int8=True)
+        assert m_q._parameters["wq"]._value.dtype == jnp.int8
+        out = pred.generate(ids, max_new_tokens=6, temperature=0.0)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_mixed_lengths_share_one_program(self):
+        """VERDICT r3 #4a: unequal-length prompts merge into ONE
+        masked generate call (previously one sub-batch per distinct
+        length), with per-row greedy parity against solo generation."""
+        from paddle_tpu.inference.serving import (BatchingServer,
+                                                  GenerationPredictor)
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        paddle.seed(0)
+        m = LlamaForCausalLM("debug")
+        pred = GenerationPredictor(m)
+        calls = []
+        orig = pred.generate
+        pred.generate = lambda *a, **k: calls.append(1) or orig(*a, **k)
+        srv = BatchingServer(pred, max_batch=4, max_wait_ms=200,
+                             max_new_tokens=4)
+        try:
+            rng = np.random.RandomState(1)
+            prompts = [rng.randint(1, 128, (n,)).astype(np.int32)
+                       for n in (4, 7, 11)]
+            reqs = [srv.submit(p) for p in prompts]
+            outs = [r.wait(timeout=300) for r in reqs]
+            assert len(calls) == 1, f"expected ONE merged call, got {calls}"
+            for p, o in zip(prompts, outs):
+                assert o.shape == (p.size + 4,)
+                np.testing.assert_array_equal(o[:p.size], p)
+                solo = orig(p[None], max_new_tokens=4)[0]
+                np.testing.assert_array_equal(o, solo)
+        finally:
+            srv.close()
+
     def test_batching_server_coalesces_and_resolves(self):
         from paddle_tpu.inference.serving import (BatchingServer,
                                                   GenerationPredictor)
