@@ -55,6 +55,13 @@ class _RecordedOp:
         # alive for the Program's lifetime (CPython reuses freed ids)
         self.out_refs = out_refs
 
+    def copy(self):
+        """Op-level copy so a pass pipeline can rewrite arg_slots without
+        mutating the recorded original."""
+        return _RecordedOp(self.name, self.fn, list(self.arg_slots),
+                           dict(self.kwargs), list(self.out_ids),
+                           list(self.out_refs))
+
 
 class Program:
     """reference framework.Program / ProgramDesc — an ordered op list with
@@ -191,9 +198,20 @@ class Executor:
     Replays the recorded op list as one pure function, jit-compiled per
     feed-shape signature (the _ExecutorCache analogue)."""
 
-    def __init__(self, place=None):
+    # the analysis pipeline run on every program before compilation
+    # (reference: InterpreterCore builds from a pass-processed program,
+    # new_executor/interpretercore.h:29; inference/analysis/ runs the
+    # same shape of pipeline before AnalysisPredictor executes). The
+    # inference Predictor here consumes a serialized StableHLO module,
+    # where XLA's own pipeline subsumes these passes — the Program
+    # pipeline applies to the recorded-Program executor path.
+    DEFAULT_PASSES = ("constant_folding", "cse", "dead_op_elimination")
+
+    def __init__(self, place=None, passes=DEFAULT_PASSES):
         self.place = place
         self._cache: dict = {}
+        self._passes = tuple(passes)
+        self.last_pass_stats: list[dict] = []
 
     def run(self, program: Program = None, feed: dict | None = None,
             fetch_list=None, return_numpy=True):
@@ -226,11 +244,22 @@ class Executor:
                tuple(fetch_ids))
         entry = self._cache.get(key)
         if entry is None:
+            # run the pass pipeline at compile time (cache miss only):
+            # fold constants, dedupe, then drop ops no fetch depends on
+            run_prog = program
+            if self._passes:
+                from .passes import PassManager
+                # deep-enough clone: passes mutate ops/arg_slots in place
+                run_prog = program.clone()
+                run_prog.ops = [op.copy() for op in program.ops]
+                pm = PassManager(self._passes)
+                run_prog = pm.run(run_prog, fetch_ids=fetch_ids)
+                self.last_pass_stats = pm.stats
             # hold the Program in the entry: idx is unique per Program
             # instance, and the ref also pins every recorded Tensor id
-            entry = (jax.jit(self._make_runner(program, feed_names,
+            entry = (jax.jit(self._make_runner(run_prog, feed_names,
                                                fetch_ids, ext_ids)),
-                     program)
+                     (program, run_prog))
             self._cache[key] = entry
         outs = entry[0](feed_vals, ext_vals)
         if return_numpy:
