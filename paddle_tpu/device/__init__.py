@@ -273,9 +273,21 @@ def memory_stats(device=None) -> dict:
         try:
             return sum(sh.data.nbytes for sh in a.addressable_shards
                        if sh.device == dev)
-        except Exception:  # noqa: BLE001 — fall back to whole-array
-            return a.nbytes if dev in getattr(
-                a, "devices", lambda: set())() else 0
+        except Exception:  # noqa: BLE001 — shard objects unavailable
+            devs = getattr(a, "devices", lambda: set())()
+            if dev not in devs:
+                return 0
+            try:
+                # exact per-device bytes from the sharding's shard shape
+                # (replicated -> full size, sharded -> slice size); never
+                # charge the GLOBAL size per device
+                shp = a.sharding.shard_shape(a.shape)
+                n = 1
+                for s in shp:
+                    n *= s
+                return n * a.dtype.itemsize
+            except Exception:  # noqa: BLE001 — even split approximation
+                return a.nbytes // max(len(devs), 1)
 
     pairs = [(a, _dev_bytes(a)) for a in jax.live_arrays()]
     pairs = [(a, b) for a, b in pairs if b > 0]

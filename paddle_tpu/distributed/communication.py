@@ -145,6 +145,10 @@ def _gsize(group):
 
 
 _GRP_ROUND: dict[int, int] = {}
+# groups whose round counter desynchronized (a member timed out
+# mid-gather while peers advanced; their lag-2 cleanup will delete keys
+# the straggler still needs) — terminally unusable, not retryable
+_GRP_DEAD: set[int] = set()
 
 
 def _check_payload_size(nbytes, what):
@@ -185,6 +189,12 @@ class _KvSubgroup:
         _check_payload_size(len(payload), "subgroup collective")
         client = _kv_client()
         g = self.group
+        if g.gid in _GRP_DEAD:
+            raise RuntimeError(
+                f"subgroup {g.gid} is unusable: a previous collective "
+                f"timed out and the group's round state desynchronized "
+                f"from its peers; create a new group (reference: a "
+                f"timed-out NCCL communicator is also terminal)")
         r = _GRP_ROUND.get(g.gid, 0)
         me = get_rank()
         pre = f"ptpu_grp/{g.gid}/{r}"
@@ -192,14 +202,20 @@ class _KvSubgroup:
                              base64.b64encode(payload).decode())
         timeout_ms = 2000 * int(flags.flag("comm_timeout_seconds"))
         outs = []
-        with comm_guard("subgroup_gather", f"gid={g.gid} round={r}"):
-            for peer in g.ranks:
-                if peer == me:
-                    outs.append(payload)
-                else:
-                    outs.append(base64.b64decode(
-                        client.blocking_key_value_get(
-                            f"{pre}/{peer}", timeout_ms)))
+        try:
+            with comm_guard("subgroup_gather", f"gid={g.gid} round={r}"):
+                for peer in g.ranks:
+                    if peer == me:
+                        outs.append(payload)
+                    else:
+                        outs.append(base64.b64decode(
+                            client.blocking_key_value_get(
+                                f"{pre}/{peer}", timeout_ms)))
+        except Exception:
+            # peers that completed this round keep advancing; our counter
+            # can never catch up safely — poison the group
+            _GRP_DEAD.add(g.gid)
+            raise
         # advance the round only after a COMPLETE gather — a timeout must
         # not desynchronize this member from its peers (same convention
         # as recv()'s deferred seq increment)

@@ -31,6 +31,11 @@ class P2PTransport:
         self.rank = rank
         self._kv = kv_client
         self._inbox: dict[tuple[int, int], bytes | bytearray] = {}
+        self._inbox_when: dict[tuple[int, int], float] = {}
+        # parked bytes PER SOURCE: the cap must backpressure only the
+        # sender that is hoarding, never stall another connection's
+        # reader behind someone else's backlog
+        self._inbox_bytes: dict[int, int] = {}
         self._cv = threading.Condition()
         self._conns: dict[int, socket.socket] = {}
         self._conn_lock = threading.Lock()      # guards the dicts only
@@ -90,11 +95,43 @@ class P2PTransport:
                 buf = self._read_exact(conn, nbytes)
                 if buf is None:
                     return
+                import time
+                from .. import flags
+                cap = float(flags.flag("p2p_inbox_max_mb")) * 2 ** 20
                 with self._cv:
+                    if cap:
+                        # bound parked memory per SOURCE: expire stale
+                        # unclaimed messages, then block this reader
+                        # (TCP backpressure to ITS sender) while this
+                        # source's own backlog exceeds the cap
+                        self._expire_locked()
+                        while self._inbox_bytes.get(src, 0) + nbytes \
+                                > cap and any(
+                                    k[0] == src for k in self._inbox):
+                            if not self._cv.wait(timeout=1.0):
+                                self._expire_locked()
                     self._inbox[(src, seq)] = buf
+                    self._inbox_when[(src, seq)] = time.monotonic()
+                    self._inbox_bytes[src] = \
+                        self._inbox_bytes.get(src, 0) + nbytes
                     self._cv.notify_all()
         finally:
             conn.close()
+
+    def _expire_locked(self):
+        """Drop unclaimed inbox entries older than 2x the comm timeout —
+        a (src, seq) nobody recv()s must not leak forever. Caller holds
+        the condition lock."""
+        import time
+        from .. import flags
+        ttl = 2.0 * float(flags.flag("comm_timeout_seconds"))
+        now = time.monotonic()
+        for key in [k for k, t in self._inbox_when.items()
+                    if now - t > ttl]:
+            dropped = self._inbox.pop(key, b"")
+            self._inbox_bytes[key[0]] = \
+                self._inbox_bytes.get(key[0], 0) - len(dropped)
+            self._inbox_when.pop(key, None)
 
     @staticmethod
     def _read_exact(conn, n):
@@ -119,7 +156,12 @@ class P2PTransport:
                 raise TimeoutError(
                     f"p2p socket recv from rank {src} seq {seq} timed "
                     f"out after {timeout}s")
-            return self._inbox.pop((src, seq))
+            buf = self._inbox.pop((src, seq))
+            self._inbox_when.pop((src, seq), None)
+            self._inbox_bytes[src] = self._inbox_bytes.get(src, 0) \
+                - len(buf)
+            self._cv.notify_all()      # wake a reader blocked on the cap
+            return buf
 
     # -- send side ----------------------------------------------------------
     def _dst_lock(self, dst):

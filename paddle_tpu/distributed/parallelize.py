@@ -179,6 +179,8 @@ class DistTrainStep:
         st = self.strategy
         if st is None:
             return
+        if hasattr(st, "_warn_inert_knobs"):
+            st._warn_inert_knobs()   # flag non-default knobs nothing reads
         cfg = getattr(self.model, "config", None)
         if getattr(st, "recompute", False) and cfg is not None \
                 and hasattr(cfg, "recompute"):
@@ -401,11 +403,12 @@ class DistTrainStep:
     def __call__(self, *args):
         opt = self.optimizer
         multi = jax.process_count() > 1
+        from ..core.lazy import concrete as _conc
         args_vals = jax.tree_util.tree_map(
             # multi-controller keeps numpy on host: the global-assembly
             # helpers consume numpy directly, so an eager jnp.asarray here
             # would just add an H2D+D2H round trip per step
-            lambda x: x._value if isinstance(x, Tensor) else
+            lambda x: _conc(x._value) if isinstance(x, Tensor) else
             (x if multi else jnp.asarray(x)) if isinstance(x, np.ndarray)
             else x, args,
             is_leaf=lambda x: isinstance(x, (Tensor, np.ndarray)))

@@ -111,3 +111,4 @@ define_flag("sep_attention_mode", "ring",
 define_flag("allocator_strategy", "auto_growth", "Kept for API parity; PJRT owns device memory on TPU.")
 define_flag("comm_timeout_seconds", 1800, "Collective watchdog timeout (reference NCCLCommTask 30min default).")
 define_flag("eager_comm_max_mb", 64, "Hard cap (MB) for a single eager send/recv or subgroup-collective payload: the eager path rides the coordinator KV store (control-plane bandwidth) and must never carry activations — use compiled collectives for data. 0 disables the check.")
+define_flag("p2p_inbox_max_mb", 256, "Per-SOURCE bytes the p2p socket transport may park in its receive inbox before that source's reader blocks (TCP backpressure to the hoarding sender only — other connections keep flowing). Unclaimed messages older than 2x comm_timeout_seconds are dropped. 0 disables both bounds.")

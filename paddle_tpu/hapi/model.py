@@ -31,6 +31,16 @@ def _to_tensor_list(data):
 def _as_loader(data, batch_size, shuffle):
     if data is None or isinstance(data, DataLoader):
         return data
+    if shuffle:
+        # epoch-seeded shuffle (set_epoch in fit's loop): crash-resume
+        # skips the first start_step batches, which only re-creates the
+        # pre-crash order if the shuffle is deterministic per epoch —
+        # an unseeded global-RNG shuffle would re-train some samples and
+        # skip others (reference DistributedBatchSampler epoch seeding)
+        from ..io import DistributedBatchSampler
+        bs = DistributedBatchSampler(data, batch_size, num_replicas=1,
+                                     rank=0, shuffle=True)
+        return DataLoader(data, batch_sampler=bs)
     return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
 
 
@@ -218,6 +228,9 @@ class Model:
         history = {"loss": []}
         step_count = 0
         for epoch in range(epochs):
+            sampler = getattr(loader, "batch_sampler", None)
+            if hasattr(sampler, "set_epoch"):
+                sampler.set_epoch(epoch)   # deterministic resume order
             cbk.on_epoch_begin(epoch)
             self._reset_metrics()
             logs = {}
