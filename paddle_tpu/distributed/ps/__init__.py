@@ -18,8 +18,8 @@ import threading
 
 import numpy as np
 
-__all__ = ["MemorySparseTable", "MemoryDenseTable", "PsServer", "PsClient",
-           "GeoCommunicator",
+__all__ = ["MemorySparseTable", "MemoryDenseTable", "GraphTable",
+           "PsServer", "PsClient", "GeoCommunicator",
            "SparseAccessor"]
 
 
@@ -153,6 +153,154 @@ class MemoryDenseTable:
 _SERVER_TABLES: dict[int, object] = {}
 
 
+class GraphTable:
+    """Graph-PS table (SURVEY missing #6; reference
+    ps/table/common_graph_table.h:501 GraphTable): adjacency lists per
+    edge type plus node features per node type, served remotely for GNN
+    sampling. The reference shards nodes by id hash across servers and
+    samples on the CPU side; here one in-memory table per server plays
+    that role (multi-server sharding = one table per server with the
+    caller routing ``id % n_servers`` — the reference's
+    get_sparse_shard convention).
+
+    Capability map: random_sample_neighbors:540, random_sample_nodes,
+    pull_graph_list, get/set_node_feat, add_graph_node:617."""
+
+    def __init__(self, seed=0):
+        self._adj: dict[int, dict[int, list]] = {}      # idx -> id -> nbrs
+        self._weights: dict[int, dict[int, list]] = {}
+        self._feat: dict[int, dict[int, dict[str, np.ndarray]]] = {}
+        self._sorted_ids: dict[int, list] = {}          # pull_graph cache
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+
+    # -- build --------------------------------------------------------------
+    def add_edges(self, idx, src, dst, weights=None):
+        """Directed edges src->dst under edge-type ``idx`` (reference
+        add_graph_node + build_sampler per shard). Mixing weighted and
+        unweighted calls is allowed: missing weights default to 1.0 so
+        the per-node weight list always aligns with the adjacency."""
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        w = (np.asarray(weights, np.float32) if weights is not None
+             else None)
+        with self._lock:
+            adj = self._adj.setdefault(int(idx), {})
+            wts = self._weights.setdefault(int(idx), {})
+            for i, (s, d) in enumerate(zip(src.tolist(), dst.tolist())):
+                nbrs = adj.setdefault(s, [])
+                if w is not None and s not in wts:
+                    wts[s] = [1.0] * len(nbrs)  # backfill earlier edges
+                nbrs.append(d)
+                if s in wts:
+                    wts[s].append(float(w[i]) if w is not None else 1.0)
+            self._sorted_ids.pop(int(idx), None)
+        return len(src)
+
+    def set_node_feat(self, idx, ids, name, values):
+        values = np.asarray(values)
+        with self._lock:
+            feats = self._feat.setdefault(int(idx), {})
+            for i, nid in enumerate(np.asarray(ids, np.int64).tolist()):
+                feats.setdefault(nid, {})[name] = values[i]
+        return True
+
+    # -- queries ------------------------------------------------------------
+    def sample_neighbors(self, idx, node_ids, sample_size,
+                         need_weight=False):
+        """Uniform neighbor sampling without replacement (reference
+        random_sample_neighbors). Returns (flat neighbors, per-node
+        counts[, flat weights])."""
+        out, cnt, out_w = [], [], []
+        with self._lock:
+            adj = self._adj.get(int(idx), {})
+            wts = self._weights.get(int(idx), {})
+            for nid in np.asarray(node_ids, np.int64).tolist():
+                nbrs = adj.get(nid, [])
+                ws = wts.get(nid)
+                if 0 <= sample_size < len(nbrs):
+                    pick = self._rng.choice(len(nbrs), size=sample_size,
+                                            replace=False)
+                    chosen = [nbrs[j] for j in pick]
+                    chosen_w = [ws[j] for j in pick] if ws else None
+                else:
+                    chosen, chosen_w = list(nbrs), (list(ws) if ws
+                                                    else None)
+                out.extend(chosen)
+                cnt.append(len(chosen))
+                if need_weight:
+                    out_w.extend(chosen_w if chosen_w is not None
+                                 else [1.0] * len(chosen))
+        nb = np.asarray(out, np.int64)
+        ct = np.asarray(cnt, np.int32)
+        if need_weight:
+            return nb, ct, np.asarray(out_w, np.float32)
+        return nb, ct
+
+    def sample_nodes(self, idx, sample_size):
+        """Uniform node sampling (reference random_sample_nodes) — the
+        GraphSAGE/deepwalk start-node draw; -1 returns every node. The
+        shared Generator is only touched under the lock (it is not
+        thread-safe and serves concurrent RPCs)."""
+        with self._lock:
+            ids = list(self._adj.get(int(idx), {}).keys())
+            if not ids:
+                return np.asarray([], np.int64)
+            if sample_size < 0 or sample_size >= len(ids):
+                return np.asarray(ids, np.int64)
+            pick = self._rng.choice(len(ids), size=sample_size,
+                                    replace=False)
+        return np.asarray([ids[j] for j in pick], np.int64)
+
+    def pull_graph_list(self, idx, start, size):
+        """Batched node-id listing (reference pull_graph_list) — the
+        full-graph iteration primitive. The sorted id list is cached and
+        invalidated by add_edges, so paging a static graph is O(page)
+        per call, not O(N log N)."""
+        with self._lock:
+            ids = self._sorted_ids.get(int(idx))
+            if ids is None:
+                ids = sorted(self._adj.get(int(idx), {}).keys())
+                self._sorted_ids[int(idx)] = ids
+        return np.asarray(ids[start:start + size], np.int64)
+
+    def get_node_feat(self, idx, ids, name):
+        with self._lock:
+            feats = self._feat.get(int(idx), {})
+            return [feats.get(nid, {}).get(name)
+                    for nid in np.asarray(ids, np.int64).tolist()]
+
+    def size(self, idx=0):
+        return len(self._adj.get(int(idx), {}))
+
+    # -- persistence (reference GraphTable Save/Load) -----------------------
+    def save(self, path):
+        import pickle
+        with self._lock:
+            with open(path + ".pkl", "wb") as f:
+                pickle.dump({"adj": self._adj, "weights": self._weights,
+                             "feat": self._feat}, f)
+
+    def load(self, path):
+        import pickle
+        with open(path, "rb") as f:
+            d = pickle.load(f)
+        with self._lock:
+            self._adj = d["adj"]
+            self._weights = d["weights"]
+            self._feat = d["feat"]
+            self._sorted_ids = {}
+
+
+def _srv_register_graph(table_id, seed):
+    _SERVER_TABLES[table_id] = GraphTable(seed)
+    return True
+
+
+def _srv_graph_call(table_id, method, args, kwargs):
+    return getattr(_SERVER_TABLES[table_id], method)(*args, **kwargs)
+
+
 def _srv_register_sparse(table_id, dim, kwargs):
     _SERVER_TABLES[table_id] = MemorySparseTable(dim, **kwargs)
     return True
@@ -200,6 +348,9 @@ def _srv_save_all(dirname):
             np.save(os.path.join(dirname, f"dense_{tid}.npy"),
                     table.pull())
             saved.append(("dense", tid))
+        elif isinstance(table, GraphTable):
+            table.save(os.path.join(dirname, f"graph_{tid}"))
+            saved.append(("graph", tid))
     return saved
 
 
@@ -220,6 +371,11 @@ def _srv_load_all(dirname):
             if os.path.exists(p):
                 table.set_value(np.load(p))
                 loaded.append(("dense", tid))
+        elif isinstance(table, GraphTable):
+            p = os.path.join(dirname, f"graph_{tid}.pkl")
+            if os.path.exists(p):
+                table.load(p)
+                loaded.append(("graph", tid))
     return loaded
 
 
@@ -289,6 +445,40 @@ class PsClient:
     def table_size(self, table_id):
         return self._rpc.rpc_sync(self.server, _srv_table_size,
                                   args=(table_id,))
+
+    # -- graph-PS (reference BrpcPsClient graph RPCs over
+    # common_graph_table.h) ------------------------------------------------
+    def create_graph_table(self, table_id, seed=0):
+        return self._rpc.rpc_sync(self.server, _srv_register_graph,
+                                  args=(table_id, seed))
+
+    def _graph(self, table_id, method, *args, **kwargs):
+        return self._rpc.rpc_sync(self.server, _srv_graph_call,
+                                  args=(table_id, method, args, kwargs))
+
+    def add_graph_edges(self, table_id, idx, src, dst, weights=None):
+        return self._graph(table_id, "add_edges", idx, np.asarray(src),
+                           np.asarray(dst), weights)
+
+    def sample_neighbors(self, table_id, idx, node_ids, sample_size,
+                         need_weight=False):
+        return self._graph(table_id, "sample_neighbors", idx,
+                           np.asarray(node_ids), sample_size,
+                           need_weight)
+
+    def sample_nodes(self, table_id, idx, sample_size):
+        return self._graph(table_id, "sample_nodes", idx, sample_size)
+
+    def pull_graph_list(self, table_id, idx, start, size):
+        return self._graph(table_id, "pull_graph_list", idx, start, size)
+
+    def set_node_feat(self, table_id, idx, ids, name, values):
+        return self._graph(table_id, "set_node_feat", idx,
+                           np.asarray(ids), name, np.asarray(values))
+
+    def get_node_feat(self, table_id, idx, ids, name):
+        return self._graph(table_id, "get_node_feat", idx,
+                           np.asarray(ids), name)
 
     def save_persistables(self, dirname):
         """reference fleet.save_persistables → per-table Save on the

@@ -208,6 +208,19 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
     return Tensor(jnp.asarray(neighbors)), Tensor(jnp.asarray(counts))
 
 
+def sample_neighbors_remote(client, table_id, input_nodes, sample_size=-1,
+                            idx=0, name=None):
+    """Neighbor sampling against a distributed graph-PS table
+    (reference: GNN training pulling from common_graph_table.h via the
+    PS client — the graph lives server-side, workers sample remotely).
+    Same return contract as :func:`sample_neighbors`."""
+    nodes = np.asarray(_t(input_nodes)._value)
+    nbrs, counts = client.sample_neighbors(table_id, idx, nodes,
+                                           sample_size)
+    return (Tensor(jnp.asarray(np.asarray(nbrs, np.int64))),
+            Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+
+
 def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
                               sample_size=-1, eids=None, return_eids=False,
                               name=None):
@@ -278,4 +291,5 @@ def reindex_heter_graph(x, neighbors, count, value_buffer=None,
     return reindexed, edge_src, Tensor(jnp.asarray(inv))
 
 
-__all__ += ["weighted_sample_neighbors", "reindex_heter_graph"]
+__all__ += ["weighted_sample_neighbors", "reindex_heter_graph",
+            "sample_neighbors_remote"]

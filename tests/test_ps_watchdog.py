@@ -146,3 +146,74 @@ class TestPsPersistenceGeoShrink:
         assert n == 2 and t.size() == 1
         # evicted rows lazily re-init on next access
         assert t.pull([2]).shape == (1, 4)
+
+
+class TestGraphPs:
+    def test_graph_table_local(self):
+        """SURVEY missing #6 (reference common_graph_table.h:501): graph
+        table with edge types, neighbor/node sampling, features."""
+        t = ps.GraphTable(seed=0)
+        t.add_edges(0, [1, 1, 1, 2, 2], [10, 11, 12, 20, 21],
+                    weights=[0.1, 0.2, 0.3, 0.4, 0.5])
+        assert t.size(0) == 2
+        nb, ct = t.sample_neighbors(0, [1, 2, 3], sample_size=2)
+        assert ct.tolist()[0] == 2 and ct.tolist()[1] == 2 \
+            and ct.tolist()[2] == 0
+        assert set(nb[:2].tolist()) <= {10, 11, 12}
+        assert set(nb[2:4].tolist()) <= {20, 21}
+        nb_all, ct_all, w = t.sample_neighbors(0, [1], -1,
+                                               need_weight=True)
+        assert sorted(nb_all.tolist()) == [10, 11, 12]
+        assert len(w) == 3
+        nodes = t.sample_nodes(0, 2)
+        assert set(nodes.tolist()) <= {1, 2}
+        assert sorted(t.sample_nodes(0, -1).tolist()) == [1, 2]
+        # mixed weighted/unweighted adds stay aligned (default weight 1.0)
+        t.add_edges(0, [1], [13])                      # unweighted append
+        nb_m, ct_m, w_m = t.sample_neighbors(0, [1], -1, need_weight=True)
+        assert len(nb_m) == len(w_m) == 4
+        assert w_m[nb_m.tolist().index(13)] == 1.0
+        np.testing.assert_array_equal(t.pull_graph_list(0, 0, 10), [1, 2])
+        t.set_node_feat(0, [1, 2], "h", np.eye(2, dtype=np.float32))
+        feats = t.get_node_feat(0, [2, 1, 7], "h")
+        np.testing.assert_array_equal(feats[0], [0, 1])
+        np.testing.assert_array_equal(feats[1], [1, 0])
+        assert feats[2] is None
+
+    def test_graph_table_over_rpc_and_geometric_bridge(self):
+        """Remote GNN sampling: the graph lives on the PS server, workers
+        sample through PsClient; geometric.sample_neighbors_remote keeps
+        the local sample_neighbors return contract."""
+        server = ps.PsServer("ps_graph", rank=0, world_size=1)
+        try:
+            client = ps.PsClient("ps_graph")
+            client.create_graph_table(7, seed=3)
+            client.add_graph_edges(7, 0, [0, 0, 0, 1], [5, 6, 7, 8])
+            nb, ct = client.sample_neighbors(7, 0, [0, 1], 2)
+            assert list(ct) == [2, 1]
+            assert set(np.asarray(nb)[:2].tolist()) <= {5, 6, 7}
+            client.set_node_feat(7, 0, [0], "emb",
+                                 np.ones((1, 4), np.float32))
+            got = client.get_node_feat(7, 0, [0], "emb")
+            np.testing.assert_array_equal(got[0], np.ones(4))
+            assert list(client.pull_graph_list(7, 0, 0, 10)) == [0, 1]
+
+            import paddle_tpu.geometric as geo
+            import paddle_tpu as paddle
+            nbrs, counts = geo.sample_neighbors_remote(
+                client, 7, paddle.to_tensor(np.asarray([0, 1])),
+                sample_size=-1)
+            assert np.asarray(counts._value).tolist() == [3, 1]
+            assert sorted(np.asarray(nbrs._value).tolist()) == [5, 6, 7, 8]
+
+            # persistence round-trip includes the graph table
+            import tempfile
+            with tempfile.TemporaryDirectory() as d:
+                saved = client.save_persistables(d)
+                assert ("graph", 7) in [tuple(s) for s in saved]
+                client.add_graph_edges(7, 0, [2], [9])  # post-save edit
+                loaded = client.load_persistables(d)
+                assert ("graph", 7) in [tuple(s) for s in loaded]
+                assert list(client.pull_graph_list(7, 0, 0, 10)) == [0, 1]
+        finally:
+            server.stop()
