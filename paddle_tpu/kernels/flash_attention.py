@@ -92,6 +92,64 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, seq_len,
     lse_ref[0, 0] = m + jnp.log(l_safe)
 
 
+def _fwd_kernel_grouped(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk,
+                        seq_len, causal, scale):
+    """GQA-grouped forward: one program owns the WHOLE query-head group
+    of one (batch, kv_head) — G·BQ query rows against a single pass over
+    that kv head's K/V. Short sequences are grid-overhead-bound on one
+    TensorCore (B·H·S/BQ tiny programs); folding the group into the M
+    dim gives each program G× the MXU work for the same K/V traffic."""
+    qblk = pl.program_id(1)
+    q = q_ref[0]                                    # [G, BQ, D]
+    g, _, d = q.shape
+    rows = g * bq
+    q2 = q.reshape(rows, d)
+
+    m0 = jnp.full((rows,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((rows,), jnp.float32)
+    acc0 = jnp.zeros((rows, d), jnp.float32)
+
+    n_kblocks = seq_len // bk
+    if causal:
+        upper = (qblk + 1) * bq + bk - 1
+        n_loop = jnp.minimum(upper // bk, n_kblocks)
+    else:
+        n_loop = n_kblocks
+
+    # row r of q2 is query position qblk*bq + (r % bq). (A two-loop
+    # masked/unmasked split was measured here and REVERTED: duplicating
+    # the loop body doubles the scoped-VMEM stack past the 16M limit at
+    # these tile sizes.)
+    q_ids = qblk * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, bk), 0) % bq
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * bk, bk), :]                       # [BK, D]
+        v = v_ref[0, pl.ds(j * bk, bk), :]
+        s = jax.lax.dot_general(q2, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=jax.lax.Precision.DEFAULT) * scale
+        if causal:
+            k_ids = j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (rows, bk), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_loop, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).reshape(g, bq, d).astype(
+        o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe)).reshape(g, bq)
+
+
 def _choose_blocks(seq_len, head_dim, dtype):
     """Pick (bq, bk, stream). ``stream=True`` switches the kernels to
     double-buffered BK-sized HBM→VMEM DMA for the full-sequence operands
@@ -234,6 +292,45 @@ def _flash_fwd_impl(q, k, v, causal, interpret=False, with_lse=False):
             ],
             interpret=interpret,
         )(qf, kf, vf)
+    elif G > 1 and S <= 8192:
+        # GQA-grouped launch: grid (B*Hkv, S/BQ); q carries the whole
+        # query-head group so the per-program MXU work is G× bigger for
+        # the same K/V read (short-seq grids are per-program-overhead
+        # bound on a single TensorCore). bq halves until the grouped
+        # resident set fits scoped VMEM — formula calibrated on v5e
+        # (G=4, bq=bk=512 fits at S=2k..4k; G=7 needs bq<=256).
+        bqg = bq
+        esz = jnp.dtype(q.dtype).itemsize
+        while bqg > 128 and (G * bqg * bk * 8          # s+p f32 tiles
+                             + G * bqg * D * (esz + 4)  # q block + f32 acc
+                             + 2 * S * D * esz          # K/V seq blocks
+                             ) > 16 * 2 ** 20:
+            bqg //= 2
+        qg = qf.reshape(B * Hkv, G, S, D)
+        kernel = functools.partial(_fwd_kernel_grouped, bq=bqg, bk=bk,
+                                   seq_len=S, causal=causal, scale=scale)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(B * Hkv, S // bqg),
+            in_specs=[
+                pl.BlockSpec((1, G, bqg, D),
+                             lambda bh, qi: (bh, 0, qi, 0)),
+                pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+                pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, G, bqg, D),
+                             lambda bh, qi: (bh, 0, qi, 0)),
+                pl.BlockSpec((1, G, bqg), lambda bh, qi: (bh, 0, qi)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * Hkv, G, S, D), q.dtype),
+                jax.ShapeDtypeStruct((B * Hkv, G, S), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qg, kf, vf)
+        out = out.reshape(B * H, S, D)
+        lse = lse.reshape(B * H, 1, S)
     else:
         kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_len=S,
                                    causal=causal, scale=scale)
