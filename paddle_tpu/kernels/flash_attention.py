@@ -150,6 +150,25 @@ def _fwd_kernel_grouped(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk,
     lse_ref[0] = (m + jnp.log(l_safe)).reshape(g, bq)
 
 
+def _grouped_bq(G, S, D, bq, bk, dtype):
+    """Largest bq whose grouped resident set fits scoped VMEM, or None
+    when no bq >= 128 fits (MQA-scale G: fall back to the ungrouped
+    kernel rather than launch a program Mosaic will reject). Formula
+    calibrated on v5e (G=4 fits at bq=512, G=7 needs 256)."""
+    esz = jnp.dtype(dtype).itemsize
+    budget = 16 * 2 ** 20
+
+    def resident(bqx):
+        return (G * bqx * bk * 8            # s + p f32 tiles
+                + G * bqx * D * (esz + 4)   # q block + f32 acc
+                + 2 * S * D * esz)          # K/V whole-seq blocks
+    while bq >= 128:
+        if resident(bq) <= budget:
+            return bq
+        bq //= 2
+    return None
+
+
 def _choose_blocks(seq_len, head_dim, dtype):
     """Pick (bq, bk, stream). ``stream=True`` switches the kernels to
     double-buffered BK-sized HBM→VMEM DMA for the full-sequence operands
@@ -292,20 +311,15 @@ def _flash_fwd_impl(q, k, v, causal, interpret=False, with_lse=False):
             ],
             interpret=interpret,
         )(qf, kf, vf)
-    elif G > 1 and S <= 8192:
+    elif G > 1 and S <= 8192 and _grouped_bq(G, S, D, bq, bk,
+                                             q.dtype) is not None:
         # GQA-grouped launch: grid (B*Hkv, S/BQ); q carries the whole
         # query-head group so the per-program MXU work is G× bigger for
         # the same K/V read (short-seq grids are per-program-overhead
         # bound on a single TensorCore). bq halves until the grouped
         # resident set fits scoped VMEM — formula calibrated on v5e
         # (G=4, bq=bk=512 fits at S=2k..4k; G=7 needs bq<=256).
-        bqg = bq
-        esz = jnp.dtype(q.dtype).itemsize
-        while bqg > 128 and (G * bqg * bk * 8          # s+p f32 tiles
-                             + G * bqg * D * (esz + 4)  # q block + f32 acc
-                             + 2 * S * D * esz          # K/V seq blocks
-                             ) > 16 * 2 ** 20:
-            bqg //= 2
+        bqg = _grouped_bq(G, S, D, bq, bk, q.dtype)
         qg = qf.reshape(B * Hkv, G, S, D)
         kernel = functools.partial(_fwd_kernel_grouped, bq=bqg, bk=bk,
                                    seq_len=S, causal=causal, scale=scale)
