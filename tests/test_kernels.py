@@ -103,6 +103,27 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), atol=2e-3)
         np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), atol=2e-3)
 
+    def test_grouped_fwd_vmem_gate(self):
+        """The GQA-grouped fwd launch must refuse configs whose resident
+        set can't fit scoped VMEM (MQA-scale G falls back to the
+        ungrouped kernel) and still produce correct output either way."""
+        from paddle_tpu.kernels.flash_attention import (_grouped_bq,
+                                                        _sdpa_reference,
+                                                        flash_attention)
+        # llama G=4 keeps full blocks; qwen G=7 shrinks; MQA G=32 refuses
+        assert _grouped_bq(4, 2048, 128, 512, 512, jnp.bfloat16) == 512
+        assert _grouped_bq(7, 2048, 128, 512, 512, jnp.bfloat16) == 256
+        assert _grouped_bq(32, 2048, 128, 512, 512, jnp.bfloat16) is None
+        # MQA parity through whatever path the gate picks (interpret)
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(1, 64, 8, 16).astype(np.float32) * 0.3)
+        k = jnp.asarray(rng.randn(1, 64, 1, 16).astype(np.float32) * 0.3)
+        v = jnp.asarray(rng.randn(1, 64, 1, 16).astype(np.float32) * 0.3)
+        out = flash_attention(q, k, v, True, True)
+        ref = _sdpa_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3)
+
     def test_gqa_reference_matches_repeat(self):
         # grouped reference == naive repeat-KV reference
         from paddle_tpu.kernels.flash_attention import _sdpa_reference
