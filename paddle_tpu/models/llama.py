@@ -125,6 +125,29 @@ LLAMA_PRESETS = {
                      num_hidden_layers=4, num_attention_heads=8,
                      num_key_value_heads=4, num_experts=4,
                      num_experts_per_tok=2, max_position_embeddings=2048),
+    # BASELINE config 5 full-size anchors (published architectures)
+    "mixtral-8x7b": dict(vocab_size=32000, hidden_size=4096,
+                         intermediate_size=14336, num_hidden_layers=32,
+                         num_attention_heads=32, num_key_value_heads=8,
+                         rope_theta=1000000.0, num_experts=8,
+                         num_experts_per_tok=2,
+                         moe_intermediate_size=14336,
+                         max_position_embeddings=32768),
+    # DeepSeekMoE 16B: 64 routed + 2 shared experts, top-6, narrow
+    # experts (1408 vs dense 10944). The released model keeps layer 0
+    # dense; here every layer is MoE (uniform scanned stack) — the
+    # capacity/parallelism behavior under EP is the anchor, not
+    # checkpoint compatibility.
+    "deepseek-moe-16b": dict(vocab_size=102400, hidden_size=2048,
+                             intermediate_size=10944,
+                             num_hidden_layers=28,
+                             num_attention_heads=16,
+                             num_key_value_heads=16,
+                             rope_theta=10000.0, num_experts=64,
+                             num_experts_per_tok=6,
+                             moe_intermediate_size=1408,
+                             moe_num_shared_experts=2,
+                             max_position_embeddings=4096),
     # BASELINE config 4 anchor: Qwen2 = llama decoder + QKV biases
     "qwen2-7b": dict(vocab_size=152064, hidden_size=3584,
                      intermediate_size=18944, num_hidden_layers=28,

@@ -602,6 +602,47 @@ class TestBaselineConfig4SFT:
             build(False, cap=0.3)(paddle.to_tensor(ids))._value)
         assert not np.allclose(out_tight, out_drop, atol=2e-4)
 
+
+class TestBaselineConfig5MoE:
+    def test_config5_presets_shapes(self):
+        """BASELINE config-5 anchors exist as faithful presets: Mixtral
+        8x7B (8 routed, top-2, wide experts) and DeepSeekMoE-16B (64
+        routed + 2 shared, top-6, narrow experts)."""
+        from paddle_tpu.models.llama import LLAMA_PRESETS, LlamaConfig
+        mx = LlamaConfig(**LLAMA_PRESETS["mixtral-8x7b"])
+        assert (mx.num_experts, mx.num_experts_per_tok,
+                mx.moe_intermediate_size) == (8, 2, 14336)
+        ds = LlamaConfig(**LLAMA_PRESETS["deepseek-moe-16b"])
+        assert (ds.num_experts, ds.num_experts_per_tok,
+                ds.moe_num_shared_experts,
+                ds.moe_intermediate_size) == (64, 6, 2, 1408)
+        # a scaled-down deepseek-shape model trains (same arch knobs)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_loss_fn
+        paddle.seed(0)
+        tiny = LlamaConfig(**{**LLAMA_PRESETS["deepseek-moe-16b"],
+                              "vocab_size": 128, "hidden_size": 64,
+                              "intermediate_size": 172,
+                              "num_hidden_layers": 2,
+                              "num_attention_heads": 4,
+                              "num_key_value_heads": 4,
+                              "num_experts": 8, "num_experts_per_tok": 3,
+                              "moe_intermediate_size": 43,
+                              "max_position_embeddings": 256})
+        m = LlamaForCausalLM(tiny)
+        o = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                   parameters=m.parameters())
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (4, 32), dtype=np.int32))
+        first = None
+        for _ in range(5):
+            loss = llama_loss_fn(m, ids, ids)
+            if first is None:
+                first = float(loss)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        assert float(loss) < first
+
     def test_dropless_trains(self):
         """Dropless gradients flow through the ragged dispatch and the
         step descends."""
