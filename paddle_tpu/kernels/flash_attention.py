@@ -153,10 +153,12 @@ def _fwd_kernel_grouped(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk,
 def _grouped_bq(G, S, D, bq, bk, dtype):
     """Largest bq whose grouped resident set fits scoped VMEM, or None
     when no bq >= 128 fits (MQA-scale G: fall back to the ungrouped
-    kernel rather than launch a program Mosaic will reject). Formula
-    calibrated on v5e (G=4 fits at bq=512, G=7 needs 256)."""
+    kernel rather than launch a program Mosaic will reject). Budget
+    calibrated on v5e, deliberately below the 16M scoped-VMEM limit so
+    the kernel keeps headroom when it runs INSIDE a rematted layer
+    (S=8192 training OOMed scoped vmem at the 16M setting)."""
     esz = jnp.dtype(dtype).itemsize
-    budget = 16 * 2 ** 20
+    budget = 12 * 2 ** 20
 
     def resident(bqx):
         return (G * bqx * bk * 8            # s + p f32 tiles
