@@ -27,6 +27,8 @@ from .sharding import (  # noqa: F401
 from .moe import MoELayer, NaiveGate, GShardGate, SwitchGate  # noqa: F401
 from . import sequence_parallel  # noqa: F401
 from . import utils  # noqa: F401
+from . import fs  # noqa: F401
+from .fs import LocalFS, HDFSClient  # noqa: F401
 
 __all__ = [
     "DistributedStrategy", "CommunicateTopology", "HybridCommunicateGroup",

@@ -1,0 +1,291 @@
+"""Filesystem clients for distributed checkpoints (reference:
+python/paddle/distributed/fleet/utils/fs.py — FS ABC :51, LocalFS :113,
+HDFSClient :447). The PS/elastic checkpoint flows save through this
+interface so a cluster deployment can point them at HDFS/AFS.
+
+TPU-native stance: LocalFS is a complete implementation (it is what the
+single-host and GCS-fuse-mounted paths use); HDFSClient shells out to
+the ``hadoop fs`` CLI exactly like the reference — it requires a hadoop
+binary on the host and raises a clear error when one isn't configured
+(this image carries none, so the command plumbing is covered by unit
+tests over a stub executable)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "ExecuteError",
+           "FSFileExistsError", "FSFileNotExistsError", "FSTimeOut",
+           "FSShellCmdAborted"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FSShellCmdAborted(ExecuteError):
+    pass
+
+
+class FS:
+    """reference fs.py:51 — the abstract surface both clients share."""
+
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        raise NotImplementedError
+
+    def upload_dir(self, local_dir, dest_dir):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """reference fs.py:113 — local filesystem with the FS contract."""
+
+    def ls_dir(self, fs_path):
+        """Returns (dirs, files) directly under fs_path."""
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, name)):
+                dirs.append(name)
+            else:
+                files.append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        assert not os.path.isfile(fs_path), f"{fs_path} is already a file"
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isfile(fs_path):
+            os.remove(fs_path)
+        else:
+            shutil.rmtree(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        if self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        os.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        """Only the directory names under fs_path (reference :378)."""
+        if not self.is_exist(fs_path):
+            return []
+        return [d for d in os.listdir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, d))]
+
+    def upload(self, local_path, fs_path):
+        # local->local: a copy (parity with the remote contract)
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def upload_dir(self, local_dir, dest_dir):
+        shutil.copytree(local_dir, dest_dir)
+
+    def cat(self, fs_path):
+        with open(fs_path, "rb") as f:
+            return f.read().decode("utf-8", "replace")
+
+
+class HDFSClient(FS):
+    """reference fs.py:447 — shells out to ``hadoop fs`` with configs
+    (the reference does exactly this; no libhdfs binding). Each call
+    builds the same command line; a missing hadoop binary raises
+    ExecuteError with the attempted command, so misconfiguration is loud
+    rather than silently local."""
+
+    def __init__(self, hadoop_home=None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        """``time_out`` and ``sleep_inter`` are MILLISECONDS (reference
+        HDFSClient signature); transient command failures retry with
+        ``sleep_inter`` pacing."""
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else "hadoop"
+        self._base = [self._hadoop, "fs"]
+        for k, v in (configs or {}).items():
+            self._base += ["-D", f"{k}={v}"]
+        self._time_out = time_out / 1000.0
+        self._sleep_inter = sleep_inter / 1000.0
+
+    def _exec(self, cmd, capture=True):
+        try:
+            return subprocess.run(cmd, capture_output=capture, text=True,
+                                  timeout=self._time_out)
+        except FileNotFoundError as e:
+            raise ExecuteError(
+                f"hadoop binary not found running {' '.join(cmd)}; set "
+                f"hadoop_home or install the hadoop CLI") from e
+        except subprocess.TimeoutExpired as e:
+            raise FSTimeOut(" ".join(cmd)) from e
+
+    def _probe(self, *args) -> bool:
+        """Commands whose non-zero rc is an ANSWER (-test): no retry."""
+        return self._exec(self._base + list(args)).returncode == 0
+
+    def _run(self, *args, capture=True, retries=3):
+        import time
+        cmd = self._base + list(args)
+        last = None
+        for attempt in range(retries + 1):
+            r = self._exec(cmd, capture=capture)
+            if r.returncode == 0:
+                return r.stdout or ""
+            last = r
+            if attempt < retries:
+                time.sleep(self._sleep_inter)
+        raise ExecuteError(f"{' '.join(cmd)} -> rc={last.returncode}: "
+                           f"{(last.stderr or '').strip()[:400]}")
+
+    def need_upload_download(self):
+        return True
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []           # shared FS contract (LocalFS parity)
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def is_exist(self, fs_path):
+        return self._probe("-test", "-e", fs_path)
+
+    def is_dir(self, fs_path):
+        return self._probe("-test", "-d", fs_path)
+
+    def is_file(self, fs_path):
+        return self._probe("-test", "-f", fs_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        # -f tolerates absence: no extra -test round trip (a hadoop
+        # invocation is a full JVM start)
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def upload_dir(self, local_dir, dest_dir):
+        self._run("-put", local_dir, dest_dir)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        if test_exists and not self.is_exist(fs_src_path):
+            raise FSFileNotExistsError(fs_src_path)
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        self._run("-touchz", fs_path)
+
+    def cat(self, fs_path):
+        return self._run("-cat", fs_path)

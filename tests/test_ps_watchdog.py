@@ -217,3 +217,73 @@ class TestGraphPs:
                 assert list(client.pull_graph_list(7, 0, 0, 10)) == [0, 1]
         finally:
             server.stop()
+
+
+class TestFsClients:
+    def test_local_fs_surface(self, tmp_path):
+        """reference fleet/utils/fs.py LocalFS:113 — the FS contract the
+        PS/elastic checkpoint flows save through."""
+        from paddle_tpu.distributed.fleet.fs import (FSFileExistsError,
+                                                     FSFileNotExistsError,
+                                                     LocalFS)
+        fs = LocalFS()
+        assert fs.need_upload_download() is False
+        d = tmp_path / "ckpt"
+        fs.mkdirs(str(d))
+        assert fs.is_dir(str(d)) and fs.is_exist(str(d))
+        f = d / "a.txt"
+        f.write_text("hello")
+        fs.touch(str(d / "b.txt"))
+        with pytest.raises(FSFileExistsError):
+            fs.touch(str(f), exist_ok=False)
+        dirs, files = fs.ls_dir(str(d))
+        assert sorted(files) == ["a.txt", "b.txt"] and dirs == []
+        fs.mkdirs(str(d / "sub"))
+        assert fs.list_dirs(str(d)) == ["sub"]
+        fs.mv(str(f), str(d / "c.txt"))
+        assert fs.cat(str(d / "c.txt")) == "hello"
+        with pytest.raises(FSFileNotExistsError):
+            fs.mv(str(d / "nope"), str(d / "x"))
+        fs.upload(str(d / "c.txt"), str(tmp_path / "up.txt"))
+        assert fs.is_file(str(tmp_path / "up.txt"))
+        fs.upload_dir(str(d), str(tmp_path / "copy"))
+        assert fs.is_dir(str(tmp_path / "copy" / "sub"))
+        fs.delete(str(d))
+        assert not fs.is_exist(str(d))
+
+    def test_hdfs_client_command_plumbing(self, tmp_path):
+        """HDFSClient builds ``hadoop fs`` commands (reference
+        fs.py:447); verified against a stub hadoop executable that logs
+        its argv and emulates -test/-ls."""
+        import stat
+        from paddle_tpu.distributed.fleet.fs import ExecuteError, HDFSClient
+        home = tmp_path / "hadoop_home"
+        (home / "bin").mkdir(parents=True)
+        log = tmp_path / "argv.log"
+        stub = home / "bin" / "hadoop"
+        stub.write_text(f"""#!/bin/sh
+echo "$@" >> {log}
+case " $@ " in
+  *" -ls "*) echo "drwxr-xr-x - u g 0 2026-01-01 00:00 /data/sub"
+             echo "-rw-r--r-- 1 u g 5 2026-01-01 00:00 /data/a.txt" ;;
+esac
+exit 0
+""")
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+        c = HDFSClient(hadoop_home=str(home),
+                       configs={"fs.default.name": "hdfs://x:9000"})
+        assert c.need_upload_download() is True
+        assert c.is_exist("/data")
+        dirs, files = c.ls_dir("/data")
+        assert dirs == ["sub"] and files == ["a.txt"]
+        c.mkdirs("/data/new")
+        c.upload("local.bin", "/data/local.bin")
+        lines = log.read_text().splitlines()
+        assert any("-D fs.default.name=hdfs://x:9000" in ln
+                   for ln in lines)
+        assert any("-mkdir -p /data/new" in ln for ln in lines)
+        assert any("-put local.bin /data/local.bin" in ln for ln in lines)
+        # missing binary is loud
+        bad = HDFSClient(hadoop_home=str(tmp_path / "nope"))
+        with pytest.raises(ExecuteError, match="hadoop binary not found"):
+            bad.mkdirs("/x")
