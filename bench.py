@@ -11,9 +11,163 @@ backward + AdamW, bf16 compute / fp32 master weights) on one chip.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+# Backend-init probe (VERDICT r4 weak #1): the remote-TPU tunnel is
+# measurably flaky — backend init either raises UNAVAILABLE or hangs
+# outright, so the probe must run in a KILLABLE subprocess with a wall
+# timeout, not in-process. Bounded retry with backoff; on final failure
+# emit ONE structured JSON line the driver can record as an infra-skip
+# and exit 0 (a stack-trace rc=1 reads as a code regression, which this
+# is not).
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in ("1", "true",
+                                                        "yes", "on")
+
+
+_PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", 90))
+_PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 3))
+_PROBE_BACKOFF_S = (0, 45, 90)
+# Wall limit for the whole bench run: the observed hang mode is not just
+# backend INIT — a collective can stall mid-bench after a clean probe.
+# Must stay UNDER the driver's own ~15-min kill or the wall never fires.
+_WALL_TIMEOUT_S = int(os.environ.get("BENCH_WALL_TIMEOUT", 720))
+
+_PRESET_METRICS = {
+    "flash32k": "flash_attention_32k_fwd_bwd_ms",
+    "decode": "decode_tokens_per_sec",
+}
+
+
+def _is_infra_error_text(msg: str) -> bool:
+    """Lenient matcher for PROBE-child stderr, where the only failure
+    diversity is backend init."""
+    needles = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "backend setup",
+               "failed to connect", "Unable to initialize backend",
+               "socket closed", "connection reset")
+    return any(n.lower() in msg.lower() for n in needles)
+
+
+def _is_infra_error(exc: BaseException) -> bool:
+    """Strict matcher for in-process exceptions: anchor on grpc status
+    classes case-sensitively, so a code-caused error whose message
+    merely mentions 'unavailable' doesn't become a silent infra-skip."""
+    msg = str(exc)
+    return ("UNAVAILABLE" in msg or "DEADLINE_EXCEEDED" in msg
+            or "Unable to initialize backend" in msg)
+
+
+def _emit_infra_skip(detail: str) -> None:
+    preset = os.environ.get("BENCH_PRESET", "default")
+    print(json.dumps({
+        "metric": _PRESET_METRICS.get(
+            preset, "llama_pretrain_tokens_per_sec_per_chip"),
+        "error": "backend_unavailable",
+        "detail": detail[:400],
+    }), flush=True)
+
+
+def probe_backend() -> None:
+    """Verify the accelerator backend initializes, from a subprocess.
+
+    Retries only INFRA failures (hang / UNAVAILABLE-class stderr); a
+    non-infra child failure (broken env, import error) propagates as a
+    real nonzero exit. Exits rc=0 with a structured error JSON if the
+    backend stays unreachable after bounded retries.
+    """
+    if _env_flag("BENCH_SKIP_PROBE"):
+        return
+    code = ("import jax; d = jax.devices(); "
+            "print(d[0].platform, len(d))")
+    last = "unknown"
+    for attempt in range(_PROBE_ATTEMPTS):
+        if attempt:
+            time.sleep(_PROBE_BACKOFF_S[min(attempt,
+                                            len(_PROBE_BACKOFF_S) - 1)])
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+                capture_output=True, text=True, timeout=_PROBE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            last = f"backend init hung > {_PROBE_TIMEOUT_S}s"
+            continue
+        if r.returncode == 0:
+            platform = (r.stdout.strip().split() or ["?"])[0]
+            if platform == "cpu" and not _env_flag("BENCH_ALLOW_CPU"):
+                # silent jax fallback to CPU = the tunnel IS down; a
+                # CPU-config number in the metric stream would be bogus
+                last = "jax fell back to cpu (accelerator plugin down)"
+                continue
+            return
+        err = (r.stderr or r.stdout).strip()
+        if err and not _is_infra_error_text(err):
+            sys.stderr.write(err + "\n")           # real breakage: rc!=0
+            sys.exit(r.returncode)
+        last = err.splitlines()[-1] if err else f"rc={r.returncode}"
+    _emit_infra_skip(last)
+    sys.exit(0)
+
+
+def _killpg_quietly(pid: int, sig) -> None:
+    try:
+        os.killpg(pid, sig)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def run_walled() -> None:
+    """Re-exec the bench in a killable child bounded by a wall timeout,
+    so a mid-bench tunnel stall surfaces as an infra-skip JSON (rc=0)
+    instead of the driver's own rc=124 kill. The child runs in its own
+    process group (so the wall kill reaps its whole tree); SIGTERM/
+    SIGINT on the parent are forwarded so a driver kill can't orphan a
+    TPU-holding child."""
+    import signal
+    import threading
+    env = dict(os.environ, BENCH_CHILD="1")
+    child = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                             env=env, start_new_session=True,
+                             stdout=subprocess.PIPE, text=True)
+    # Forward the child's stdout live and remember whether a metric line
+    # already went out: a post-result teardown stall must NOT add a
+    # second, contradictory infra-skip line (one-JSON-line contract).
+    saw_metric = threading.Event()
+
+    def _pump():
+        for line in child.stdout:
+            sys.stdout.write(line)
+            sys.stdout.flush()
+            s = line.strip()
+            if s.startswith("{") and '"metric"' in s:
+                saw_metric.set()
+
+    pump = threading.Thread(target=_pump, daemon=True)
+    pump.start()
+
+    def forward(signum, frame):
+        _killpg_quietly(child.pid, signal.SIGKILL)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, forward)
+    signal.signal(signal.SIGINT, forward)
+    try:
+        rc = child.wait(timeout=_WALL_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        _killpg_quietly(child.pid, signal.SIGKILL)
+        child.wait()
+        pump.join(timeout=10)
+        if not saw_metric.is_set():
+            _emit_infra_skip(
+                f"bench hung > {_WALL_TIMEOUT_S}s wall limit")
+        sys.exit(0)
+    pump.join(timeout=10)
+    sys.exit(rc)
 
 
 def peak_flops_per_chip() -> float:
@@ -71,7 +225,6 @@ def check_bf16_psum_parity():
 def bench_flash_32k():
     """S=32k flash attention fwd+bwd on the real chip (VERDICT r3 #6b —
     the README long-context claim, driver-capturable)."""
-    import os
     import jax
     import jax.numpy as jnp
     b = int(os.environ.get("BENCH_FLASH_BATCH", 1))
@@ -117,7 +270,6 @@ def bench_flash_32k():
 def bench_decode():
     """Serving decode throughput as a JSON metric (VERDICT r3 #6c — was
     prose-only in BASELINE.md)."""
-    import os
     import jax
     import paddle_tpu as paddle
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
@@ -171,7 +323,6 @@ def main():
     from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
                                          llama_loss_fn)
 
-    import os
     paddle.seed(0)
     preset = os.environ.get("BENCH_PRESET", "default")
     if preset == "flash32k":
@@ -288,4 +439,13 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if not _env_flag("BENCH_CHILD") and not _env_flag("BENCH_NO_WALL"):
+        run_walled()
+    probe_backend()
+    try:
+        main()
+    except Exception as e:  # infra-only: real code errors still rc!=0
+        if _is_infra_error(e):
+            _emit_infra_skip(f"{type(e).__name__}: {e}")
+            sys.exit(0)
+        raise

@@ -1,0 +1,229 @@
+"""bench.py tunnel-flake hardening (VERDICT r4 weak #1 / ask #1): the
+backend probe must retry with backoff and, on final failure, emit ONE
+structured infra-skip JSON line and exit 0 — never a stack-trace rc=1.
+Probe logic tested with a monkeypatched subprocess so no backend is
+touched."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+def test_is_infra_error_classifies():
+    # in-process matcher is STRICT (grpc status classes, case-sensitive)
+    assert bench._is_infra_error(
+        RuntimeError("UNAVAILABLE: TPU backend setup/compile error"))
+    assert bench._is_infra_error(RuntimeError("DEADLINE_EXCEEDED: rpc"))
+    assert not bench._is_infra_error(ValueError("bad shape (3, 4)"))
+    assert not bench._is_infra_error(AssertionError("loss did not fall"))
+    assert not bench._is_infra_error(
+        NotImplementedError("feature unavailable on this backend"))
+    # probe-stderr matcher is lenient (failure diversity is init-only)
+    assert bench._is_infra_error_text("failed to connect to all addresses")
+    assert bench._is_infra_error_text("socket closed")
+    assert not bench._is_infra_error_text("ModuleNotFoundError: jax")
+
+
+def test_infra_skip_metric_follows_preset(monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_PRESET", "decode")
+    bench._emit_infra_skip("tunnel down")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "decode_tokens_per_sec"
+    monkeypatch.setenv("BENCH_PRESET", "flash32k")
+    bench._emit_infra_skip("tunnel down")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "flash_attention_32k_fwd_bwd_ms"
+
+
+def test_env_flag_tolerant(monkeypatch):
+    for v, want in [("1", True), ("true", True), ("YES", True),
+                    ("0", False), ("", False), ("false", False)]:
+        monkeypatch.setenv("BENCH_SKIP_PROBE", v)
+        assert bench._env_flag("BENCH_SKIP_PROBE") is want
+    monkeypatch.delenv("BENCH_SKIP_PROBE")
+    assert bench._env_flag("BENCH_SKIP_PROBE") is False
+
+
+def test_probe_skipped_via_env(monkeypatch):
+    monkeypatch.setenv("BENCH_SKIP_PROBE", "1")
+
+    def boom(*a, **k):  # probe must not spawn anything when skipped
+        raise AssertionError("probe ran despite BENCH_SKIP_PROBE")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    bench.probe_backend()
+
+
+def test_probe_success_first_try(monkeypatch, capsys):
+    monkeypatch.delenv("BENCH_SKIP_PROBE", raising=False)
+    monkeypatch.setattr(bench, "_PROBE_BACKOFF_S", (0, 0, 0))
+    calls = []
+
+    def ok(cmd, **k):
+        calls.append(cmd)
+        return subprocess.CompletedProcess(cmd, 0, stdout="tpu 1\n",
+                                           stderr="")
+
+    monkeypatch.setattr(subprocess, "run", ok)
+    bench.probe_backend()
+    assert len(calls) == 1
+    assert capsys.readouterr().out == ""
+
+
+def test_probe_retries_then_infra_skip(monkeypatch, capsys):
+    monkeypatch.delenv("BENCH_SKIP_PROBE", raising=False)
+    monkeypatch.setattr(bench, "_PROBE_ATTEMPTS", 3)
+    monkeypatch.setattr(bench, "_PROBE_BACKOFF_S", (0, 0, 0))
+    attempts = []
+
+    def hang(cmd, timeout=None, **k):
+        attempts.append(timeout)
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(subprocess, "run", hang)
+    with pytest.raises(SystemExit) as ei:
+        bench.probe_backend()
+    assert ei.value.code == 0                      # infra-skip, NOT rc=1
+    assert len(attempts) == 3                      # bounded retry
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["error"] == "backend_unavailable"
+    assert out["metric"] == "llama_pretrain_tokens_per_sec_per_chip"
+    assert "hung" in out["detail"]
+
+
+def test_probe_propagates_non_infra_failure(monkeypatch, capsys):
+    """A broken env (import error) is a real regression: rc!=0, no
+    infra-skip JSON, no retry burn."""
+    monkeypatch.delenv("BENCH_SKIP_PROBE", raising=False)
+    calls = []
+
+    def broken(cmd, **k):
+        calls.append(cmd)
+        return subprocess.CompletedProcess(
+            cmd, 1, stdout="",
+            stderr="ModuleNotFoundError: No module named 'jax'\n")
+
+    monkeypatch.setattr(subprocess, "run", broken)
+    with pytest.raises(SystemExit) as ei:
+        bench.probe_backend()
+    assert ei.value.code == 1
+    assert len(calls) == 1                         # no pointless retries
+    assert capsys.readouterr().out == ""           # no infra-skip JSON
+
+
+@pytest.fixture
+def _restore_signals():
+    """run_walled installs SIGTERM/SIGINT handlers; monkeypatch cannot
+    undo signal.signal, so restore by hand or a later driver SIGTERM to
+    the suite would invoke the leftover forward() handler."""
+    import signal
+    saved = [(s, signal.getsignal(s))
+             for s in (signal.SIGTERM, signal.SIGINT)]
+    yield
+    for s, h in saved:
+        signal.signal(s, h)
+
+
+class _FakeChild:
+    def __init__(self, lines=(), rc=0, hang=False):
+        self.pid = 12345
+        self.stdout = iter(lines)
+        self._rc = rc
+        self._hang = hang
+
+    def wait(self, timeout=None):
+        if self._hang and timeout is not None:
+            raise subprocess.TimeoutExpired("bench", timeout)
+        return self._rc
+
+
+def test_walled_run_times_out_to_infra_skip(monkeypatch, capsys,
+                                            _restore_signals):
+    monkeypatch.setattr(subprocess, "Popen",
+                        lambda *a, **k: _FakeChild(hang=True))
+    killed = []
+    monkeypatch.setattr(os, "killpg", lambda pid, sig: killed.append(pid))
+    monkeypatch.setattr(bench, "_WALL_TIMEOUT_S", 7)
+    with pytest.raises(SystemExit) as ei:
+        bench.run_walled()
+    assert ei.value.code == 0
+    assert killed == [12345]
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["error"] == "backend_unavailable"
+    assert "wall limit" in out["detail"]
+
+
+def test_walled_timeout_after_metric_is_not_double_emitted(
+        monkeypatch, capsys, _restore_signals):
+    """Post-result teardown stall: the metric line already went out, so
+    the wall kill must NOT add a second contradictory JSON line."""
+    metric = json.dumps({"metric": "decode_tokens_per_sec", "value": 1})
+    monkeypatch.setattr(
+        subprocess, "Popen",
+        lambda *a, **k: _FakeChild(lines=[metric + "\n"], hang=True))
+    monkeypatch.setattr(os, "killpg", lambda pid, sig: None)
+    monkeypatch.setattr(bench, "_WALL_TIMEOUT_S", 7)
+    with pytest.raises(SystemExit) as ei:
+        bench.run_walled()
+    assert ei.value.code == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert lines == [metric]                       # exactly one JSON line
+
+
+def test_walled_run_propagates_child_rc(monkeypatch, capsys,
+                                        _restore_signals):
+    monkeypatch.setattr(subprocess, "Popen",
+                        lambda *a, **k: _FakeChild(rc=3))
+    with pytest.raises(SystemExit) as ei:
+        bench.run_walled()
+    assert ei.value.code == 3
+    assert capsys.readouterr().out == ""
+
+
+def test_probe_rejects_silent_cpu_fallback(monkeypatch, capsys):
+    monkeypatch.delenv("BENCH_SKIP_PROBE", raising=False)
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    monkeypatch.setattr(bench, "_PROBE_ATTEMPTS", 2)
+    monkeypatch.setattr(bench, "_PROBE_BACKOFF_S", (0, 0))
+
+    def cpu_fallback(cmd, **k):
+        return subprocess.CompletedProcess(cmd, 0, stdout="cpu 8\n",
+                                           stderr="")
+
+    monkeypatch.setattr(subprocess, "run", cpu_fallback)
+    with pytest.raises(SystemExit) as ei:
+        bench.probe_backend()
+    assert ei.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["error"] == "backend_unavailable"
+    assert "cpu" in out["detail"]
+    # explicit opt-in keeps the CPU smoke path usable
+    monkeypatch.setenv("BENCH_ALLOW_CPU", "1")
+    bench.probe_backend()                          # must not exit
+
+
+def test_probe_recovers_on_second_attempt(monkeypatch, capsys):
+    monkeypatch.delenv("BENCH_SKIP_PROBE", raising=False)
+    monkeypatch.setattr(bench, "_PROBE_BACKOFF_S", (0, 0, 0))
+    state = {"n": 0}
+
+    def flaky(cmd, timeout=None, **k):
+        state["n"] += 1
+        if state["n"] == 1:
+            return subprocess.CompletedProcess(
+                cmd, 1, stdout="",
+                stderr="jax.errors.JaxRuntimeError: UNAVAILABLE: boom\n")
+        return subprocess.CompletedProcess(cmd, 0, stdout="tpu 1\n",
+                                           stderr="")
+
+    monkeypatch.setattr(subprocess, "run", flaky)
+    bench.probe_backend()                          # must not exit
+    assert state["n"] == 2
+    assert capsys.readouterr().out == ""
