@@ -111,10 +111,21 @@ def apply_op(name: str, fn: Callable, args: tuple, kwargs: dict,
         else:
             raw = [a._value if isinstance(a, Tensor) else a for a in args]
             fn_sig = ("reg",) if is_reg else ("key", lazy_key)
-            out = eng.record(name, fn, tuple(raw), kwargs, fn_sig)
-            outs = out if isinstance(out, tuple) else (out,)
-            wrapped = tuple(Tensor(o, stop_gradient=True) for o in outs)
-            return wrapped if len(wrapped) > 1 else wrapped[0]
+            try:
+                out = eng.record(name, fn, tuple(raw), kwargs, fn_sig)
+            except _lazy.UncapturableArg:
+                # no stable signature for a static arg: flush and fall
+                # through to eager (same rule as unidentified closures)
+                eng.flush()
+                for i in tensor_idx:
+                    v = args[i]._value
+                    if isinstance(v, _lazy.LazyValue):
+                        args[i]._value = v.force()
+            else:
+                outs = out if isinstance(out, tuple) else (out,)
+                wrapped = tuple(Tensor(o, stop_gradient=True)
+                                for o in outs)
+                return wrapped if len(wrapped) > 1 else wrapped[0]
 
     arrays = [a._value if isinstance(a, Tensor) else a for a in args]
 

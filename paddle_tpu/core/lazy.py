@@ -154,12 +154,24 @@ class _Node:
         self.out_refs: list = []        # weakrefs to produced LazyValues
 
 
+class UncapturableArg(Exception):
+    """A static op argument has no stable signature — the caller must
+    flush and fall through to eager dispatch."""
+
+
 def _static_repr(v) -> str:
-    """Hashable signature for a non-array op argument."""
+    """Hashable signature for a non-array op argument.
+
+    Refuses (raises UncapturableArg) when repr fails: keying on id()
+    would let CPython id reuse after GC alias two distinct objects to
+    one cached compiled segment and replay a wrong closed-over value
+    (ADVICE r4 #4) — same rule as unidentified closures. Safe to raise:
+    record() builds signatures before mutating any engine state."""
     try:
         return repr(v)
     except Exception:
-        return f"<{type(v).__name__}@{id(v)}>"
+        raise UncapturableArg(
+            f"un-repr-able static arg of type {type(v).__name__}")
 
 
 class SegmentEngine:

@@ -273,9 +273,19 @@ class HDFSClient(FS):
         self._run("-mv", fs_src_path, fs_dst_path)
 
     def mv(self, fs_src_path, fs_dst_path, overwrite=False,
-           test_exists=False):
-        if test_exists and not self.is_exist(fs_src_path):
-            raise FSFileNotExistsError(fs_src_path)
+           test_exists=True):
+        """Reference HDFSClient.mv defaults test_exists=True (ADVICE r4
+        #3); with checks on and no overwrite the destination is
+        pre-checked so mv onto an existing dst raises FSFileExistsError
+        instead of retrying the non-transient `hadoop fs -mv` failure
+        into an ExecuteError. ``test_exists=False`` opts out of ALL
+        existence round-trips (reference behavior — each is a JVM
+        start)."""
+        if test_exists:
+            if not self.is_exist(fs_src_path):
+                raise FSFileNotExistsError(fs_src_path)
+            if not overwrite and self.is_exist(fs_dst_path):
+                raise FSFileExistsError(fs_dst_path)
         if overwrite and self.is_exist(fs_dst_path):
             self.delete(fs_dst_path)
         self._run("-mv", fs_src_path, fs_dst_path)

@@ -32,13 +32,22 @@ def _free_port() -> int:
 
 
 def launch(script: str, script_args=(), nproc_per_node: int = 1,
-           master: str | None = None, log_dir: str = "log",
+           master: str | None = None, log_dir: str | None = None,
            job_id: str = "default", envs: dict | None = None,
            python: str | None = None, tail: bool = True) -> int:
     """Spawn ``nproc_per_node`` workers running ``script``; returns the
     first nonzero exit code (0 if all succeed). Reference
-    controllers/collective.py CollectiveController.build_pod."""
+    controllers/collective.py CollectiveController.build_pod.
+
+    ``log_dir`` defaults to a fresh temp dir (NOT ./log like the
+    reference CLI): programmatic callers — the dryrun, tests — must not
+    dirty the working tree with workerlog files. A defaulted temp dir is
+    removed after a clean run and kept for debugging on failure."""
     master = master or f"127.0.0.1:{_free_port()}"
+    tmp_logs = log_dir is None
+    if tmp_logs:
+        import tempfile
+        log_dir = tempfile.mkdtemp(prefix="paddle_launch_log_")
     os.makedirs(log_dir, exist_ok=True)
     endpoints = ",".join(f"127.0.0.1:{_free_port()}"
                          for _ in range(nproc_per_node))
@@ -99,6 +108,9 @@ def launch(script: str, script_args=(), nproc_per_node: int = 1,
                 p.kill()
         for f in logs:
             f.close()
+        if tmp_logs and rc == 0:
+            import shutil
+            shutil.rmtree(log_dir, ignore_errors=True)
     return rc
 
 
@@ -111,7 +123,8 @@ def main(argv=None):
                         default=1)
     parser.add_argument("--master", default=None,
                         help="coordinator host:port (default: local free port)")
-    parser.add_argument("--log_dir", default="log")
+    parser.add_argument("--log_dir", default=None,
+                        help="worker log dir (default: a temp dir)")
     parser.add_argument("--job_id", default="default")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)

@@ -36,6 +36,8 @@ class P2PTransport:
         # sender that is hoarding, never stall another connection's
         # reader behind someone else's backlog
         self._inbox_bytes: dict[int, int] = {}
+        # expired (src, seq) tombstones, insertion-ordered for bounding
+        self._dropped: dict[tuple[int, int], bool] = {}
         self._cv = threading.Condition()
         self._conns: dict[int, socket.socket] = {}
         self._conn_lock = threading.Lock()      # guards the dicts only
@@ -100,16 +102,19 @@ class P2PTransport:
                 cap = float(flags.flag("p2p_inbox_max_mb")) * 2 ** 20
                 with self._cv:
                     if cap:
-                        # bound parked memory per SOURCE: expire stale
-                        # unclaimed messages, then block this reader
-                        # (TCP backpressure to ITS sender) while this
-                        # source's own backlog exceeds the cap
-                        self._expire_locked()
+                        # bound parked memory per SOURCE: block this
+                        # reader (TCP backpressure to ITS sender) while
+                        # this source's own backlog + the incoming
+                        # message exceed the cap; stale entries expire
+                        # ONLY for the source under that pressure, so
+                        # the blocked reader always unwedges after the
+                        # TTL while other sources' parked messages are
+                        # never dropped (ADVICE r4 #2)
                         while self._inbox_bytes.get(src, 0) + nbytes \
                                 > cap and any(
                                     k[0] == src for k in self._inbox):
                             if not self._cv.wait(timeout=1.0):
-                                self._expire_locked()
+                                self._expire_locked(src)
                     self._inbox[(src, seq)] = buf
                     self._inbox_when[(src, seq)] = time.monotonic()
                     self._inbox_bytes[src] = \
@@ -118,20 +123,37 @@ class P2PTransport:
         finally:
             conn.close()
 
-    def _expire_locked(self):
-        """Drop unclaimed inbox entries older than 2x the comm timeout —
-        a (src, seq) nobody recv()s must not leak forever. Caller holds
-        the condition lock."""
+    def _expire_locked(self, src: int):
+        """Drop unclaimed inbox entries from ``src`` older than 2x the
+        comm timeout. Called ONLY from a reader blocked on that source's
+        cap (ADVICE r4 #2): a receiver stalled in a long compile or an
+        imbalanced pipeline step may legitimately recv() old entries
+        later, so expiry never touches a source that isn't actively
+        wedging its reader. Dropped seqs are remembered (bounded) so a
+        later take() fails loudly instead of timing out into a silent
+        seq desync. Caller holds the condition lock."""
         import time
         from .. import flags
         ttl = 2.0 * float(flags.flag("comm_timeout_seconds"))
         now = time.monotonic()
-        for key in [k for k, t in self._inbox_when.items()
-                    if now - t > ttl]:
+        expired = [k for k, t in self._inbox_when.items()
+                   if k[0] == src and now - t > ttl]
+        for key in expired:
             dropped = self._inbox.pop(key, b"")
             self._inbox_bytes[key[0]] = \
                 self._inbox_bytes.get(key[0], 0) - len(dropped)
             self._inbox_when.pop(key, None)
+            self._dropped[key] = True
+            while len(self._dropped) > 1024:       # bounded tombstones
+                self._dropped.pop(next(iter(self._dropped)))
+            from ..utils.log import get_logger
+            get_logger("paddle_tpu.p2p").warning(
+                "p2p inbox dropped unclaimed message src=%d seq=%d "
+                "(%d bytes, > %.0fs old, source over the parking cap); "
+                "a later recv of this seq will raise", key[0], key[1],
+                len(dropped), ttl)
+        if expired:
+            self._cv.notify_all()    # wake take()ers parked on these seqs
 
     @staticmethod
     def _read_exact(conn, n):
@@ -151,7 +173,15 @@ class P2PTransport:
         callers that need bytes semantics must copy."""
         with self._cv:
             ok = self._cv.wait_for(
-                lambda: (src, seq) in self._inbox, timeout)
+                lambda: (src, seq) in self._inbox
+                or (src, seq) in self._dropped, timeout)
+            if (src, seq) in self._dropped:
+                self._dropped.pop((src, seq), None)
+                raise RuntimeError(
+                    f"p2p message from rank {src} seq {seq} was expired "
+                    f"from the inbox under cap pressure before recv — "
+                    f"the seq stream from this source is broken (raise "
+                    f"flag p2p_inbox_max_mb or recv sooner)")
             if not ok:
                 raise TimeoutError(
                     f"p2p socket recv from rank {src} seq {seq} timed "

@@ -46,7 +46,15 @@ class GenerationPredictor:
             if hasattr(model, "config"):
                 model.config.dtype = "bfloat16"
         if int8:
-            from ..models.llama import quantize_weights_int8
+            from ..distributed.fleet.mp_layers import current_mesh
+            from ..models.llama import _pp_degree, quantize_weights_int8
+            if _pp_degree(current_mesh()) > 1:
+                # fail at construction, not after the float weights are
+                # destroyed: pp>1 forces the re-encode generate path,
+                # which has no dequantize step (ADVICE r4 #1)
+                raise RuntimeError(
+                    "int8 weight-only serving requires a pp=1 mesh "
+                    "(the KV-cache generate path)")
             quantize_weights_int8(model)
         model.eval()
 

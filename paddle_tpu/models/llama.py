@@ -680,6 +680,14 @@ class LlamaForCausalLM(nn.Layer):
             raise ValueError(
                 "attention_mask requires the KV-cache generate path "
                 "(use_cache=True, pp=1)")
+        if getattr(self, "_quant_scales", None) and not use_cache:
+            # Only the cached program dequantizes (ADVICE r4 #1): the
+            # re-encode path would consume raw int8 weights scale-less
+            # and emit garbage with no error.
+            raise RuntimeError(
+                "int8 weight-only model requires the KV-cache generate "
+                "path (use_cache=True on a pp=1 mesh); re-quantize on "
+                "the serving mesh or skip quantize_weights_int8")
         with autograd.no_grad():
             if use_cache:
                 am = attention_mask._value \
@@ -696,6 +704,10 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids):
         cfg = self.config
+        if getattr(self, "_quant_scales", None):
+            raise RuntimeError(
+                "int8 weight-only model is serving-only: forward() has "
+                "no dequantize step; use generate() on a pp=1 mesh")
         ids = input_ids._value if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         stacked_params = [self._parameters[n] for n in self._stacked_names()]

@@ -310,3 +310,195 @@ class TestMemoryStatsAndOom:
         import pytest
         with pytest.raises(RuntimeError, match="remedies"):
             D._wrap_oom(fake, m, o)
+
+
+class TestAdvisorRound4:
+    """Regression tests for the round-4 advisor findings (ADVICE.md r4)."""
+
+    def test_int8_model_refuses_scaleless_paths(self):
+        # ADVICE r4 #1: a quantized model on any path without in-program
+        # dequant must raise, not emit garbage
+        import pytest
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             quantize_weights_int8)
+        paddle.seed(0)
+        m = LlamaForCausalLM("debug")
+        quantize_weights_int8(m)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(1, 128, (2, 8)).astype(
+                np.int32))
+        with pytest.raises(RuntimeError, match="serving-only"):
+            m.forward(ids)
+        with pytest.raises(RuntimeError, match="KV-cache generate"):
+            m.generate(ids, max_new_tokens=4, use_cache=False)
+        # the cached path still works
+        out = m.generate(ids, max_new_tokens=4, temperature=0.0)
+        assert out.shape == [2, 12]
+
+    def test_int8_generate_raises_on_pp_mesh(self):
+        import jax
+        import pytest
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed.fleet.mp_layers import sharding_ctx
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             quantize_weights_int8)
+        paddle.seed(0)
+        m = LlamaForCausalLM("debug")
+        quantize_weights_int8(m)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(1, 128, (2, 8)).astype(
+                np.int32))
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("pp",))
+        with sharding_ctx(mesh):
+            with pytest.raises(RuntimeError, match="KV-cache generate"):
+                m.generate(ids, max_new_tokens=4)
+
+    def test_int8_predictor_refuses_pp_mesh_before_quantizing(self):
+        import jax
+        import jax.numpy as jnp
+        import pytest
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed.fleet.mp_layers import sharding_ctx
+        from paddle_tpu.inference.serving import GenerationPredictor
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        paddle.seed(0)
+        m = LlamaForCausalLM("debug")
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("pp",))
+        with sharding_ctx(mesh):
+            with pytest.raises(RuntimeError, match="pp=1"):
+                GenerationPredictor(m, int8=True)
+        # refusal happened BEFORE the float weights were destroyed
+        assert m._parameters["wq"]._value.dtype == jnp.float32
+
+    def test_p2p_expiry_only_under_cap_pressure(self):
+        # ADVICE r4 #2: parked messages outlive the TTL when their
+        # source is under the cap; over-cap stale entries expire loudly
+        # and a later take() of an expired seq raises instead of
+        # desynchronizing the stream
+        import time as _time
+        import pytest
+        from paddle_tpu import flags
+        from paddle_tpu.distributed.p2p_transport import P2PTransport
+
+        class _KV:  # transport only registers its address at init
+            def key_value_set(self, k, v):
+                pass
+
+        t = P2PTransport(rank=0, kv_client=_KV())
+        try:
+            old = {"cap": flags.flag("p2p_inbox_max_mb"),
+                   "to": flags.flag("comm_timeout_seconds")}
+            flags.set_flags({"p2p_inbox_max_mb": 1,
+                             "comm_timeout_seconds": 0.01})
+            stale = _time.monotonic() - 10.0
+            with t._cv:
+                # src 1: stale but NOT wedging its reader — must survive
+                t._inbox[(1, 0)] = b"x" * 64
+                t._inbox_when[(1, 0)] = stale
+                t._inbox_bytes[1] = 64
+                # src 2: its (simulated) reader is blocked on the cap —
+                # expiry is scoped to exactly this source
+                t._inbox[(2, 0)] = b"y" * 512
+                t._inbox_when[(2, 0)] = stale
+                t._inbox_bytes[2] = 512
+                t._expire_locked(2)
+                assert (1, 0) in t._inbox          # other source intact
+                assert (2, 0) not in t._inbox
+                assert (2, 0) in t._dropped
+                assert t._inbox_bytes[2] == 0      # backlog accounting
+            assert bytes(t.take(1, 0, timeout=1.0)) == b"x" * 64
+            with pytest.raises(RuntimeError, match="expired"):
+                t.take(2, 0, timeout=1.0)
+
+            # a take() already parked wakes promptly via the expiry
+            # notify (not after its full timeout): insert + expire under
+            # ONE lock hold so the tombstone notify is the only wake-up
+            import threading
+            err = []
+
+            def waiter():
+                try:
+                    t.take(3, 7, timeout=30.0)
+                except RuntimeError as e:
+                    err.append(e)
+
+            th = threading.Thread(target=waiter)
+            th.start()
+            _time.sleep(0.2)                        # waiter parks
+            with t._cv:
+                t._inbox[(3, 7)] = b"z" * 128
+                t._inbox_when[(3, 7)] = _time.monotonic() - 10.0
+                t._inbox_bytes[3] = 128
+                t._expire_locked(3)
+            th.join(timeout=5.0)
+            assert not th.is_alive() and err        # woke early, loudly
+        finally:
+            flags.set_flags({"p2p_inbox_max_mb": old["cap"],
+                             "comm_timeout_seconds": old["to"]})
+            t.close() if hasattr(t, "close") else t._srv.close()
+
+    def test_hdfs_mv_defaults_and_exists_check(self, tmp_path):
+        # ADVICE r4 #3: mv defaults test_exists=True (reference parity)
+        # and pre-checks the destination in the no-overwrite case
+        import stat
+        import pytest
+        from paddle_tpu.distributed.fleet.fs import (FSFileExistsError,
+                                                     FSFileNotExistsError,
+                                                     HDFSClient)
+        home = tmp_path / "hadoop_home"
+        (home / "bin").mkdir(parents=True)
+        log = tmp_path / "argv.log"
+        stub = home / "bin" / "hadoop"
+        # -test -e <p> succeeds iff <p> is listed in exists.txt
+        stub.write_text(f"""#!/bin/sh
+echo "$@" >> {log}
+prev=""; target=""
+for a in "$@"; do
+  if [ "$prev" = "-e" ]; then target="$a"; fi
+  prev="$a"
+done
+case " $@ " in
+  *" -test -e "*) grep -qx "$target" {tmp_path}/exists.txt && exit 0 || exit 1 ;;
+esac
+exit 0
+""")
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+        (tmp_path / "exists.txt").write_text("/data/src\n/data/dst\n")
+        c = HDFSClient(hadoop_home=str(home))
+        with pytest.raises(FSFileNotExistsError):
+            c.mv("/data/missing", "/data/other")   # default test_exists
+        with pytest.raises(FSFileExistsError):
+            c.mv("/data/src", "/data/dst")         # dst pre-check, no -mv
+        assert not any("-mv" in ln for ln in log.read_text().splitlines())
+        c.mv("/data/src", "/data/fresh")           # happy path runs -mv
+        assert any("-mv /data/src /data/fresh" in ln
+                   for ln in log.read_text().splitlines())
+        c.mv("/data/src", "/data/dst", overwrite=True)  # rm then mv
+        lines = log.read_text().splitlines()
+        assert any("-rm -r -f /data/dst" in ln for ln in lines)
+        # test_exists=False opts out of ALL existence round-trips
+        n_tests = sum("-test" in ln for ln in lines)
+        c.mv("/data/whatever", "/data/other", test_exists=False)
+        lines = log.read_text().splitlines()
+        assert sum("-test" in ln for ln in lines) == n_tests
+        assert any("-mv /data/whatever /data/other" in ln for ln in lines)
+
+    def test_lazy_refuses_unreprable_static_args(self):
+        # ADVICE r4 #4: no id()-keyed cache entries — record() refuses,
+        # dispatch flushes to eager, results stay correct
+        import pytest
+        from paddle_tpu.core.lazy import SegmentEngine, UncapturableArg
+
+        class NoRepr:
+            def __repr__(self):
+                raise TypeError("not representable")
+
+        eng = SegmentEngine()
+        with pytest.raises(UncapturableArg):
+            eng.record("fake_op", lambda x, s: x, (np.ones((2,)),
+                                                   NoRepr()), {})
+        assert eng.recorded_ops == 0 and not eng._nodes  # state unmutated
+        with pytest.raises(UncapturableArg):
+            eng.record("fake_op", lambda x, **kw: x, (np.ones((2,)),),
+                       {"cfg": NoRepr()})
+        assert eng.recorded_ops == 0 and not eng._nodes
