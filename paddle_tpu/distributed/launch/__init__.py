@@ -108,9 +108,14 @@ def launch(script: str, script_args=(), nproc_per_node: int = 1,
                 p.kill()
         for f in logs:
             f.close()
-        if tmp_logs and rc == 0:
+        clean = rc == 0 and sys.exc_info()[0] is None
+        if tmp_logs and clean:
             import shutil
             shutil.rmtree(log_dir, ignore_errors=True)
+        elif tmp_logs:
+            # failure/interrupt: keep the logs AND say where they are
+            sys.stderr.write(
+                f"paddle_tpu.launch: worker logs kept at {log_dir}\n")
     return rc
 
 

@@ -295,9 +295,63 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
                    paddings=tuple(pd), dilations=tuple(dl))
 
 
+@defop("fold")
+def _fold(x, output_sizes, kernel_sizes, strides, paddings, dilations):
+    """Inverse of unfold: scatter-add each column's patch back to its
+    image location (reference nn/functional/common.py fold; overlapping
+    windows SUM, matching the im2col^T convention). TPU-shaped as one
+    dense one-hot contraction: cols [N, C, kh*kw, L] against a
+    precomputed (kh*kw*L -> padded HW) assignment matrix — a single MXU
+    matmul instead of L serial scatters."""
+    N = x.shape[0]
+    kh, kw = kernel_sizes
+    oh, ow = output_sizes
+    C = x.shape[1] // (kh * kw)
+    ph = oh + paddings[0] + paddings[1]
+    pw = ow + paddings[2] + paddings[3]
+    sh, sw = strides
+    dh, dw = dilations
+    lh = (ph - (dh * (kh - 1) + 1)) // sh + 1
+    lw = (pw - (dw * (kw - 1) + 1)) // sw + 1
+    L = lh * lw
+    if x.shape[2] != L:
+        raise ValueError(
+            f"fold: x has {x.shape[2]} columns but output_sizes/"
+            f"kernel/stride/padding/dilation imply {L}")
+    # flat padded-image index of element (ki, kj) of patch (li, lj);
+    # scatter-add is O(kh*kw*L) memory — a dense one-hot contraction
+    # would be O(kh*kw*L * ph*pw), gigabytes at realistic image sizes
+    li, lj = jnp.meshgrid(jnp.arange(lh), jnp.arange(lw), indexing="ij")
+    ki, kj = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")
+    rows = li.reshape(-1)[None, :] * sh \
+        + ki.reshape(-1)[:, None] * dh          # [kh*kw, L]
+    cols_idx = lj.reshape(-1)[None, :] * sw + kj.reshape(-1)[:, None] * dw
+    flat = (rows * pw + cols_idx).reshape(-1)   # [kh*kw*L] in [0, ph*pw)
+    cols = x.reshape(N, C, kh * kw * L)
+    out = jnp.zeros((N, C, ph * pw), x.dtype).at[:, :, flat].add(cols)
+    out = out.reshape(N, C, ph, pw)
+    return out[:, :, paddings[0]:ph - paddings[1],
+               paddings[2]:pw - paddings[3]]
+
+
 def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
          name=None):
-    raise NotImplementedError("fold: planned (inverse of unfold)")
+    """Combine sliding-window columns [N, C*kh*kw, L] into an image
+    [N, C, H, W]; the inverse of :func:`unfold` with overlaps summed
+    (reference python/paddle/nn/functional/common.py fold)."""
+    def _norm(v, n=2):
+        return [v] * n if isinstance(v, int) else list(v)
+    out = _norm(output_sizes)
+    ks = _norm(kernel_sizes)
+    st = _norm(strides)
+    dl = _norm(dilations)
+    pd = _norm(paddings, 4) if not isinstance(paddings, int) \
+        else [paddings] * 4
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+    return _fold(_t(x), output_sizes=tuple(out), kernel_sizes=tuple(ks),
+                 strides=tuple(st), paddings=tuple(pd),
+                 dilations=tuple(dl))
 
 
 @defop("affine_grid")

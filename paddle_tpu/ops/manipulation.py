@@ -370,35 +370,67 @@ def take_along_axis(arr, indices, axis, broadcast=True, name=None):
 
 
 @defop("put_along_axis")
-def _put_along_axis(x, index, value, axis, reduce="assign"):
+def _put_along_axis(x, index, value, axis, reduce="assign",
+                    include_self=True):
+    """Scatter ``value`` at ``index`` along ``axis`` with a reduction
+    (reference tensor/manipulation.py put_along_axis; phi
+    put_along_axis kernel reduce modes assign/add/mul/amin/amax).
+    ``include_self=False`` seeds every touched position with the
+    reduction identity so only the scattered values participate."""
     index = index.astype(jnp.int32)
     value = jnp.broadcast_to(jnp.asarray(value, x.dtype), index.shape)
     if reduce in ("assign", None):
         return jnp.put_along_axis(x, index, value, axis=axis, inplace=False)
-    # scatter-add/mul via full advanced-index grids along every dim
+    # scatter via full advanced-index grids along every dim
     axis = axis % x.ndim
     grids = []
     for d in range(x.ndim):
         if d == axis:
             grids.append(index)
         else:
-            shape = tuple(index.shape[i] if i != d else x.shape[d]
-                          for i in range(x.ndim))
             g = jnp.arange(index.shape[d]).reshape(
                 tuple(index.shape[d] if i == d else 1 for i in range(x.ndim)))
             grids.append(jnp.broadcast_to(g, index.shape))
     idx = tuple(grids)
-    if reduce == "add":
-        return x.at[idx].add(value)
-    if reduce in ("mul", "multiply"):
-        return x.at[idx].multiply(value)
-    raise NotImplementedError(f"put_along_axis reduce={reduce}")
+    ops = {"add": (lambda b: b.at[idx].add(value), 0),
+           "mul": (lambda b: b.at[idx].multiply(value), 1),
+           "multiply": (lambda b: b.at[idx].multiply(value), 1),
+           "amin": (lambda b: b.at[idx].min(value),
+                    jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                    else jnp.iinfo(x.dtype).max),
+           "amax": (lambda b: b.at[idx].max(value),
+                    -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                    else jnp.iinfo(x.dtype).min)}
+    if reduce not in ops:
+        raise ValueError(
+            f"put_along_axis: unsupported reduce={reduce!r} (expected "
+            f"assign/add/mul/multiply/amin/amax)")
+    scatter, identity = ops[reduce]
+    base = x
+    if not include_self:
+        touched = jnp.zeros(x.shape, bool).at[idx].set(True)
+        base = jnp.where(touched, jnp.asarray(identity, x.dtype), x)
+    return scatter(base)
 
 
 def put_along_axis(arr, indices, values, axis, reduce="assign",
                    include_self=True, broadcast=True, name=None):
-    return _put_along_axis(_t(arr), _v(indices), _t(values), axis=axis,
-                           reduce=reduce)
+    """``broadcast=True`` (reference infer_broadcast_shape) expands
+    ``indices`` against ``arr`` on every non-axis dim before the
+    scatter; ``broadcast=False`` keeps numpy's partial-window
+    semantics (indices address only the leading region)."""
+    x, idx = _t(arr), _v(indices)
+    if broadcast:
+        ax = axis % x.ndim
+        if idx.ndim != x.ndim:
+            raise ValueError(
+                "`indices` and `arr` must have the same number of "
+                "dimensions!")
+        bshape = tuple(idx.shape[d] if d == ax else x.shape[d]
+                       for d in range(x.ndim))
+        idx = jnp.broadcast_to(idx, bshape)
+    return _put_along_axis(x, idx, _t(values), axis=axis, reduce=reduce,
+                           include_self=bool(include_self))
 
 
 @defop("where")

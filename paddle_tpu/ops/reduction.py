@@ -210,14 +210,38 @@ def trapezoid(y, x=None, dx=None, axis=-1, name=None):
 
 
 @defop("cumulative_trapezoid")
-def _cumulative_trapezoid(y, dx=1.0, axis=-1):
+def _cumulative_trapezoid(y, x=None, dx=1.0, axis=-1):
+    """Cumulative trapezoid rule along ``axis``; with sample points
+    ``x`` the step is the successive difference of x (reference
+    tensor/math.py cumulative_trapezoid → phi CumulativeTrapezoid:
+    x may be 1-D, broadcast against y's axis, or y-shaped)."""
     ya = jnp.moveaxis(y, axis, -1)
-    avg = (ya[..., 1:] + ya[..., :-1]) * 0.5 * dx
+    if x is not None:
+        if x.ndim == 1:
+            if x.shape[0] != ya.shape[-1]:
+                raise ValueError(
+                    f"cumulative_trapezoid: 1-D x has {x.shape[0]} "
+                    f"sample points but y has {ya.shape[-1]} along "
+                    f"axis {axis}")
+            step = jnp.diff(x)
+        else:
+            xa = jnp.moveaxis(x, axis, -1)
+            if xa.shape[-1] != ya.shape[-1]:
+                raise ValueError(
+                    f"cumulative_trapezoid: x has {xa.shape[-1]} sample "
+                    f"points but y has {ya.shape[-1]} along axis {axis}")
+            step = jnp.diff(xa, axis=-1)
+        avg = (ya[..., 1:] + ya[..., :-1]) * 0.5 * step
+    else:
+        avg = (ya[..., 1:] + ya[..., :-1]) * 0.5 * dx
     return jnp.moveaxis(jnp.cumsum(avg, axis=-1), -1, axis)
 
 
 def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
     if x is not None:
-        raise NotImplementedError("cumulative_trapezoid with x tensor")
+        if dx is not None:
+            raise ValueError(
+                "cumulative_trapezoid: pass either x or dx, not both")
+        return _cumulative_trapezoid(_t(y), _t(x), axis=axis)
     return _cumulative_trapezoid(_t(y), dx=1.0 if dx is None else float(dx),
                                  axis=axis)

@@ -1,0 +1,231 @@
+"""Round-5 op-surface gap closures (VERDICT r4 missing #2): the last
+NotImplementedError stubs become real kernels, each checked against a
+torch (CPU) or numpy oracle.
+
+- nn.SpectralNorm layer (module twin of the nn.utils.spectral_norm hook;
+  reference python/paddle/nn/layer/norm.py SpectralNorm)
+- F.fold (inverse unfold; reference nn/functional/common.py fold)
+- put_along_axis reduce modes add/mul/amin/amax (+ include_self=False)
+- adaptive_max_pool{1,2,3}d with non-divisible sizes
+- cumulative_trapezoid(x=...) sample points
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestFold:
+    @pytest.mark.parametrize("ks,st,pd,dl", [
+        ((2, 2), (2, 2), 0, 1),
+        ((3, 3), (1, 1), 1, 1),          # overlapping windows: sums
+        ((3, 2), (2, 1), (1, 2), (1, 1)),
+        ((2, 2), (1, 1), 0, 2),          # dilation
+    ])
+    def test_fold_matches_torch(self, ks, st, pd, dl):
+        x = np.random.RandomState(0).randn(2, 3, 10, 12).astype(np.float32)
+        cols = F.unfold(paddle.to_tensor(x), list(ks), strides=list(st),
+                        paddings=pd if isinstance(pd, int) else list(pd),
+                        dilations=dl if isinstance(dl, int) else list(dl))
+        out = F.fold(cols, output_sizes=[10, 12], kernel_sizes=list(ks),
+                     strides=list(st),
+                     paddings=pd if isinstance(pd, int) else list(pd),
+                     dilations=dl if isinstance(dl, int) else list(dl))
+        tc = torch.nn.functional.unfold(
+            torch.from_numpy(x), ks, dilation=dl,
+            padding=pd if isinstance(pd, int) else tuple(pd), stride=st)
+        tf = torch.nn.functional.fold(
+            tc, (10, 12), ks, dilation=dl,
+            padding=pd if isinstance(pd, int) else tuple(pd), stride=st)
+        np.testing.assert_allclose(_np(out), tf.numpy(), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_fold_grad(self):
+        cols = paddle.to_tensor(
+            np.random.RandomState(1).randn(1, 4, 9).astype(np.float32),
+            stop_gradient=False)
+        out = F.fold(cols, output_sizes=[4, 4], kernel_sizes=[2, 2],
+                     strides=1)
+        out.sum().backward()
+        # fold's adjoint is unfold of ones: every column element maps to
+        # exactly one image position, so the grad is all-ones
+        np.testing.assert_allclose(_np(cols.grad), 1.0)
+
+    def test_fold_column_mismatch_raises(self):
+        cols = paddle.to_tensor(np.zeros((1, 4, 5), np.float32))
+        with pytest.raises(ValueError, match="columns"):
+            F.fold(cols, output_sizes=[4, 4], kernel_sizes=[2, 2],
+                   strides=1)
+
+
+class TestPutAlongAxisReduce:
+    def _oracle(self, reduce, include_self):
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        idx = np.random.RandomState(1).randint(0, 6, (4, 3))
+        val = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+        tred = {"add": "sum", "mul": "prod", "multiply": "prod",
+                "amin": "amin", "amax": "amax"}[reduce]
+        want = torch.from_numpy(x.copy()).scatter_reduce(
+            1, torch.from_numpy(idx), torch.from_numpy(val), tred,
+            include_self=include_self).numpy()
+        got = paddle.put_along_axis(
+            paddle.to_tensor(x), paddle.to_tensor(idx),
+            paddle.to_tensor(val), axis=1, reduce=reduce,
+            include_self=include_self)
+        np.testing.assert_allclose(_np(got), want, rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.parametrize("reduce", ["add", "mul", "amin", "amax"])
+    def test_reduce_include_self(self, reduce):
+        self._oracle(reduce, True)
+
+    @pytest.mark.parametrize("reduce", ["add", "mul", "amin", "amax"])
+    def test_reduce_exclude_self(self, reduce):
+        self._oracle(reduce, False)
+
+    def test_broadcast_indices(self):
+        # reference infer_broadcast_shape: indices broadcast against arr
+        # on non-axis dims ([[0]] writes the whole row 0)
+        x = paddle.to_tensor(np.array([[10., 30., 20.],
+                                       [60., 40., 50.]], np.float32))
+        out = paddle.put_along_axis(x, paddle.to_tensor([[0]]), 99.0,
+                                    axis=0)
+        np.testing.assert_allclose(
+            _np(out), [[99., 99., 99.], [60., 40., 50.]])
+
+    def test_unknown_reduce_raises(self):
+        x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        with pytest.raises(ValueError, match="unsupported reduce"):
+            paddle.put_along_axis(x, paddle.to_tensor([[0]]), 1.0,
+                                  axis=0, reduce="mean")
+
+
+class TestAdaptiveMaxPoolNonDivisible:
+    def test_2d_matches_torch(self):
+        x = np.random.RandomState(0).randn(2, 3, 7, 11).astype(np.float32)
+        for osize in [(3, 5), (2, 4), (5, 3), (7, 11), (1, 1)]:
+            got = F.adaptive_max_pool2d(paddle.to_tensor(x), list(osize))
+            want = torch.nn.functional.adaptive_max_pool2d(
+                torch.from_numpy(x), osize).numpy()
+            np.testing.assert_allclose(_np(got), want, rtol=1e-6)
+
+    def test_1d_and_3d(self):
+        x1 = np.random.RandomState(1).randn(2, 3, 10).astype(np.float32)
+        got = F.adaptive_max_pool1d(paddle.to_tensor(x1), 4)
+        want = torch.nn.functional.adaptive_max_pool1d(
+            torch.from_numpy(x1), 4).numpy()
+        np.testing.assert_allclose(_np(got), want, rtol=1e-6)
+        x3 = np.random.RandomState(2).randn(1, 2, 5, 6, 7).astype(
+            np.float32)
+        got = F.adaptive_max_pool3d(paddle.to_tensor(x3), [2, 4, 3])
+        want = torch.nn.functional.adaptive_max_pool3d(
+            torch.from_numpy(x3), (2, 4, 3)).numpy()
+        np.testing.assert_allclose(_np(got), want, rtol=1e-6)
+
+
+class TestCumulativeTrapezoidX:
+    def test_x_1d(self):
+        y = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        x = np.sort(np.random.RandomState(1).rand(8)).astype(np.float32)
+        got = paddle.cumulative_trapezoid(paddle.to_tensor(y),
+                                          x=paddle.to_tensor(x))
+        want = torch.cumulative_trapezoid(torch.from_numpy(y),
+                                          x=torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(_np(got), want, rtol=1e-5, atol=1e-6)
+
+    def test_x_full_shape_and_axis(self):
+        y = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        x = np.cumsum(np.random.RandomState(1).rand(4, 5), axis=0).astype(
+            np.float32)
+        got = paddle.cumulative_trapezoid(paddle.to_tensor(y),
+                                          x=paddle.to_tensor(x), axis=0)
+        want = torch.cumulative_trapezoid(torch.from_numpy(y),
+                                          x=torch.from_numpy(x),
+                                          dim=0).numpy()
+        np.testing.assert_allclose(_np(got), want, rtol=1e-5, atol=1e-6)
+
+    def test_x_and_dx_conflict(self):
+        y = paddle.to_tensor(np.zeros((3,), np.float32))
+        with pytest.raises(ValueError, match="not both"):
+            paddle.cumulative_trapezoid(y, x=y, dx=0.5)
+
+
+class TestSpectralNormLayer:
+    def test_normalizes_largest_singular_value(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        w = np.random.RandomState(0).randn(6, 4).astype(np.float32) * 3.0
+        sn = nn.SpectralNorm(w.shape, dim=0, power_iters=30)
+        out = sn(paddle.to_tensor(w))
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(
+            np.linalg.svd(_np(out), compute_uv=False)[0], 1.0, rtol=1e-4)
+        np.testing.assert_allclose(_np(out), w / sigma, rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_conv_weight_dim1_and_state_advances(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(1)
+        w = np.random.RandomState(1).randn(4, 8, 3, 3).astype(np.float32)
+        sn = nn.SpectralNorm(w.shape, dim=1, power_iters=1)
+        u0 = _np(sn.weight_u).copy()
+        out1 = sn(paddle.to_tensor(w))
+        u1 = _np(sn.weight_u).copy()
+        assert not np.allclose(u0, u1)          # persistent u advanced
+        assert out1.shape == list(w.shape)
+        # repeated application converges to sigma-normalized weight
+        for _ in range(30):
+            sn(paddle.to_tensor(w))
+        out = sn(paddle.to_tensor(w))
+        mat = np.moveaxis(w, 1, 0).reshape(8, -1)
+        sigma = np.linalg.svd(mat, compute_uv=False)[0]
+        np.testing.assert_allclose(_np(out), w / sigma, rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_gradient_flows_through_sigma(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(2)
+        w = paddle.to_tensor(
+            np.random.RandomState(2).randn(5, 3).astype(np.float32),
+            stop_gradient=False)
+        sn = nn.SpectralNorm([5, 3], dim=0, power_iters=2)
+        # converge u/v first: the tape treats them as constants (same
+        # rule as the reference), which only matches finite differences
+        # at the power-iteration fixed point where dsigma/du = 0
+        from paddle_tpu.core import autograd
+        with autograd.no_grad():
+            for _ in range(60):
+                sn(paddle.to_tensor(_np(w)))
+        sn(w).sum().backward()
+        g = _np(w.grad)
+        assert np.isfinite(g).all() and (g != 0).any()
+        # finite-difference check through the FROZEN u/v (power iteration
+        # uses stop_gradient'd values, so freeze state for the oracle)
+        import copy
+        eps = 1e-3
+        w0 = _np(w).copy()
+
+        def f(arr):
+            sn2 = copy.deepcopy(sn)
+            return float(sn2(paddle.to_tensor(arr)).sum()._value)
+
+        i, j = 2, 1
+        wp, wm = w0.copy(), w0.copy()
+        wp[i, j] += eps
+        wm[i, j] -= eps
+        fd = (f(wp) - f(wm)) / (2 * eps)
+        np.testing.assert_allclose(g[i, j], fd, rtol=5e-2, atol=1e-3)
+
+    def test_shape_mismatch_and_bad_power_iters(self):
+        import paddle_tpu.nn as nn
+        sn = nn.SpectralNorm([4, 4], dim=0, power_iters=1)
+        with pytest.raises(ValueError, match="shape"):
+            sn(paddle.to_tensor(np.zeros((3, 3), np.float32)))
+        with pytest.raises(ValueError, match="power_iters"):
+            nn.SpectralNorm([4, 4], power_iters=0)
