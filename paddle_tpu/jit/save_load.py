@@ -54,6 +54,8 @@ def save(layer, path, input_spec=None, **configs):
     payload["input_meta"] = input_meta
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(payload, f, protocol=4)
+    return payload  # callers (onnx bridge) read metadata without a
+    #                 second full deserialization of the weights
 
 
 class TranslatedLayer:

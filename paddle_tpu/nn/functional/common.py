@@ -282,27 +282,34 @@ def _unfold(x, kernel_sizes, strides, paddings, dilations):
     return patches.reshape(n, ckk, oh * ow)
 
 
-def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+def _patch_args(kernel_sizes, strides, paddings, dilations):
+    """Normalize unfold/fold window args to (ks2, st2, pd4, dl2) tuples;
+    paddings expand int→4, (ph, pw)→(ph, ph, pw, pw)."""
     def _norm(v, n=2):
         return [v] * n if isinstance(v, int) else list(v)
     ks = _norm(kernel_sizes)
     st = _norm(strides)
     dl = _norm(dilations)
-    pd = _norm(paddings, 4) if not isinstance(paddings, int) else [paddings] * 4
+    pd = _norm(paddings, 4) if not isinstance(paddings, int) \
+        else [paddings] * 4
     if len(pd) == 2:
         pd = [pd[0], pd[0], pd[1], pd[1]]
-    return _unfold(_t(x), kernel_sizes=tuple(ks), strides=tuple(st),
-                   paddings=tuple(pd), dilations=tuple(dl))
+    return tuple(ks), tuple(st), tuple(pd), tuple(dl)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks, st, pd, dl = _patch_args(kernel_sizes, strides, paddings, dilations)
+    return _unfold(_t(x), kernel_sizes=ks, strides=st, paddings=pd,
+                   dilations=dl)
 
 
 @defop("fold")
 def _fold(x, output_sizes, kernel_sizes, strides, paddings, dilations):
-    """Inverse of unfold: scatter-add each column's patch back to its
-    image location (reference nn/functional/common.py fold; overlapping
-    windows SUM, matching the im2col^T convention). TPU-shaped as one
-    dense one-hot contraction: cols [N, C, kh*kw, L] against a
-    precomputed (kh*kw*L -> padded HW) assignment matrix — a single MXU
-    matmul instead of L serial scatters."""
+    """Inverse of unfold: scatter-add each column's patch element back
+    to its image location (reference nn/functional/common.py fold;
+    overlapping windows SUM, matching the im2col^T convention). One
+    static-index scatter-add over the flattened padded image — XLA
+    lowers it to a single fused kernel, and memory stays O(kh*kw*L)."""
     N = x.shape[0]
     kh, kw = kernel_sizes
     oh, ow = output_sizes
@@ -339,19 +346,11 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
     """Combine sliding-window columns [N, C*kh*kw, L] into an image
     [N, C, H, W]; the inverse of :func:`unfold` with overlaps summed
     (reference python/paddle/nn/functional/common.py fold)."""
-    def _norm(v, n=2):
-        return [v] * n if isinstance(v, int) else list(v)
-    out = _norm(output_sizes)
-    ks = _norm(kernel_sizes)
-    st = _norm(strides)
-    dl = _norm(dilations)
-    pd = _norm(paddings, 4) if not isinstance(paddings, int) \
-        else [paddings] * 4
-    if len(pd) == 2:
-        pd = [pd[0], pd[0], pd[1], pd[1]]
-    return _fold(_t(x), output_sizes=tuple(out), kernel_sizes=tuple(ks),
-                 strides=tuple(st), paddings=tuple(pd),
-                 dilations=tuple(dl))
+    ks, st, pd, dl = _patch_args(kernel_sizes, strides, paddings, dilations)
+    out = [output_sizes] * 2 if isinstance(output_sizes, int) \
+        else list(output_sizes)
+    return _fold(_t(x), output_sizes=tuple(out), kernel_sizes=ks,
+                 strides=st, paddings=pd, dilations=dl)
 
 
 @defop("affine_grid")

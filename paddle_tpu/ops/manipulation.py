@@ -392,25 +392,31 @@ def _put_along_axis(x, index, value, axis, reduce="assign",
                 tuple(index.shape[d] if i == d else 1 for i in range(x.ndim)))
             grids.append(jnp.broadcast_to(g, index.shape))
     idx = tuple(grids)
-    ops = {"add": (lambda b: b.at[idx].add(value), 0),
-           "mul": (lambda b: b.at[idx].multiply(value), 1),
-           "multiply": (lambda b: b.at[idx].multiply(value), 1),
-           "amin": (lambda b: b.at[idx].min(value),
-                    jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
-                    else jnp.iinfo(x.dtype).max),
-           "amax": (lambda b: b.at[idx].max(value),
-                    -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
-                    else jnp.iinfo(x.dtype).min)}
+    ops = {"add": lambda b: b.at[idx].add(value),
+           "mul": lambda b: b.at[idx].multiply(value),
+           "multiply": lambda b: b.at[idx].multiply(value),
+           "amin": lambda b: b.at[idx].min(value),
+           "amax": lambda b: b.at[idx].max(value)}
     if reduce not in ops:
         raise ValueError(
             f"put_along_axis: unsupported reduce={reduce!r} (expected "
             f"assign/add/mul/multiply/amin/amax)")
-    scatter, identity = ops[reduce]
     base = x
     if not include_self:
+        # identities computed lazily: iinfo is only meaningful for the
+        # amin/amax modes (add/mul must keep working for complex/bool)
+        if reduce == "add":
+            identity = 0
+        elif reduce in ("mul", "multiply"):
+            identity = 1
+        elif jnp.issubdtype(x.dtype, jnp.floating):
+            identity = jnp.inf if reduce == "amin" else -jnp.inf
+        else:
+            info = jnp.iinfo(x.dtype)
+            identity = info.max if reduce == "amin" else info.min
         touched = jnp.zeros(x.shape, bool).at[idx].set(True)
         base = jnp.where(touched, jnp.asarray(identity, x.dtype), x)
-    return scatter(base)
+    return ops[reduce](base)
 
 
 def put_along_axis(arr, indices, values, axis, reduce="assign",

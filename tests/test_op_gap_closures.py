@@ -99,6 +99,22 @@ class TestPutAlongAxisReduce:
         np.testing.assert_allclose(
             _np(out), [[99., 99., 99.], [60., 40., 50.]])
 
+    def test_add_keeps_working_for_complex(self):
+        # identities are computed lazily: iinfo (integer-only) must not
+        # run for dtypes that only use add/mul (bool is rejected by jax
+        # scatter-add and absent from the reference dtype list too)
+        xc = (np.random.RandomState(0).randn(3, 4)
+              + 1j * np.random.RandomState(1).randn(3, 4)).astype(
+                  np.complex64)
+        idx = np.array([[0, 1], [2, 3], [1, 0]])
+        out = paddle.put_along_axis(
+            paddle.to_tensor(xc), paddle.to_tensor(idx),
+            paddle.to_tensor(np.ones((3, 2), np.complex64)), axis=1,
+            reduce="add")
+        want = xc.copy()
+        np.add.at(want, (np.arange(3)[:, None], idx), 1.0)
+        np.testing.assert_allclose(_np(out), want, rtol=1e-6)
+
     def test_unknown_reduce_raises(self):
         x = paddle.to_tensor(np.zeros((2, 3), np.float32))
         with pytest.raises(ValueError, match="unsupported reduce"):
