@@ -50,7 +50,13 @@ class FileKVStore:
     def put(self, key, value, ttl=None):
         payload = {"value": value, "ts": time.time(), "ttl": ttl,
                    "key": key}
-        tmp = self._path(key) + ".tmp"
+        # unique tmp per writer: concurrent put()s of the same key (e.g.
+        # every rank of a pod recording the same checkpoint) must each
+        # complete their own atomic replace, not race on one tmp file.
+        # uuid, not pid: on a shared filesystem two hosts can collide
+        # on pid
+        import uuid
+        tmp = self._path(key) + f".tmp.{uuid.uuid4().hex}"
         with open(tmp, "w") as f:
             json.dump(payload, f)
         os.replace(tmp, self._path(key))

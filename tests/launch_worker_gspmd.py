@@ -91,6 +91,89 @@ def main():
                 for _ in range(3)]
     print("GSPMD_LOSSES_LOCAL", json.dumps(losses_l), flush=True)
 
+    ck = os.environ.get("GSPMD_CKPT_DIR")
+    if ck:
+        _checkpoint_phase(net, opt, step, x, y, ck)
+
+
+def _opt_state_tensors(opt):
+    """Optimizer slots as checkpoint entries via the public
+    state_dict(); returns (tensors, writeback) where writeback() hands
+    the (restored-in-place) wrappers back through set_state_dict."""
+    from paddle_tpu.core.tensor import Tensor
+    sd = opt.state_dict()
+    tensors = {f"__opt__/{k}": v for k, v in sd.items()
+               if isinstance(v, Tensor)}
+
+    def writeback(gstep):
+        full = {k.split("/", 1)[1]: v for k, v in tensors.items()}
+        full["global_step"] = gstep
+        opt.set_state_dict(full)
+
+    return tensors, writeback
+
+
+def _checkpoint_phase(net, opt, step, x, y, ck):
+    """VERDICT r4 #4: orbax save/load ACROSS the multi-controller
+    process boundary. Save (collective), train 2 more steps, reload the
+    snapshot, replay the same 2 steps — losses must match bit-exactly.
+    The snapshot carries params AND optimizer moments + global step."""
+    snap = os.path.join(ck, "snap")
+    state = dict(net.state_dict())
+    opt_ts, _ = _opt_state_tensors(opt)
+    state.update(opt_ts)
+    gstep = opt._global_step
+    dist.save_state_dict(state, snap)
+    post = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+            for _ in range(2)]
+    print("GSPMD_CKPT_POST", json.dumps(post), flush=True)
+
+    targets = dict(net.state_dict())
+    opt_ts2, writeback = _opt_state_tensors(opt)
+    targets.update(opt_ts2)
+    dist.load_state_dict(targets, snap)
+    writeback(gstep)
+    replay = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+              for _ in range(2)]
+    print("GSPMD_CKPT_REPLAY", json.dumps(replay), flush=True)
+
+
+def crosstopo_load():
+    """Cross-topology load (VERDICT r4 #4): a checkpoint written by the
+    2-proc [dp=2, mp=4] run restores into a single-process model on a
+    [dp=1, mp=8] mesh; two further train steps must track the 2-proc
+    run's post-save losses (collective order may differ → fp tolerance
+    checked host-side)."""
+    dist.init_parallel_env()
+    snap = os.path.join(os.environ["GSPMD_LOAD_DIR"], "snap")
+    paddle.seed(11)
+    net = TPNet()
+    opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                 parameters=net.parameters())
+    from paddle_tpu.distributed.fleet.sharding import apply_sharding_specs
+    apply_sharding_specs(net, stage=2, axis="dp", min_size_to_shard=0)
+    mesh = dist.ProcessMesh(shape=[1, 8], dim_names=["dp", "mp"])
+    dist.shard_model_state(net, mesh)
+    step = dist.DistTrainStep(net, opt, loss_fn, mesh, donate=False)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    y = rng.randint(0, 4, (8,))
+    # build the jitted step + optimizer accumulators, then restore the
+    # snapshot over them (3 throwaway steps mirror the saver's history)
+    for _ in range(3):
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+    targets = dict(net.state_dict())
+    opt_ts, writeback = _opt_state_tensors(opt)
+    targets.update(opt_ts)
+    dist.load_state_dict(targets, snap)
+    writeback(3)
+    post = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+            for _ in range(2)]
+    print("GSPMD_CROSSTOPO_POST", json.dumps(post), flush=True)
+
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("GSPMD_LOAD_DIR"):
+        crosstopo_load()
+    else:
+        main()

@@ -463,6 +463,25 @@ class TestShardedCheckpoint:
         dist.load_state_dict(model.state_dict(), path)
         assert np.allclose(_np(model.weight), ref)
 
+    def test_pdparams_suffix_forces_pickle_format(self, tmp_path):
+        """The on-disk format is explicit by suffix (r5): .pdparams is
+        always the host-pickle file, round-tripping even with orbax
+        installed; a missing path raises FileNotFoundError, not a wrong
+        'orbax artifact' diagnosis."""
+        import os
+        import pytest
+        model = nn.Linear(4, 2)
+        ref = _np(model.weight)
+        path = str(tmp_path / "state.pdparams")
+        dist.save_state_dict(model.state_dict(), path)
+        assert os.path.isfile(path)          # a file, not an orbax dir
+        model.weight.set_value(np.zeros_like(ref))
+        dist.load_state_dict(model.state_dict(), path)
+        assert np.allclose(_np(model.weight), ref)
+        with pytest.raises(FileNotFoundError):
+            dist.load_state_dict(model.state_dict(),
+                                 str(tmp_path / "nope"))
+
 
 class TestBaselineConfig4SFT:
     """BASELINE config 4 end to end: Qwen2 SFT under ZeRO-3 (GroupSharded
@@ -1717,3 +1736,94 @@ class TestSpmdPropagationRules:
                         (table, P("mp", None)), (ids, P(None, None)))
         assert np.allclose(np.asarray(out),
                            np.take(np.asarray(table), np.asarray(ids), 0))
+
+
+class TestMultiControllerCheckpoint:
+    """VERDICT r4 #4: checkpoint/resume in the 2-process GSPMD harness —
+    the one topology the v5p north star actually uses."""
+
+    def _run(self, worker, env=None, argv=(), nproc=2, log_dir=None,
+             timeout=420):
+        import os, subprocess, sys
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nproc_per_node", str(nproc)]
+        if log_dir is not None:
+            cmd += ["--log_dir", str(log_dir)]
+        cmd += [worker, *argv]
+        return subprocess.run(cmd, cwd=root, env=dict(os.environ,
+                                                      **(env or {})),
+                              capture_output=True, text=True,
+                              timeout=timeout)
+
+    @staticmethod
+    def _tagged(text, tag):
+        import json
+        for line in text.splitlines():
+            if line.startswith(tag + " "):
+                return json.loads(line[len(tag) + 1:])
+        raise AssertionError(f"no {tag!r} in:\n{text}")
+
+    def test_two_process_orbax_save_load_and_crosstopo(self, tmp_path):
+        """Save is a collective orbax write across 2 processes sharing a
+        [dp=2, mp=4] mesh; reload + replay is bit-exact; the same
+        checkpoint then restores into a single-process [dp=1, mp=8]
+        mesh (cross-topology reshard-on-load) with loss parity."""
+        import os, subprocess, sys
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        worker = os.path.join(root, "tests", "launch_worker_gspmd.py")
+        ck = tmp_path / "ck"
+        logs = tmp_path / "logs"
+        r = self._run(worker, env={"GSPMD_CKPT_DIR": str(ck)},
+                      log_dir=logs)
+        assert r.returncode == 0, r.stdout + r.stderr
+        posts = []
+        for i in range(2):
+            text = (logs / f"workerlog.{i}").read_text()
+            post = self._tagged(text, "GSPMD_CKPT_POST")
+            replay = self._tagged(text, "GSPMD_CKPT_REPLAY")
+            assert post == replay, (post, replay)   # bit-exact replay
+            posts.append(post)
+        assert posts[0] == posts[1]                 # ranks agree
+
+        # cross-topology: [2, 4] checkpoint -> [1, 8] mesh, 1 process
+        r2 = subprocess.run(
+            [sys.executable, worker], cwd=root,
+            env=dict(os.environ, GSPMD_LOCAL_DEVICES="8",
+                     GSPMD_LOAD_DIR=str(ck), PYTHONPATH=root),
+            capture_output=True, text=True, timeout=300)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+        cross = self._tagged(r2.stdout, "GSPMD_CROSSTOPO_POST")
+        np.testing.assert_allclose(cross, posts[0], rtol=1e-4)
+
+    def test_kill_one_rank_relaunch_resumes_with_loss_parity(
+            self, tmp_path):
+        """Rank 1 dies hard (os._exit 101) at step 6; the launcher reaps
+        the pod; a relaunch resumes BOTH ranks from the last advertised
+        orbax snapshot and steps 7-10 match an uninterrupted run
+        bit-exactly."""
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        worker = os.path.join(root, "tests", "autockpt_worker_gspmd.py")
+
+        ref = self._run(worker, argv=(str(tmp_path / "ref"), "-1"),
+                        log_dir=tmp_path / "l1")
+        assert ref.returncode == 0, ref.stdout + ref.stderr
+        ref_losses = dict(self._tagged(
+            (tmp_path / "l1" / "workerlog.0").read_text(), "LOSSES"))
+
+        crash = self._run(worker, argv=(str(tmp_path / "wd"), "6"),
+                          log_dir=tmp_path / "l2")
+        assert crash.returncode == 101, crash.stdout + crash.stderr
+
+        resume = self._run(worker, argv=(str(tmp_path / "wd"), "-1"),
+                           log_dir=tmp_path / "l3")
+        assert resume.returncode == 0, resume.stdout + resume.stderr
+        for i in range(2):
+            text = (tmp_path / "l3" / f"workerlog.{i}").read_text()
+            assert "RESUMED_AT 6" in text, text
+        got = dict(self._tagged(
+            (tmp_path / "l3" / "workerlog.0").read_text(), "LOSSES"))
+        assert set(got) == {7, 8, 9, 10}
+        for s, loss in got.items():
+            assert loss == ref_losses[s], (s, loss, ref_losses[s])
