@@ -209,6 +209,14 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence | None = None,
                 return jnp.ones(t._value.shape, t._value.dtype)
             return g._value if hasattr(g, "_value") else g
 
+    # mixed-mode capture (core/lazy.py): a root still pending in a
+    # segment has no _grad_node yet — force it first so the flush runs
+    # the compiled fwd+vjp and wires the segment GradNode
+    from .lazy import LazyValue as _LV
+    for t in tensors:
+        if isinstance(t._value, _LV):
+            t._value = t._value.force()
+
     roots: list[GradNode] = []
     for t, g in zip(tensors, grad_tensors):
         g = _as_cot(g, t)

@@ -15,6 +15,7 @@ backward codegen.
 from __future__ import annotations
 
 import functools
+import weakref
 from typing import Any, Callable
 
 import jax
@@ -89,10 +90,12 @@ def apply_op(name: str, fn: Callable, args: tuple, kwargs: dict,
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
 
     # Mixed-mode graph capture (core/lazy.py): while a SegmentEngine is
-    # active, grad-free ops accumulate into a compiled segment instead of
-    # executing. Anything the lazy path can't honor (autograd, AMP casts,
-    # program recorders, nan checks, unidentified closures) flushes and
-    # falls through to the normal eager dispatch below.
+    # active, ops — including grad-requiring ones (r5) — accumulate into
+    # a compiled segment instead of executing; trainable segments flush
+    # as a compiled vjp pair with one GradNode covering the segment.
+    # What the lazy path can't honor (AMP casts, program recorders, nan
+    # checks, unidentified closures) flushes and falls through to the
+    # normal eager dispatch below.
     if _lazy._ACTIVE:
         eng = _lazy._ACTIVE[-1]
         from ..amp.auto_cast import _STATE as _amp_state
@@ -100,7 +103,7 @@ def apply_op(name: str, fn: Callable, args: tuple, kwargs: dict,
                       and any(not args[i].stop_gradient
                               for i in tensor_idx))
         is_reg = OP_REGISTRY.get(name) is fn
-        if (wants_grad or _amp_state.enabled or OP_RECORDERS
+        if (_amp_state.enabled or OP_RECORDERS
                 or flags.flag("check_nan_inf")
                 or not (is_reg or lazy_key is not None)):
             eng.flush()
@@ -110,9 +113,13 @@ def apply_op(name: str, fn: Callable, args: tuple, kwargs: dict,
                     args[i]._value = v.force()
         else:
             raw = [a._value if isinstance(a, Tensor) else a for a in args]
+            tensor_args = tuple(a if isinstance(a, Tensor) else None
+                                for a in args)
             fn_sig = ("reg",) if is_reg else ("key", lazy_key)
             try:
-                out = eng.record(name, fn, tuple(raw), kwargs, fn_sig)
+                out = eng.record(name, fn, tuple(raw), kwargs, fn_sig,
+                                 tensor_args=tensor_args,
+                                 wants_grad=wants_grad)
             except _lazy.UncapturableArg:
                 # no stable signature for a static arg: flush and fall
                 # through to eager (same rule as unidentified closures)
@@ -123,8 +130,13 @@ def apply_op(name: str, fn: Callable, args: tuple, kwargs: dict,
                         args[i]._value = v.force()
             else:
                 outs = out if isinstance(out, tuple) else (out,)
-                wrapped = tuple(Tensor(o, stop_gradient=True)
-                                for o in outs)
+                wrapped = []
+                for o in outs:
+                    t = Tensor(o, stop_gradient=not wants_grad)
+                    if isinstance(o, _lazy.LazyValue):
+                        o._tensor_ref = weakref.ref(t)
+                    wrapped.append(t)
+                wrapped = tuple(wrapped)
                 return wrapped if len(wrapped) > 1 else wrapped[0]
 
     arrays = [a._value if isinstance(a, Tensor) else a for a in args]

@@ -24,9 +24,21 @@ cached on the engine, so repeated calls with stable shapes skip tracing
 AND compilation and pay only Python-side op recording (the SOT analogue
 of guard evaluation).
 
-Capture degrades safely rather than breaking semantics: grad-requiring
-ops, AMP autocast, program recorders, and the check_nan_inf flag all
-force a flush and fall back to the normal eager dispatch for that op.
+Training segments (r5, VERDICT r4 #2): grad-requiring ops RECORD too.
+At flush, a segment containing differentiable ops compiles as a
+``jax.vjp`` pair — one executable computing (outputs, flattened vjp
+residuals), and one lazily-jitted backward that reconstructs the vjp
+closure from the residual leaves — and registers ONE GradNode for the
+whole segment: its inputs are the segment's grad-requiring external
+tensors, its outputs are the segment's live outputs, so the eager tape
+stitches straight through the compiled region (the SOT analogue of
+compiling training subgraphs, reference jit/sot opcode_executor).
+Per-arg stop_gradient is honored with explicit ``lax.stop_gradient``
+barriers on internal edges whose consuming Tensor was detached.
+
+Capture still degrades safely rather than breaking semantics: AMP
+autocast, program recorders, and the check_nan_inf flag force a flush
+and fall back to the normal eager dispatch for that op.
 """
 
 from __future__ import annotations
@@ -35,7 +47,17 @@ import weakref
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+
+_INEXACT_CACHE: dict = {}
+
+
+def _is_inexact(dt) -> bool:
+    r = _INEXACT_CACHE.get(dt)
+    if r is None:
+        r = _INEXACT_CACHE[dt] = bool(jnp.issubdtype(dt, jnp.inexact))
+    return r
 
 __all__ = ["LazyValue", "SegmentEngine", "active_engine", "activate",
            "deactivate"]
@@ -70,7 +92,7 @@ class LazyValue:
     flush on any concrete access."""
 
     __slots__ = ("_engine", "_aval", "_node_id", "_slot", "_concrete",
-                 "_aborted", "__weakref__")
+                 "_aborted", "_tensor_ref", "__weakref__")
     _is_lazy_value = True
 
     def __init__(self, engine, aval, node_id, slot):
@@ -80,6 +102,8 @@ class LazyValue:
         self._slot = slot
         self._concrete = None
         self._aborted = False
+        self._tensor_ref = None     # weakref to the wrapping Tensor —
+        #                             flush wires its _grad_node
 
     # -- metadata (no flush) -----------------------------------------------
     @property
@@ -142,9 +166,10 @@ class LazyValue:
 
 class _Node:
     __slots__ = ("name", "fn", "arg_kinds", "kwargs", "n_outs", "out_refs",
-                 "static_sig")
+                 "static_sig", "wants_grad", "ext_tensors", "val_stops")
 
-    def __init__(self, name, fn, arg_kinds, kwargs, n_outs, static_sig):
+    def __init__(self, name, fn, arg_kinds, kwargs, n_outs, static_sig,
+                 wants_grad=False, ext_tensors=(), val_stops=()):
         self.name = name
         self.fn = fn
         self.arg_kinds = arg_kinds      # ("ext", j) | ("val", nid, slot) | ("static", v)
@@ -152,6 +177,9 @@ class _Node:
         self.n_outs = n_outs
         self.static_sig = static_sig
         self.out_refs: list = []        # weakrefs to produced LazyValues
+        self.wants_grad = wants_grad    # outputs carry grad
+        self.ext_tensors = ext_tensors  # Tensor-or-None per ext input
+        self.val_stops = val_stops      # per-arg: internal edge detached
 
 
 class UncapturableArg(Exception):
@@ -194,7 +222,8 @@ class SegmentEngine:
 
     # -- recording ----------------------------------------------------------
     def record(self, name: str, fn: Callable, args: tuple, kwargs: dict,
-               fn_sig: tuple = ("reg",)):
+               fn_sig: tuple = ("reg",), tensor_args=None,
+               wants_grad: bool = False):
         """Append one op to the pending segment; returns LazyValue outputs
         (tuple when the op is multi-output, single LazyValue otherwise).
 
@@ -203,41 +232,71 @@ class SegmentEngine:
         ("key", k) supplied by closure-carrying call sites (getitem's
         index, for example). The cache is only sound if equal
         (name, fn_sig, static args) implies equal computation, which is
-        why dispatch refuses to record unidentified closures."""
+        why dispatch refuses to record unidentified closures.
+
+        ``tensor_args`` (parallel to args: the wrapping Tensor or None)
+        + ``wants_grad`` make the segment trainable: grad-requiring
+        external tensors become the flushed segment's GradNode inputs,
+        and a detached (stop_gradient) Tensor consuming an internal edge
+        becomes an explicit stop_gradient barrier in the replay."""
+        tensor_args = tensor_args or (None,) * len(args)
         arg_kinds = []
         ext_inputs = []          # concrete arrays feeding this node
+        ext_tensors = []         # Tensor-or-None per ext input
+        val_stops = []           # per-arg: True = detached internal edge
         in_avals = []
         sig_parts = []
-        for a in args:
+        for a, t in zip(args, tensor_args):
+            stopped = t is not None and t.stop_gradient
+            dt = getattr(a, "dtype", None)
+            inexact = dt is not None and _is_inexact(dt)
+            diff = bool(wants_grad and t is not None and not stopped
+                        and inexact)   # jax.vjp rejects integer primals
+            if t is not None and t._grad_hooks and not stopped \
+                    and isinstance(a, LazyValue) and a._concrete is None \
+                    and a._engine is self:
+                # a hook on an internal edge cannot fire from inside the
+                # compiled segment backward — refuse this op so dispatch
+                # flushes and the consumer runs eager (hook fires there)
+                raise UncapturableArg(
+                    "grad-hooked tensor consumed inside a segment")
             if isinstance(a, LazyValue) and a._concrete is None \
                     and a._engine is self:
                 arg_kinds.append(("val", a._node_id, a._slot))
+                val_stops.append(stopped)
                 in_avals.append(a._aval)
-                sig_parts.append(("val",))
+                sig_parts.append(("val", stopped))
             elif isinstance(a, LazyValue):
                 c = a.force()
                 arg_kinds.append(("ext", None))
                 ext_inputs.append(c)
+                ext_tensors.append(t if diff else None)
+                val_stops.append(False)
                 in_avals.append(jax.ShapeDtypeStruct(c.shape, c.dtype))
-                sig_parts.append(("ext",))
+                sig_parts.append(("ext", diff))
             elif isinstance(a, (jax.Array, np.ndarray)):
                 arg_kinds.append(("ext", None))
                 ext_inputs.append(a)
+                ext_tensors.append(t if diff else None)
+                val_stops.append(False)
                 in_avals.append(jax.ShapeDtypeStruct(a.shape,
                                                      np.asarray(a).dtype
                                                      if isinstance(a, np.ndarray)
                                                      else a.dtype))
-                sig_parts.append(("ext",))
+                sig_parts.append(("ext", diff))
             else:
                 arg_kinds.append(("static", a))
+                val_stops.append(False)
                 sig_parts.append(("static", _static_repr(a)))
-        static_sig = (name, fn_sig, tuple(sig_parts),
+        static_sig = (name, fn_sig, tuple(sig_parts), wants_grad,
                       tuple(sorted((k, _static_repr(v))
                                    for k, v in kwargs.items())))
 
         out_avals = self._infer(static_sig, fn, arg_kinds, kwargs, in_avals)
         node = _Node(name, fn, tuple(arg_kinds), dict(kwargs),
-                     len(out_avals), static_sig)
+                     len(out_avals), static_sig, wants_grad=wants_grad,
+                     ext_tensors=tuple(ext_tensors),
+                     val_stops=tuple(val_stops))
         node_id = self._node_seq
         self._node_seq += 1
         self._nodes.append((node, node_id, tuple(ext_inputs)))
@@ -320,6 +379,10 @@ class SegmentEngine:
             self._flush_compiled(nodes)
         except Exception:
             self.failures += 1
+            if any(node.wants_grad for node, _, _ in nodes):
+                # op-by-op materialization has no tape: silent wrong
+                # grads are worse than a loud demotion to eager
+                raise
             self._run_eager(nodes)
 
     def _flush_compiled(self, nodes):
@@ -329,17 +392,23 @@ class SegmentEngine:
         # wiring are stable across calls
         pos_of = {node_id: pos for pos, (_, node_id, _) in enumerate(nodes)}
         ext_flat = []
+        ext_tensors = []  # Tensor (diff) or None, parallel to ext_flat
         spec = []        # (fn, resolved_arg_kinds, kwargs, n_outs, pos, live_mask)
         key_parts = []
+        internal_edges = set()   # (producer_pos, slot) consumed in-segment
         for pos, (node, node_id, ext_inputs) in enumerate(nodes):
             it = iter(ext_inputs)
+            ts = iter(node.ext_tensors)
             resolved = []
-            for kind in node.arg_kinds:
+            for kind, stop in zip(node.arg_kinds, node.val_stops):
                 if kind[0] == "ext":
                     resolved.append(("ext", len(ext_flat)))
                     ext_flat.append(next(it))
+                    ext_tensors.append(next(ts))
                 elif kind[0] == "val":
-                    resolved.append(("val", pos_of[kind[1]], kind[2]))
+                    resolved.append(("val", pos_of[kind[1]], kind[2],
+                                     stop))
+                    internal_edges.add((pos_of[kind[1]], kind[2]))
                 else:
                     resolved.append(kind)
             live = tuple(r() is not None for r in node.out_refs)
@@ -348,7 +417,24 @@ class SegmentEngine:
             key_parts.append((node.static_sig,
                               tuple(k if k[0] != "static" else ("static",)
                                     for k in resolved), live))
-        key = (tuple(key_parts),
+        diff_pos = [i for i, t in enumerate(ext_tensors) if t is not None]
+        if diff_pos:
+            # hooks registered AFTER an internal edge was recorded (the
+            # record()-time refusal catches the common ordering) cannot
+            # fire from the compiled backward — demote loudly, never
+            # drop them silently
+            for (pos, s) in internal_edges:
+                node = nodes[pos][0]
+                lv = node.out_refs[s]() if s < len(node.out_refs) else None
+                t = lv._tensor_ref() if (lv is not None
+                                         and lv._tensor_ref is not None) \
+                    else None
+                if node.wants_grad and t is not None and t._grad_hooks:
+                    raise RuntimeError(
+                        "a grad-hooked tensor is an internal edge of a "
+                        "captured training segment; hooks cannot run "
+                        "inside the compiled backward")
+        key = (tuple(key_parts), tuple(diff_pos),
                tuple((tuple(np.shape(e)), str(getattr(e, "dtype",
                                                       np.asarray(e).dtype)))
                      for e in ext_flat))
@@ -365,10 +451,17 @@ class SegmentEngine:
             def replay(ext):
                 env = {}
                 for fn, resolved, kw, n_outs, pos, _live in spec:
-                    call_args = [
-                        ext[k[1]] if k[0] == "ext" else
-                        env[(k[1], k[2])] if k[0] == "val" else k[1]
-                        for k in resolved]
+                    call_args = []
+                    for k in resolved:
+                        if k[0] == "ext":
+                            call_args.append(ext[k[1]])
+                        elif k[0] == "val":
+                            v = env[(k[1], k[2])]
+                            if k[3]:   # consuming Tensor was detached
+                                v = jax.lax.stop_gradient(v)
+                            call_args.append(v)
+                        else:
+                            call_args.append(k[1])
                     out = fn(*call_args, **kw)
                     outs = (tuple(out) if isinstance(out, (tuple, list))
                             else (out,))
@@ -376,19 +469,67 @@ class SegmentEngine:
                         env[(pos, s)] = o
                 return [env[k] for k in out_keys]
 
-            jitted = jax.jit(replay)
+            entry = {"out_keys": out_keys, "diff_pos": tuple(diff_pos)}
+            if diff_pos:
+                # trainable segment: ONE compiled fwd returning (outputs,
+                # flattened vjp residuals). The vjp closure is a pytree
+                # of arrays, so tree_flatten inside jit is legal; its
+                # treedef is static and captured at trace time. Integer
+                # outputs ride has_aux — jax.vjp would demand float0
+                # cotangents for them.
+                nondiff_pos = [i for i in range(len(ext_flat))
+                               if ext_tensors[i] is None]
+                entry["nondiff_pos"] = tuple(nondiff_pos)
+                import jax.numpy as jnp
+                float_mask = []
+                for (pos, s) in out_keys:
+                    lv = nodes[pos][0].out_refs[s]()
+                    float_mask.append(
+                        lv is not None
+                        and jnp.issubdtype(lv._aval.dtype, jnp.inexact))
+                entry["float_mask"] = tuple(float_mask)
+
+                def fwd_res(diff_vals, nondiff_vals):
+                    def run(*diff):
+                        ext = [None] * (len(diff_pos) + len(nondiff_pos))
+                        for i, v in zip(diff_pos, diff):
+                            ext[i] = v
+                        for i, v in zip(nondiff_pos, nondiff_vals):
+                            ext[i] = v
+                        outs = replay(ext)
+                        f = [o for o, m in zip(outs, float_mask) if m]
+                        aux = [o for o, m in zip(outs, float_mask)
+                               if not m]
+                        return f, aux
+                    outs_f, vjp_fn, aux = jax.vjp(run, *diff_vals,
+                                                  has_aux=True)
+                    leaves, treedef = jax.tree_util.tree_flatten(vjp_fn)
+                    entry["treedef"] = treedef
+                    return outs_f, aux, leaves
+
+                entry["fwd"] = jax.jit(fwd_res)
+                entry["fwd_py"] = fwd_res       # uncompiled safety net
+                entry["replay"] = replay        # create_graph replays
+            else:
+                entry["fwd"] = jax.jit(replay)
             self.compile_count += 1
         else:
-            jitted, out_keys = hit
+            entry = hit
+            out_keys = entry["out_keys"]
+            diff_pos = list(entry["diff_pos"])
+
+        if diff_pos:
+            self._execute_diff(nodes, entry, ext_flat, ext_tensors, key)
+            return
 
         try:
-            results = jitted(ext_flat)
+            results = entry["fwd"](ext_flat)
         except Exception:
             self.failures += 1
             self.cache[key] = "eager"
             self._run_eager(nodes)
             return
-        self.cache[key] = (jitted, out_keys)
+        self.cache[key] = entry
         self.executable_calls += 1
         by_key = dict(zip(out_keys, results))
         for pos, (node, _node_id, _) in enumerate(nodes):
@@ -396,3 +537,81 @@ class SegmentEngine:
                 lv = ref()
                 if lv is not None:
                     lv._concrete = by_key[(pos, s)]
+
+    def _execute_diff(self, nodes, entry, ext_flat, ext_tensors, key):
+        """Run a trainable segment: compiled fwd+residuals, then register
+        ONE GradNode covering every live output so the eager tape flows
+        through the compiled region. The backward executable is built
+        lazily from the traced treedef and cached on the entry."""
+        out_keys = entry["out_keys"]
+        diff_pos = list(entry["diff_pos"])
+        nondiff_pos = list(entry["nondiff_pos"])
+        float_mask = entry["float_mask"]
+        diff_vals = [ext_flat[i] for i in diff_pos]
+        nondiff_vals = [ext_flat[i] for i in nondiff_pos]
+        try:
+            outs_f, aux, leaves = entry["fwd"](diff_vals, nondiff_vals)
+        except Exception:
+            # safety net: same math, uncompiled (keeps grads correct —
+            # op-by-op _run_eager would silently drop the tape). Pin the
+            # entry to the python path so later steps don't re-attempt
+            # the failing jit trace every call.
+            self.failures += 1
+            outs_f, aux, leaves = entry["fwd_py"](diff_vals, nondiff_vals)
+            entry["fwd"] = entry["fwd_py"]
+        else:
+            if entry["fwd"] is not entry.get("fwd_py"):
+                self.executable_calls += 1
+        self.cache[key] = entry
+
+        itf, ita = iter(outs_f), iter(aux)
+        outs = [next(itf) if m else next(ita) for m in float_mask]
+        by_key = dict(zip(out_keys, outs))
+        for pos, (node, _node_id, _) in enumerate(nodes):
+            for s, ref in enumerate(node.out_refs):
+                lv = ref()
+                if lv is not None:
+                    lv._concrete = by_key[(pos, s)]
+
+        from . import autograd
+        treedef = entry["treedef"]
+        bwd = entry.get("bwd")
+        if bwd is None:
+            def bwd_fn(leaves_, cts):
+                vjp_fn = jax.tree_util.tree_unflatten(treedef, leaves_)
+                return vjp_fn(list(cts))
+            bwd = entry["bwd"] = jax.jit(bwd_fn)
+
+        def vjp_fn(cots, _leaves=leaves, _bwd=bwd, _fm=float_mask):
+            # GradNode hands one cotangent per output; the compiled vjp
+            # covers only the float outputs (ints rode has_aux)
+            cts = [c for c, m in zip(cots, _fm) if m]
+            return tuple(_bwd(_leaves, cts))
+
+        # create_graph support: a pure forward over the diff primals
+        # (non-diff ext baked in, like eager GradNode closures)
+        replay = entry["replay"]
+
+        def fwd_fn(*diff, _nd=tuple(nondiff_vals)):
+            ext = [None] * (len(diff_pos) + len(nondiff_pos))
+            for i, v in zip(diff_pos, diff):
+                ext[i] = v
+            for i, v in zip(nondiff_pos, _nd):
+                ext[i] = v
+            return tuple(replay(ext))
+
+        out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+        gnode = autograd.GradNode(
+            "mixed_segment", vjp_fn,
+            [ext_tensors[i] for i in diff_pos], out_avals, fwd_fn=fwd_fn)
+        for j, (pos, s) in enumerate(out_keys):
+            node = nodes[pos][0]
+            if not node.wants_grad:
+                continue
+            lv = node.out_refs[s]()
+            t = lv._tensor_ref() if (lv is not None
+                                     and lv._tensor_ref is not None) \
+                else None
+            if t is not None and not t.stop_gradient:
+                t._grad_node = gnode
+                t._out_index = j

@@ -231,6 +231,9 @@ class TestNamespaceShims:
         import paddle_tpu.distributed.io as dio
         assert hasattr(dio, "save_persistables")
 
-    def test_onnx_raises_helpfully(self):
-        with pytest.raises(NotImplementedError):
+    def test_onnx_requires_input_spec_without_p2o(self):
+        # r5: onnx.export is a StableHLO bridge (tests/test_inference.py
+        # TestOnnxBridge covers the artifact); without input_spec it
+        # still fails loudly, not silently
+        with pytest.raises(ValueError, match="input_spec"):
             paddle.onnx.export(None, "/tmp/m")
