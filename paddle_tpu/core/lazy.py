@@ -480,7 +480,6 @@ class SegmentEngine:
                 nondiff_pos = [i for i in range(len(ext_flat))
                                if ext_tensors[i] is None]
                 entry["nondiff_pos"] = tuple(nondiff_pos)
-                import jax.numpy as jnp
                 float_mask = []
                 for (pos, s) in out_keys:
                     lv = nodes[pos][0].out_refs[s]()
