@@ -210,7 +210,12 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatch: int,
             f"buffer is indexed by microbatch")
     v = interleave
 
-    def apply(stage_params, x_mb):
+    def apply(stage_params, x_mb, extras_mb=None):
+        """``extras_mb`` (optional, [n_mb, ...] pytree): per-microbatch
+        side inputs handed to stage_fn alongside the activation — NOT
+        carried between stages (every rank indexes its scheduled
+        microbatch directly). Serving prefill threads the per-row
+        attention key mask through here (r5)."""
         stage = lax.axis_index(axis_name)
         n_ticks = v * n_microbatch + n_stages - 1
 
@@ -226,11 +231,11 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatch: int,
             lambda a: a.reshape((v, a.shape[0] // v) + a.shape[1:]),
             stage_params)
 
-        def chunk_apply(j, x):
+        def chunk_apply(j, x, ex):
             pj = jax.tree_util.tree_map(
                 lambda a: lax.dynamic_index_in_dim(a, j, 0, keepdims=False),
                 chunked)
-            res = stage_fn(pj, x)
+            res = stage_fn(pj, x) if ex is None else stage_fn(pj, x, ex)
             return res if has_aux else (res, jnp.zeros((), jnp.float32))
 
         if remat:
@@ -259,7 +264,9 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatch: int,
             fresh = x_mb[m]  # already pp-varying (m depends on axis_index)
             first_chunk_in = jnp.where(j == 0, fresh, inbuf[m])
             inp = jnp.where(stage == 0, first_chunk_in, state)
-            out, aux_t = chunk_apply(j, inp)
+            ex = None if extras_mb is None else jax.tree_util.tree_map(
+                lambda a: a[m], extras_mb)
+            out, aux_t = chunk_apply(j, inp, ex)
             active = jnp.logical_and(rel >= 0, rel < v * n_microbatch)
             aux_acc = aux_acc + jnp.where(active, aux_t, 0.0)
             # last rank, last chunk emits microbatch m's result
