@@ -29,6 +29,11 @@ from . import sequence_parallel  # noqa: F401
 from . import utils  # noqa: F401
 from . import fs  # noqa: F401
 from .fs import LocalFS, HDFSClient  # noqa: F401
+from . import data_feed  # noqa: F401
+from .data_feed import (  # noqa: F401
+    DataGenerator, InMemoryDataset, MultiSlotDataFeed,
+    MultiSlotDataGenerator, SlotDesc,
+)
 
 __all__ = [
     "DistributedStrategy", "CommunicateTopology", "HybridCommunicateGroup",

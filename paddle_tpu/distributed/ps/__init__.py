@@ -20,7 +20,7 @@ import numpy as np
 
 __all__ = ["MemorySparseTable", "MemoryDenseTable", "GraphTable",
            "PsServer", "PsClient", "GeoCommunicator",
-           "SparseAccessor"]
+           "SparseAccessor", "DownpourTrainer", "CTRTower"]
 
 
 class SparseAccessor:
@@ -329,6 +329,14 @@ def _srv_push_dense(table_id, grad):
     return True
 
 
+def _srv_set_dense(table_id, value):
+    t = _SERVER_TABLES[table_id]
+    if not isinstance(t, MemoryDenseTable):
+        raise TypeError(f"table {table_id} is not a dense table")
+    t.set_value(np.asarray(value))
+    return True
+
+
 def _srv_table_size(table_id):
     return _SERVER_TABLES[table_id].size()
 
@@ -442,6 +450,12 @@ class PsClient:
                                   args=(table_id, np.asarray(grad)))
         return fut.wait() if sync else fut
 
+    def set_dense(self, table_id, value):
+        """Overwrite a dense region exactly (trainer 0 seeding its init
+        values; reference push_dense_param)."""
+        return self._rpc.rpc_sync(self.server, _srv_set_dense,
+                                  args=(table_id, np.asarray(value)))
+
     def table_size(self, table_id):
         return self._rpc.rpc_sync(self.server, _srv_table_size,
                                   args=(table_id,))
@@ -544,3 +558,5 @@ class GeoCommunicator:
         self._local = np.asarray(merged, np.float32).copy()
         self._base = self._local.copy()
         return self._local
+
+from .trainer import CTRTower, DownpourTrainer  # noqa: E402,F401
