@@ -276,16 +276,19 @@ class GenerationPredictor:
         model.eval()
 
     def supports_mask(self) -> bool:
-        """attention_mask rides the KV-cache generate path on pp=1, and
-        the pipeline-prefill re-encode path on pp>1 (r5) — only manual
-        sequence parallelism (sep>1) still lacks a masked path."""
+        """attention_mask support: llama rides the KV-cache path on
+        pp=1 and the pipeline-prefill re-encode path on pp>1; GPT rides
+        the re-encode path with pad-relative position-table lookups
+        (r5). Only manual sequence parallelism (sep>1) and model
+        families whose generate lacks an attention_mask parameter still
+        opt out."""
         try:
             import inspect
             from ..distributed.fleet.mp_layers import current_mesh
             from ..distributed.sep import _axis_size
             if "attention_mask" not in inspect.signature(
                     self.model.generate).parameters:
-                return False               # e.g. the GPT family
+                return False               # family without a masked path
             return _axis_size(current_mesh(), "sep") <= 1
         except Exception:  # noqa: BLE001 — unknown model family
             return False

@@ -48,7 +48,7 @@ def _ln(x, w, b, eps):
     return ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
 
 
-def _gpt_layer(cfg: GPTConfig, lp, x):
+def _gpt_layer(cfg: GPTConfig, lp, x, key_mask=None):
     h, hd = cfg.num_attention_heads, cfg.head_dim
     b, s, d = x.shape
     y = _ln(x, lp["ln1_w"], lp["ln1_b"], cfg.layer_norm_eps)
@@ -56,7 +56,8 @@ def _gpt_layer(cfg: GPTConfig, lp, x):
     q, k, v = jnp.split(qkv.reshape(b, s, 3, h, hd), 3, axis=2)
     q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
     from .llama import _attention
-    attn = _attention(q, k, v, causal=True).reshape(b, s, d)
+    attn = _attention(q, k, v, causal=True,
+                      key_mask=key_mask).reshape(b, s, d)
     x = x + attn @ lp["w_proj"] + lp["b_proj"]
     y = _ln(x, lp["ln2_w"], lp["ln2_b"], cfg.layer_norm_eps)
     hmid = jax.nn.gelu(y @ lp["w_fc"] + lp["b_fc"])
@@ -98,10 +99,22 @@ class GPTForCausalLM(nn.Layer):
         mk("lnf_w", [d], (None,), ones=True)
         mk("lnf_b", [d], (None,), zeros=True)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, attention_mask=None):
+        """``attention_mask`` [b, s] (1 = real token, LEFT-padded rows):
+        unlike RoPE models, GPT's learned positions are ABSOLUTE, so the
+        masked path both excludes pad keys AND shifts each row's
+        position-table lookups pad-relative."""
         cfg = self.config
         ids = input_ids._value if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
+        key_mask = None
+        if attention_mask is not None:
+            key_mask = attention_mask._value \
+                if isinstance(attention_mask, Tensor) \
+                else jnp.asarray(attention_mask)
+            # float 0/1 masks (the HF convention) must still produce
+            # integer position-table indices
+            key_mask = jnp.asarray(key_mask, jnp.int32)
         names = ["w_qkv", "b_qkv", "w_proj", "b_proj", "ln1_w", "ln1_b",
                  "ln2_w", "ln2_b", "w_fc", "b_fc", "w_out", "b_out"]
         params = self._parameters
@@ -110,10 +123,18 @@ class GPTForCausalLM(nn.Layer):
             stacked = dict(zip(names, arrays[:len(names)]))
             wte, wpe, lnf_w, lnf_b = arrays[len(names):]
             b, s = ids.shape
-            x = jnp.take(wte, ids, axis=0) + wpe[None, :s]
+            if key_mask is None:
+                pos_emb = wpe[None, :s]
+            else:
+                pad_len = s - jnp.sum(key_mask, axis=1)
+                positions = jnp.maximum(
+                    jnp.arange(s)[None, :] - pad_len[:, None], 0)
+                pos_emb = jnp.take(wpe, positions, axis=0)
+            x = jnp.take(wte, ids, axis=0) + pos_emb
 
             def layer_fn(carry, lp):
-                return _gpt_layer(cfg, lp, carry), None
+                return _gpt_layer(cfg, lp, carry,
+                                  key_mask=key_mask), None
 
             if cfg.recompute:
                 layer_fn = jax.checkpoint(layer_fn)
@@ -128,11 +149,15 @@ class GPTForCausalLM(nn.Layer):
 
 
 def _gpt_generate_method(self, input_ids, max_new_tokens=32,
-                         temperature=1.0, top_k=0, seed=0):
+                         temperature=1.0, top_k=0, seed=0,
+                         attention_mask=None):
     """Autoregressive sampling (reference PaddleNLP generation_utils);
     reuses llama's re-encode loop — GPT's learned position TABLE bounds
     the total length (checked up front), and the KV-cache fused decode
-    lives on the llama family, whose decoder the serving path targets."""
+    lives on the llama family, whose decoder the serving path targets.
+    ``attention_mask`` (1 = real token, left-padded rows) serves
+    mixed-length prompts in one program: pad keys are excluded and each
+    row's position lookups shift pad-relative (r5)."""
     from ..core import autograd
     from .llama import _generate
     ids = input_ids._value if isinstance(input_ids, Tensor) \
@@ -143,9 +168,12 @@ def _gpt_generate_method(self, input_ids, max_new_tokens=32,
             f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
             f"= {total} exceeds max_position_embeddings "
             f"({self.config.max_position_embeddings})")
+    am = attention_mask._value if isinstance(attention_mask, Tensor) \
+        else attention_mask
     with autograd.no_grad():
         out = _generate(self, ids, int(max_new_tokens), float(temperature),
-                        int(top_k), jax.random.PRNGKey(seed))
+                        int(top_k), jax.random.PRNGKey(seed),
+                        attention_mask=am)
     return Tensor(out, stop_gradient=True)
 
 
