@@ -300,6 +300,16 @@ class TestContinuousBatching:
         m.eval()
         return m
 
+    @staticmethod
+    def _drive(eng, pending, iters=200):
+        """Run the engine loop until every pending request is served."""
+        for _ in range(iters):
+            eng.admit(pending)
+            eng.decode_once()
+            if eng.idle() and not pending:
+                return
+        raise AssertionError("engine did not drain the workload")
+
     def _workload(self, rng):
         # 2 long generations + 6 shorts: batch-at-a-time rides every
         # tick to its max(max_new); the engine retires shorts early and
@@ -321,11 +331,7 @@ class TestContinuousBatching:
         eng = DecodeEngine(m, capacity=4, s_max=96, chunk=4)
         reqs = [_Request(p, mn) for p, mn in zip(prompts, max_news)]
         pending = list(reqs)
-        for _ in range(200):
-            eng.admit(pending)
-            eng.decode_once()
-            if eng.idle() and not pending:
-                break
+        self._drive(eng, pending)
         for req, ref in zip(reqs, refs):
             np.testing.assert_array_equal(req.wait(timeout=1), ref)
 
@@ -363,11 +369,7 @@ class TestContinuousBatching:
         eng = DecodeEngine(m, capacity=4, s_max=96, chunk=4)
         pend = [_Request(p, mn) for p, mn in zip(prompts, max_news)]
         pending = list(pend)
-        for _ in range(200):
-            eng.admit(pending)
-            eng.decode_once()
-            if eng.idle() and not pending:
-                break
+        self._drive(eng, pending)
         for r in pend:
             r.wait(timeout=1)
         assert eng.device_steps < baseline_steps, (
@@ -404,6 +406,34 @@ class TestContinuousBatching:
         finally:
             srv.close()
 
+    def test_engine_on_mp_sharded_mesh(self):
+        """Continuous batching on a tensor-parallel serving mesh: the
+        engine's prefill/decode programs consume mp-sharded weights
+        (GSPMD inserts the collectives) with solo-parity tokens — the
+        multi-chip serving shape an 8B model needs on 16G chips."""
+        import warnings
+
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.inference.serving import DecodeEngine, _Request
+        m = self._model()
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(1, 128, (n,)).astype(np.int32)
+                   for n in (8, 5)]
+        refs = [np.asarray(m.generate(
+            paddle.to_tensor(p[None, :]), max_new_tokens=5,
+            temperature=0.0)._value)[0] for p in prompts]
+        mesh = dist.ProcessMesh(shape=[1, 1, 1, 1, 8],
+                                dim_names=["dp", "pp", "sep", "ep", "mp"])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)  # tiny dims
+            dist.shard_model_state(m, mesh)
+        eng = DecodeEngine(m, capacity=2, s_max=64, chunk=4)
+        reqs = [_Request(p, 5) for p in prompts]
+        pending = list(reqs)
+        self._drive(eng, pending)
+        for req, ref in zip(reqs, refs):
+            np.testing.assert_array_equal(req.wait(timeout=1), ref)
+
     def test_engine_int8_dequantizes_in_program(self):
         """An int8 weight-only model serves through the engine: the
         dequant runs inside the compiled prefill/decode programs and
@@ -420,11 +450,7 @@ class TestContinuousBatching:
         eng = DecodeEngine(m, capacity=2, s_max=64, chunk=4)
         req = _Request(p, 5)
         pending = [req]
-        for _ in range(50):
-            eng.admit(pending)
-            eng.decode_once()
-            if eng.idle() and not pending:
-                break
+        self._drive(eng, pending)
         np.testing.assert_array_equal(req.wait(timeout=1), ref)
 
     def test_pp2_masked_batching(self):
