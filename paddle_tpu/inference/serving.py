@@ -353,17 +353,34 @@ class BatchingServer:
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
         self.max_new_tokens = max_new_tokens
+        from ..distributed.fleet.mp_layers import current_mesh
+        # the mesh is thread-local: capture the constructor's mesh so
+        # the worker thread serves under the SAME mesh the fallback
+        # decision (and the user's sharding) was made with
+        self._mesh = current_mesh()
         self.engine = None
         if continuous:
-            self.engine = DecodeEngine(
-                predictor.model, capacity=max_batch,
-                pad_id=predictor.pad_id, **(engine_kwargs or {}))
+            from ..models.llama import _pp_degree
+            if _pp_degree(self._mesh) > 1:
+                # the engine needs the single-program decode path —
+                # degrade to the masked batch loop, loudly. (Only this
+                # known case degrades; any other engine-construction
+                # failure propagates.)
+                import warnings
+                warnings.warn(
+                    "continuous batching needs a pp=1 mesh; falling "
+                    "back to masked batch-at-a-time", RuntimeWarning,
+                    stacklevel=2)
+            else:
+                self.engine = DecodeEngine(
+                    predictor.model, capacity=max_batch,
+                    pad_id=predictor.pad_id, **(engine_kwargs or {}))
         self._q: queue.Queue[_Request] = queue.Queue()
         self._pending: list[_Request] = []
         self._stop = threading.Event()
         self._worker = threading.Thread(
-            target=self._loop_continuous if continuous else self._loop,
-            daemon=True)
+            target=self._loop_continuous if self.engine is not None
+            else self._loop, daemon=True)
         self._worker.start()
 
     def submit(self, input_ids, max_new_tokens=None) -> _Request:
@@ -420,6 +437,11 @@ class BatchingServer:
         return batch
 
     def _loop(self):
+        from ..distributed.fleet.mp_layers import sharding_ctx
+        with sharding_ctx(self._mesh):
+            self._loop_body()
+
+    def _loop_body(self):
         while not self._stop.is_set():
             batch = self._take_batch()
             if not batch:
@@ -432,6 +454,11 @@ class BatchingServer:
                     r.event.set()
 
     def _loop_continuous(self):
+        from ..distributed.fleet.mp_layers import sharding_ctx
+        with sharding_ctx(self._mesh):
+            self._loop_continuous_body()
+
+    def _loop_continuous_body(self):
         """Continuous batching: one iteration = drain arrivals, admit
         into free slots, ONE bounded decode chunk. Retire/admit happen
         every chunk boundary, never at generation granularity."""

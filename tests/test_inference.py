@@ -453,6 +453,39 @@ class TestContinuousBatching:
         self._drive(eng, pending)
         np.testing.assert_array_equal(req.wait(timeout=1), ref)
 
+    def test_continuous_falls_back_on_pp_mesh(self):
+        """continuous=True on a pipeline mesh degrades loudly to the
+        masked batch loop instead of crashing at construction."""
+        import warnings
+
+        import jax as _jax
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed.fleet.mp_layers import sharding_ctx
+        from paddle_tpu.inference.serving import (BatchingServer,
+                                                  GenerationPredictor)
+        m = self._model()
+        p = np.random.RandomState(6).randint(1, 128, (7,)).astype(
+            np.int32)
+        ref = np.asarray(m.generate(
+            paddle.to_tensor(p[None, :]), max_new_tokens=3,
+            temperature=0.0)._value)[0]
+        mesh = Mesh(np.array(_jax.devices()[:2]).reshape(2, 1),
+                    ("pp", "mp"))
+        with sharding_ctx(mesh):
+            pred = GenerationPredictor(m)
+            with warnings.catch_warnings(record=True) as rec:
+                warnings.simplefilter("always")
+                srv = BatchingServer(pred, continuous=True,
+                                     max_wait_ms=50.0)
+            try:
+                assert any("falling back" in str(x.message)
+                           for x in rec)
+                assert srv.engine is None
+                np.testing.assert_array_equal(
+                    srv.submit(p, 3).wait(timeout=300), ref)
+            finally:
+                srv.close()
+
     def test_pp2_masked_batching(self):
         """supports_mask() is True on a pp=2 mesh (r5): mixed-length
         prompts share ONE masked program through the pipeline prefill,
