@@ -542,11 +542,18 @@ class GeoCommunicator:
     def value(self):
         return self._local
 
-    def step(self, grad, lr=0.05):
-        """One local SGD step; sync with the PS every k_steps."""
+    def step_local(self, grad, lr=0.05) -> bool:
+        """The pure-local half of a geo step (no RPC); returns True
+        when the k-step boundary was reached and :meth:`sync` is due —
+        callers that serialize RPCs separately (DownpourTrainer) take
+        their rpc lock only around that sync."""
         self._local = self._local - lr * np.asarray(grad, np.float32)
         self._step += 1
-        if self._step % self.k_steps == 0:
+        return self._step % self.k_steps == 0
+
+    def step(self, grad, lr=0.05):
+        """One local SGD step; sync with the PS every k_steps."""
+        if self.step_local(grad, lr):
             self.sync()
         return self._local
 

@@ -163,8 +163,12 @@ class _Worker(threading.Thread):
                     lane = emb[:, si, :, :].reshape(-1, t.tower.dim)
                     lane[live] = rows[inv]
                     emb[:, si, :, :] = lane.reshape(B, k, t.tower.dim)
-            flat = np.asarray(t.client.pull_dense(t.dense_table_id),
-                              np.float32)
+            if t.geo is None:
+                flat = np.asarray(
+                    t.client.pull_dense(t.dense_table_id), np.float32)
+        if t.geo is not None:
+            with t._geo_lock:     # geo: dense stays LOCAL between syncs
+                flat = t.geo.value.copy()
         # ... one compiled fwd/bwd ...
         loss, preds, d_emb, d_flat = t.tower(emb, flat, mask, dense,
                                              label, row_w)
@@ -182,8 +186,18 @@ class _Worker(threading.Thread):
                     acc = np.zeros((uniq.size, t.tower.dim), np.float32)
                     np.add.at(acc, inv, d_rows[live])
                     t.client.push_sparse(tid, uniq, acc, sync=False)
-                t.client.push_dense(t.dense_table_id,
-                                    np.asarray(d_flat), sync=False)
+                if t.geo is None:
+                    t.client.push_dense(t.dense_table_id,
+                                        np.asarray(d_flat), sync=False)
+            if t.geo is not None:
+                with t._geo_lock:
+                    # geo step: pure-local SGD; the rpc lock is taken
+                    # only on the k-th step's sync, so workers' sparse
+                    # RPCs never stall behind a local numpy update
+                    if t.geo.step_local(np.asarray(d_flat),
+                                        lr=t._dense_lr):
+                        with t._rpc_lock:
+                            t.geo.sync()
         self.losses.append(float(loss))
         self.preds.append(np.asarray(preds)[:b])
         self.labels.append(label[:b])
@@ -200,7 +214,13 @@ class DownpourTrainer:
     def __init__(self, client, slots, label_slot="label",
                  embedding_dim=8, hidden=32, batch_size=32, n_threads=2,
                  sparse_table_id_base=0, dense_table_id=None,
-                 sparse_lr=0.05, dense_lr=0.05, seed=0):
+                 sparse_lr=0.05, dense_lr=0.05, geo_k_steps=0, seed=0):
+        """``geo_k_steps > 0`` switches the dense region to geo-SGD
+        (reference a_sync_configs k_steps): workers apply dense SGD to a
+        trainer-local copy and a GeoCommunicator ships the accumulated
+        delta to the server every k steps — no per-step dense round
+        trip, staleness bounded by k. Sparse pushes stay per-step
+        (Downpour)."""
         self.client = client
         self.label_slot = label_slot
         self.batch_size = int(batch_size)
@@ -227,6 +247,13 @@ class DownpourTrainer:
         # server owns the authoritative dense params from step 0
         client.set_dense(self.dense_table_id, self.tower.flat0)
         self._rpc_lock = threading.Lock()
+        self._dense_lr = float(dense_lr)
+        self.geo = None
+        if geo_k_steps:
+            from . import GeoCommunicator
+            self.geo = GeoCommunicator(client, self.dense_table_id,
+                                       k_steps=int(geo_k_steps))
+            self._geo_lock = threading.Lock()
         self._batches: queue.Queue = queue.Queue(
             maxsize=max(4, 4 * self.n_threads))
 
@@ -267,6 +294,11 @@ class DownpourTrainer:
         for w in workers:
             if w.error is not None:
                 raise w.error
+        if self.geo is not None:
+            # flush the residual delta: a run ending off the k-step
+            # boundary must not strand its tail updates locally
+            with self._geo_lock, self._rpc_lock:
+                self.geo.sync()
         losses = [loss for w in workers for loss in w.losses]
         auc = Auc()
         for w in workers:

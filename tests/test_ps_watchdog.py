@@ -373,6 +373,39 @@ class TestPsIngestionAndTrainer:
         ds.release_memory()
         assert len(ds) == 0
 
+    def test_geo_sgd_dense_sync(self, tmp_path):
+        """geo_k_steps mode: workers train the dense region on a LOCAL
+        copy and the GeoCommunicator ships deltas every k steps — the
+        model still learns, and the server's dense region converges to
+        the trained values (not the init) after the final sync."""
+        from paddle_tpu.distributed import fleet, ps
+        slots = self._slots()
+        p = tmp_path / "ctr.txt"
+        self._write_ctr_file(str(p), n=800)
+        ds = fleet.InMemoryDataset(slots, batch_size=64, seed=0)
+        ds.load_into_memory([str(p)])
+        ds.local_shuffle()
+        srv = ps.PsServer(name="ps_geo_test")
+        try:
+            client = ps.PsClient(server_name="ps_geo_test")
+            tr = ps.DownpourTrainer(client, slots, embedding_dim=8,
+                                    hidden=32, batch_size=64,
+                                    n_threads=2, sparse_lr=2.0,
+                                    dense_lr=0.5, geo_k_steps=4)
+            stats = tr.train(ds, epochs=8)
+            assert stats["loss_mean_tail"] < stats["loss_mean_head"] - 0.1
+            # train() flushes the residual delta itself — the server is
+            # authoritative the moment train() returns
+            server_flat = np.asarray(client.pull_dense(
+                tr.dense_table_id))
+            # the server moved away from the init by the local training
+            assert not np.allclose(server_flat, tr.tower.flat0,
+                                   atol=1e-3)
+            ev = tr.evaluate(ds)
+            assert ev["auc"] > 0.7, (stats, ev)
+        finally:
+            srv.stop()
+
     def test_full_uint64_feasign_range(self):
         """64-bit hash feasigns (above 2^63-1) parse as the signed
         bit-pattern and round-trip through a sparse table — per-slot
