@@ -216,12 +216,22 @@ class DecodeEngine:
             self._reset()   # a wedged fill must not brick later bursts
             return 0
         st, embed, fnorm, lm = self._weights()
+        t0 = time.perf_counter()   # decode-only window: admit()'s
+        #                            prefill/compile must not read as a
+        #                            phantom throughput collapse
         toks, self._ck, self._cv = self._decode(
             st, embed, fnorm, lm, self._scales, jnp.asarray(self._tok),
             self._ck, self._cv, self._g, jnp.asarray(self._pad))
-        toks = _np.asarray(toks)        # [chunk, B]
+        toks = _np.asarray(toks)        # [chunk, B] (fetch = sync)
+        wall = time.perf_counter() - t0
         self._g += self.chunk
         self.device_steps += self.chunk
+        n_busy = sum(r is not None for r in self._rows)
+        from ..utils.log import log_event
+        log_event("engine_chunk", steps=self.chunk, rows=n_busy,
+                  fill=self._g, wall_s=round(wall, 4),
+                  tokens_per_s=round(self.chunk * n_busy
+                                     / max(wall, 1e-9), 1))
         alive = 0
         for slot, row in enumerate(self._rows):
             if row is None:
