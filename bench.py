@@ -121,7 +121,7 @@ def _killpg_quietly(pid: int, sig) -> None:
         pass
 
 
-def run_walled() -> None:
+def run_walled(wall_s: float | None = None) -> None:
     """Re-exec the bench in a killable child bounded by a wall timeout,
     so a mid-bench tunnel stall surfaces as an infra-skip JSON (rc=0)
     instead of the driver's own rc=124 kill. The child runs in its own
@@ -130,7 +130,9 @@ def run_walled() -> None:
     TPU-holding child."""
     import signal
     import threading
-    env = dict(os.environ, BENCH_CHILD="1")
+    # the parent already ran the probe; re-probing in the child would
+    # spend wall budget on work that's done
+    env = dict(os.environ, BENCH_CHILD="1", BENCH_SKIP_PROBE="1")
     child = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                              env=env, start_new_session=True,
                              stdout=subprocess.PIPE, text=True)
@@ -156,15 +158,15 @@ def run_walled() -> None:
 
     signal.signal(signal.SIGTERM, forward)
     signal.signal(signal.SIGINT, forward)
+    wall = _WALL_TIMEOUT_S if wall_s is None else wall_s
     try:
-        rc = child.wait(timeout=_WALL_TIMEOUT_S)
+        rc = child.wait(timeout=wall)
     except subprocess.TimeoutExpired:
         _killpg_quietly(child.pid, signal.SIGKILL)
         child.wait()
         pump.join(timeout=10)
         if not saw_metric.is_set():
-            _emit_infra_skip(
-                f"bench hung > {_WALL_TIMEOUT_S}s wall limit")
+            _emit_infra_skip(f"bench hung > {wall:.0f}s wall limit")
         sys.exit(0)
     pump.join(timeout=10)
     sys.exit(rc)
@@ -440,7 +442,13 @@ def main():
 
 if __name__ == "__main__":
     if not _env_flag("BENCH_CHILD") and not _env_flag("BENCH_NO_WALL"):
-        run_walled()
+        # probe FIRST, then charge its runtime against the TOTAL wall
+        # budget: probe retries + bench must together stay under the
+        # driver's own ~15-min kill or the infra-skip never emits
+        _t0 = time.monotonic()
+        probe_backend()
+        run_walled(max(120.0, _WALL_TIMEOUT_S
+                       - (time.monotonic() - _t0)))
     probe_backend()
     try:
         main()

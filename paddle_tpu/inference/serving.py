@@ -22,6 +22,8 @@ import time
 
 import numpy as np
 
+from ..utils.log import log_event
+
 __all__ = ["GenerationPredictor", "BatchingServer", "DecodeEngine"]
 
 
@@ -227,7 +229,6 @@ class DecodeEngine:
         self._g += self.chunk
         self.device_steps += self.chunk
         n_busy = sum(r is not None for r in self._rows)
-        from ..utils.log import log_event
         log_event("engine_chunk", steps=self.chunk, rows=n_busy,
                   fill=self._g, wall_s=round(wall, 4),
                   tokens_per_s=round(self.chunk * n_busy
