@@ -72,6 +72,24 @@ def _emit_infra_skip(detail: str) -> None:
     }), flush=True)
 
 
+_LIVE_CHILDREN: list = []   # pids a parent signal handler must reap
+
+
+def _install_parent_handlers() -> None:
+    """SIGTERM/SIGINT during ANY phase (probe included) must reap the
+    live child process groups — a dead parent waiting on a hung probe
+    would otherwise orphan a tunnel-holding subprocess."""
+    import signal
+
+    def bail(signum, frame):
+        for pid in list(_LIVE_CHILDREN):
+            _killpg_quietly(pid, signal.SIGKILL)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, bail)
+    signal.signal(signal.SIGINT, bail)
+
+
 def probe_backend() -> None:
     """Verify the accelerator backend initializes, from a subprocess.
 
@@ -89,14 +107,24 @@ def probe_backend() -> None:
         if attempt:
             time.sleep(_PROBE_BACKOFF_S[min(attempt,
                                             len(_PROBE_BACKOFF_S) - 1)])
+        child = subprocess.Popen(
+            [sys.executable, "-c", code],
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True)
+        _LIVE_CHILDREN.append(child.pid)
         try:
-            r = subprocess.run(
-                [sys.executable, "-c", code],
-                cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
-                capture_output=True, text=True, timeout=_PROBE_TIMEOUT_S)
+            out, err = child.communicate(timeout=_PROBE_TIMEOUT_S)
+            r = subprocess.CompletedProcess(
+                code, child.returncode, stdout=out, stderr=err)
         except subprocess.TimeoutExpired:
+            import signal
+            _killpg_quietly(child.pid, signal.SIGKILL)
+            child.wait()
             last = f"backend init hung > {_PROBE_TIMEOUT_S}s"
             continue
+        finally:
+            _LIVE_CHILDREN.remove(child.pid)
         if r.returncode == 0:
             platform = (r.stdout.strip().split() or ["?"])[0]
             if platform == "cpu" and not _env_flag("BENCH_ALLOW_CPU"):
@@ -136,6 +164,7 @@ def run_walled(wall_s: float | None = None) -> None:
     child = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                              env=env, start_new_session=True,
                              stdout=subprocess.PIPE, text=True)
+    _LIVE_CHILDREN.append(child.pid)
     # Forward the child's stdout live and remember whether a metric line
     # already went out: a post-result teardown stall must NOT add a
     # second, contradictory infra-skip line (one-JSON-line contract).
@@ -445,10 +474,18 @@ if __name__ == "__main__":
         # probe FIRST, then charge its runtime against the TOTAL wall
         # budget: probe retries + bench must together stay under the
         # driver's own ~15-min kill or the infra-skip never emits
+        _install_parent_handlers()
         _t0 = time.monotonic()
         probe_backend()
-        run_walled(max(120.0, _WALL_TIMEOUT_S
-                       - (time.monotonic() - _t0)))
+        _remaining = _WALL_TIMEOUT_S - (time.monotonic() - _t0)
+        if _remaining < 120.0:
+            # raised probe knobs ate the budget: say so honestly rather
+            # than start a bench the driver will kill mid-run
+            _emit_infra_skip(
+                f"probe retries consumed the wall budget "
+                f"({_remaining:.0f}s left of {_WALL_TIMEOUT_S}s)")
+            sys.exit(0)
+        run_walled(_remaining)
     probe_backend()
     try:
         main()

@@ -51,71 +51,138 @@ def test_env_flag_tolerant(monkeypatch):
     assert bench._env_flag("BENCH_SKIP_PROBE") is False
 
 
+class _FakeProbe:
+    """Stands in for the probe's Popen child (communicate/wait/pid)."""
+
+    def __init__(self, rc=0, out="", err="", hang=False):
+        self.pid = 999_999_999          # nonexistent: killpg is patched
+        self.returncode = rc
+        self._out = out
+        self._err = err
+        self._hang = hang
+
+    def communicate(self, timeout=None):
+        if self._hang:
+            raise subprocess.TimeoutExpired("probe", timeout)
+        return self._out, self._err
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+
+def _patch_probe(monkeypatch, results):
+    """Install a fake Popen handing out ``results`` per attempt; returns
+    the list of spawn calls. killpg is stubbed so fake pids are never
+    signalled for real."""
+    calls = []
+    it = iter(results)
+
+    def popen(cmd, **k):
+        calls.append(cmd)
+        return next(it)
+
+    monkeypatch.setattr(subprocess, "Popen", popen)
+    monkeypatch.setattr(os, "killpg", lambda pid, sig: None)
+    monkeypatch.setattr(bench, "_PROBE_BACKOFF_S", (0, 0, 0))
+    monkeypatch.delenv("BENCH_SKIP_PROBE", raising=False)
+    return calls
+
+
 def test_probe_skipped_via_env(monkeypatch):
     monkeypatch.setenv("BENCH_SKIP_PROBE", "1")
 
     def boom(*a, **k):  # probe must not spawn anything when skipped
         raise AssertionError("probe ran despite BENCH_SKIP_PROBE")
 
-    monkeypatch.setattr(subprocess, "run", boom)
+    monkeypatch.setattr(subprocess, "Popen", boom)
     bench.probe_backend()
 
 
 def test_probe_success_first_try(monkeypatch, capsys):
-    monkeypatch.delenv("BENCH_SKIP_PROBE", raising=False)
-    monkeypatch.setattr(bench, "_PROBE_BACKOFF_S", (0, 0, 0))
-    calls = []
-
-    def ok(cmd, **k):
-        calls.append(cmd)
-        return subprocess.CompletedProcess(cmd, 0, stdout="tpu 1\n",
-                                           stderr="")
-
-    monkeypatch.setattr(subprocess, "run", ok)
+    calls = _patch_probe(monkeypatch, [_FakeProbe(out="tpu 1\n")])
     bench.probe_backend()
     assert len(calls) == 1
     assert capsys.readouterr().out == ""
+    assert not bench._LIVE_CHILDREN                # bookkeeping drained
 
 
 def test_probe_retries_then_infra_skip(monkeypatch, capsys):
-    monkeypatch.delenv("BENCH_SKIP_PROBE", raising=False)
     monkeypatch.setattr(bench, "_PROBE_ATTEMPTS", 3)
-    monkeypatch.setattr(bench, "_PROBE_BACKOFF_S", (0, 0, 0))
-    attempts = []
-
-    def hang(cmd, timeout=None, **k):
-        attempts.append(timeout)
-        raise subprocess.TimeoutExpired(cmd, timeout)
-
-    monkeypatch.setattr(subprocess, "run", hang)
+    calls = _patch_probe(monkeypatch, [_FakeProbe(hang=True)
+                                       for _ in range(3)])
     with pytest.raises(SystemExit) as ei:
         bench.probe_backend()
     assert ei.value.code == 0                      # infra-skip, NOT rc=1
-    assert len(attempts) == 3                      # bounded retry
+    assert len(calls) == 3                         # bounded retry
     out = json.loads(capsys.readouterr().out.strip())
     assert out["error"] == "backend_unavailable"
     assert out["metric"] == "llama_pretrain_tokens_per_sec_per_chip"
     assert "hung" in out["detail"]
+    assert not bench._LIVE_CHILDREN
 
 
 def test_probe_propagates_non_infra_failure(monkeypatch, capsys):
     """A broken env (import error) is a real regression: rc!=0, no
     infra-skip JSON, no retry burn."""
-    monkeypatch.delenv("BENCH_SKIP_PROBE", raising=False)
-    calls = []
-
-    def broken(cmd, **k):
-        calls.append(cmd)
-        return subprocess.CompletedProcess(
-            cmd, 1, stdout="",
-            stderr="ModuleNotFoundError: No module named 'jax'\n")
-
-    monkeypatch.setattr(subprocess, "run", broken)
+    calls = _patch_probe(monkeypatch, [
+        _FakeProbe(rc=1, err="ModuleNotFoundError: No module named "
+                             "'jax'\n")])
     with pytest.raises(SystemExit) as ei:
         bench.probe_backend()
     assert ei.value.code == 1
     assert len(calls) == 1                         # no pointless retries
     assert capsys.readouterr().out == ""           # no infra-skip JSON
+
+
+def test_probe_rejects_silent_cpu_fallback(monkeypatch, capsys):
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    monkeypatch.setattr(bench, "_PROBE_ATTEMPTS", 2)
+    _patch_probe(monkeypatch, [_FakeProbe(out="cpu 8\n")
+                               for _ in range(2)])
+    with pytest.raises(SystemExit) as ei:
+        bench.probe_backend()
+    assert ei.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["error"] == "backend_unavailable"
+    assert "cpu" in out["detail"]
+    # explicit opt-in keeps the CPU smoke path usable
+    monkeypatch.setenv("BENCH_ALLOW_CPU", "1")
+    _patch_probe(monkeypatch, [_FakeProbe(out="cpu 8\n")])
+    monkeypatch.setenv("BENCH_ALLOW_CPU", "1")
+    bench.probe_backend()                          # must not exit
+
+
+def test_probe_recovers_on_second_attempt(monkeypatch, capsys):
+    calls = _patch_probe(monkeypatch, [
+        _FakeProbe(rc=1, err="jax.errors.JaxRuntimeError: UNAVAILABLE: "
+                             "boom\n"),
+        _FakeProbe(out="tpu 1\n")])
+    bench.probe_backend()                          # must not exit
+    assert len(calls) == 2
+    assert capsys.readouterr().out == ""
+
+
+def test_parent_handlers_reap_live_children(monkeypatch, capsys):
+    """A driver SIGTERM during ANY phase (probe included) must SIGKILL
+    every live child process group before the parent exits."""
+    import signal
+    saved = [(s, signal.getsignal(s))
+             for s in (signal.SIGTERM, signal.SIGINT)]
+    killed = []
+    monkeypatch.setattr(os, "killpg",
+                        lambda pid, sig: killed.append((pid, sig)))
+    try:
+        bench._install_parent_handlers()
+        bench._LIVE_CHILDREN.append(424242)
+        handler = signal.getsignal(signal.SIGTERM)
+        with pytest.raises(SystemExit) as ei:
+            handler(signal.SIGTERM, None)
+        assert ei.value.code == 128 + signal.SIGTERM
+        assert (424242, signal.SIGKILL) in killed
+    finally:
+        bench._LIVE_CHILDREN.clear()
+        for s, h in saved:
+            signal.signal(s, h)
 
 
 @pytest.fixture
@@ -184,46 +251,4 @@ def test_walled_run_propagates_child_rc(monkeypatch, capsys,
     with pytest.raises(SystemExit) as ei:
         bench.run_walled()
     assert ei.value.code == 3
-    assert capsys.readouterr().out == ""
-
-
-def test_probe_rejects_silent_cpu_fallback(monkeypatch, capsys):
-    monkeypatch.delenv("BENCH_SKIP_PROBE", raising=False)
-    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
-    monkeypatch.setattr(bench, "_PROBE_ATTEMPTS", 2)
-    monkeypatch.setattr(bench, "_PROBE_BACKOFF_S", (0, 0))
-
-    def cpu_fallback(cmd, **k):
-        return subprocess.CompletedProcess(cmd, 0, stdout="cpu 8\n",
-                                           stderr="")
-
-    monkeypatch.setattr(subprocess, "run", cpu_fallback)
-    with pytest.raises(SystemExit) as ei:
-        bench.probe_backend()
-    assert ei.value.code == 0
-    out = json.loads(capsys.readouterr().out.strip())
-    assert out["error"] == "backend_unavailable"
-    assert "cpu" in out["detail"]
-    # explicit opt-in keeps the CPU smoke path usable
-    monkeypatch.setenv("BENCH_ALLOW_CPU", "1")
-    bench.probe_backend()                          # must not exit
-
-
-def test_probe_recovers_on_second_attempt(monkeypatch, capsys):
-    monkeypatch.delenv("BENCH_SKIP_PROBE", raising=False)
-    monkeypatch.setattr(bench, "_PROBE_BACKOFF_S", (0, 0, 0))
-    state = {"n": 0}
-
-    def flaky(cmd, timeout=None, **k):
-        state["n"] += 1
-        if state["n"] == 1:
-            return subprocess.CompletedProcess(
-                cmd, 1, stdout="",
-                stderr="jax.errors.JaxRuntimeError: UNAVAILABLE: boom\n")
-        return subprocess.CompletedProcess(cmd, 0, stdout="tpu 1\n",
-                                           stderr="")
-
-    monkeypatch.setattr(subprocess, "run", flaky)
-    bench.probe_backend()                          # must not exit
-    assert state["n"] == 2
     assert capsys.readouterr().out == ""

@@ -373,6 +373,40 @@ class TestPsIngestionAndTrainer:
         ds.release_memory()
         assert len(ds) == 0
 
+    def test_global_shuffle_partitions_across_ranks(self, monkeypatch):
+        """world>1 global_shuffle: every rank computes the SAME
+        permutation of the gathered global record set and takes its
+        strided share — together the shares cover each record exactly
+        once (reference Dataset GlobalShuffle over the PS channel)."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import fleet
+        slots = [fleet.SlotDesc("x", "uint64")]
+        world = 3
+        per_rank = [[{"x": np.asarray([r * 100 + i], np.int64)}
+                     for i in range(4)] for r in range(world)]
+
+        shares = []
+        for rank in range(world):
+            ds = fleet.InMemoryDataset(slots, batch_size=2, seed=7)
+            ds._records = list(per_rank[rank])
+            monkeypatch.setattr(dist, "get_world_size",
+                                lambda group=None: world)
+            monkeypatch.setattr(dist, "get_rank",
+                                lambda group=None, r=rank: r)
+
+            def fake_gather(out, obj, group=None):
+                out.extend(list(per_rank))  # same global view everywhere
+
+            monkeypatch.setattr(dist, "all_gather_object", fake_gather)
+            ds.global_shuffle()
+            shares.append([int(r["x"][0]) for r in ds._records])
+        allrec = sorted(x for s in shares for x in s)
+        want = sorted(r * 100 + i for r in range(world) for i in range(4))
+        assert allrec == want                  # exact cover, no dupes
+        assert all(len(s) == 4 for s in shares)
+        # and it is a real shuffle, not identity partitioning
+        assert shares[0] != [0, 1, 2, 3]
+
     def test_geo_sgd_dense_sync(self, tmp_path):
         """geo_k_steps mode: workers train the dense region on a LOCAL
         copy and the GeoCommunicator ships deltas every k steps — the
