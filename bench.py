@@ -41,6 +41,8 @@ _WALL_TIMEOUT_S = int(os.environ.get("BENCH_WALL_TIMEOUT", 720))
 _PRESET_METRICS = {
     "flash32k": "flash_attention_32k_fwd_bwd_ms",
     "decode": "decode_tokens_per_sec",
+    "engine": "engine_decode_tokens_per_sec",
+    "smoke": "smoke_wall_seconds",
 }
 
 
@@ -345,6 +347,164 @@ def bench_decode():
     }))
 
 
+def bench_engine():
+    """Continuous-batching serving throughput: staggered arrivals with
+    mixed max_new through the paged DecodeEngine. tokens/s comes from
+    the engine's own ``engine_chunk`` events (device-side decode windows
+    only — admission prefills and compile excluded), and vs_baseline is
+    the DEVICE-STEP ratio against batch-at-a-time over the identical
+    FIFO workload (deterministic device-work comparison, not two wall
+    clocks; >1 means the engine ran fewer decode steps)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import DecodeEngine, _Request
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.utils.log import default_event_log
+    on_tpu = jax.default_backend() not in ("cpu",)
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
+                          intermediate_size=14336, num_hidden_layers=2,
+                          num_attention_heads=32, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16")
+        capacity, s_max, chunk = 8, 512, 8
+        n_req, p_lo, p_hi = 32, 64, 128
+        max_news = (32, 64, 128)
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=344, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2)
+        capacity, s_max, chunk = 4, 64, 4
+        n_req, p_lo, p_hi = 12, 5, 16
+        max_news = (4, 8, 16)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        import jax.numpy as jnp
+        for p in model.parameters():
+            p._in_place_update(p._value.astype(jnp.bfloat16))
+    model.eval()
+    rng = np.random.default_rng(0)
+    eng = DecodeEngine(model, capacity=capacity, s_max=s_max,
+                       chunk=chunk)
+
+    def drive(pending, stagger=None, iters=100000):
+        queue = list(pending)
+        del pending[:]
+        live = []
+        for _ in range(iters):
+            while queue and (stagger is None or len(live) < stagger):
+                live.append(queue.pop(0))
+            eng.admit(live)
+            eng.decode_once()
+            if not queue and not live and eng.idle():
+                return
+        raise RuntimeError("engine bench did not drain")
+
+    # warmup: compile the prefill + chunk programs outside the window
+    warm = _Request(rng.integers(
+        1, cfg.vocab_size, p_hi).astype(np.int32), chunk)
+    drive([warm])
+    warm.wait(timeout=600)
+    mark = len(default_event_log.events("engine_chunk"))
+    steps0 = eng.device_steps
+
+    reqs = [_Request(
+        rng.integers(1, cfg.vocab_size,
+                     int(rng.integers(p_lo, p_hi + 1))).astype(np.int32),
+        int(max_news[i % len(max_news)])) for i in range(n_req)]
+    drive(list(reqs), stagger=2)    # 2 FIFO arrivals per chunk tick:
+    #                                 admission overlaps live decodes
+    for r in reqs:
+        r.wait(timeout=600)
+    chunks = default_event_log.events("engine_chunk")[mark:]
+    dev_tokens = sum(c["steps"] * c["rows"] for c in chunks)
+    wall = sum(c["wall_s"] for c in chunks)
+    tps = dev_tokens / max(wall, 1e-9)
+    # batch-at-a-time baseline on the same FIFO order: each tick of
+    # `capacity` requests rides to its slowest member's max_new
+    baseline_steps = sum(max(r.max_new for r in reqs[i:i + capacity])
+                         for i in range(0, n_req, capacity))
+    engine_steps = eng.device_steps - steps0
+    print(json.dumps({
+        "metric": "engine_decode_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(baseline_steps / max(engine_steps, 1), 4),
+        "extra": {"requests": n_req, "capacity": capacity,
+                  "chunk": chunk, "s_max": s_max,
+                  "engine_device_steps": int(engine_steps),
+                  "batch_at_a_time_steps": int(baseline_steps),
+                  "decode_chunks": len(chunks),
+                  "blocks": eng._alloc.stats() if eng.paged else None,
+                  "paged": bool(eng.paged),
+                  "backend": jax.default_backend()},
+    }))
+
+
+def bench_smoke():
+    """Sub-minute pipeline probe: ONE tiny compiled train step
+    (fwd+bwd+AdamW) plus ONE compiled flash-attention fwd+bwd. The
+    metric is wall seconds against a 60s budget (vs_baseline > 1 means
+    under budget) — a fast end-to-end 'compiles and trains' signal for
+    CI, not a performance number."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.kernels.flash_attention import flash_attention
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_loss_fn)
+    t0 = time.perf_counter()
+    paddle.seed(0)
+    ndev = len(jax.devices())
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      intermediate_size=172, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    mesh = dist.ProcessMesh(shape=[ndev], dim_names=["dp"])
+    dist.shard_model_state(model, mesh)
+    step = dist.DistTrainStep(model, opt, llama_loss_fn, mesh)
+    toks = paddle.to_tensor(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (2 * ndev, 64)).astype(np.int32))
+    loss0 = float(step(toks, toks))
+    loss1 = float(step(toks, toks))
+
+    rng = np.random.default_rng(1)
+
+    def mk(h):
+        return jnp.asarray(rng.standard_normal((1, 256, h, 128)),
+                           jnp.float32)
+
+    q, k, v = mk(4), mk(2), mk(2)
+
+    interp = jax.default_backend() == "cpu"   # Pallas on CPU only runs
+    #                                           in interpret mode
+
+    def attn_loss(q, k, v):
+        return flash_attention(q, k, v, causal=True,
+                               interpret=interp).astype(
+            jnp.float32).sum()
+
+    g = jax.jit(jax.grad(attn_loss, argnums=(0, 1, 2)))
+    tf = time.perf_counter()
+    float(g(q, k, v)[0].sum())
+    flash_s = time.perf_counter() - tf
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "smoke_wall_seconds",
+        "value": round(wall, 2),
+        "unit": "s",
+        "vs_baseline": round(60.0 / max(wall, 1e-9), 4),
+        "extra": {"train_loss_first": round(loss0, 4),
+                  "train_loss_second": round(loss1, 4),
+                  "flash_fwd_bwd_compile_s": round(flash_s, 2),
+                  "devices": ndev,
+                  "backend": jax.default_backend()},
+    }))
+
+
 def main():
     import jax
     on_tpu = jax.default_backend() not in ("cpu",)
@@ -360,6 +520,10 @@ def main():
         return bench_flash_32k()
     if preset == "decode":
         return bench_decode()
+    if preset == "engine":
+        return bench_engine()
+    if preset == "smoke":
+        return bench_smoke()
     if on_tpu:
         check_bf16_psum_parity()
     if on_tpu:

@@ -96,6 +96,12 @@ class Tensor:
         a = np.asarray(self._value)
         return a.astype(dtype) if dtype is not None else a
 
+    def __jax_array__(self):
+        # jnp.asarray(tensor) resolves through this on every jax version;
+        # the numpy __array__ fallback alone is not honored by older
+        # jnp.array
+        return self._value
+
     def __float__(self):
         return float(self._value)
 

@@ -299,8 +299,9 @@ def spmd_pipeline(stage_fn: Callable, n_stages: int, n_microbatch: int,
             # aux_mean_axes (e.g. a manual sep axis) average the per-shard
             # terms so the scalar is replicated for the P() out_spec
             aux = lax.psum(aux_acc, axis_name)
+            from ..fcollectives import axis_size as _axis_size
             for ax in aux_mean_axes:
-                aux = safe_psum(aux, ax) / jax.lax.axis_size(ax)
+                aux = safe_psum(aux, ax) / _axis_size(ax)
             return outputs, aux
         return outputs
 

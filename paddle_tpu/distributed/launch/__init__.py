@@ -128,12 +128,19 @@ def main(argv=None):
                         default=1)
     parser.add_argument("--master", default=None,
                         help="coordinator host:port (default: local free port)")
-    parser.add_argument("--log_dir", default=None,
-                        help="worker log dir (default: a temp dir)")
+    parser.add_argument("--log_dir", default="log",
+                        help="worker log dir (default: ./log, the "
+                             "reference CLI convention; programmatic "
+                             "launch() still defaults to a temp dir)")
     parser.add_argument("--job_id", default="default")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+    # CLI runs always say where the logs are — debugging a dead worker
+    # starts with its workerlog, and a defaulted path is easy to miss
+    sys.stderr.write(
+        f"paddle_tpu.launch: worker logs in {os.path.abspath(args.log_dir)}"
+        "\n")
     return launch(args.script, args.script_args,
                   nproc_per_node=args.nproc_per_node, master=args.master,
                   log_dir=args.log_dir, job_id=args.job_id)

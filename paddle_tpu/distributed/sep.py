@@ -107,8 +107,9 @@ def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
     spec = _seq_spec(axis_name)
     fn = functools.partial(ring_attention_local, axis_name=axis_name,
                            n_shards=n, causal=causal)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, axis_names={axis_name})(q, k, v)
+    from ..utils.compat import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, axis_names={axis_name})(q, k, v)
 
 
 def ulysses_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
@@ -140,9 +141,10 @@ def ulysses_attention(q, k, v, causal: bool = True, axis_name: str = "sep",
                               tiled=True)
 
     # check_vma off: pallas_call inside shard_map can't express output vma
-    return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, axis_names={axis_name},
-                         check_vma=False)(q, k, v)
+    from ..utils.compat import shard_map
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, axis_names={axis_name},
+                     check_vma=False)(q, k, v)
 
 
 def sep_attention(q, k, v, causal: bool = True, axis_name: str = "sep",

@@ -1,0 +1,77 @@
+"""Block-pool allocator for the paged KV cache (tentpole of the paged
+continuous-batching DecodeEngine; reference shape: vLLM's BlockAllocator
+behind "Ragged Paged Attention", arxiv 2604.15464).
+
+The device side is a ``[L, n_blocks, block_size, kvh, hd]`` pool plus a
+per-row int32 block table; this module owns the HOST side: a free-list
+of page ids. Page 0 is the reserved NULL page (kernels/paged_attention
+NULL_PAGE): padded table entries and inactive rows read/write it, so
+the fixed-shape programs need no validity masks — the allocator simply
+never hands it out.
+
+Policy: LIFO free list (hot pages stay hot in HBM), O(1) allocate and
+free, loud double-free / unknown-page errors — an aliased page would
+silently corrupt another row's KV history, the one failure mode a paged
+cache must never have.
+"""
+
+from __future__ import annotations
+
+from ..kernels.paged_attention import NULL_PAGE
+
+__all__ = ["BlockAllocator"]
+
+
+class BlockAllocator:
+    """Free-list over page ids ``1..n_blocks-1`` (page 0 = NULL)."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError(
+                f"n_blocks={n_blocks}: need at least one allocatable "
+                f"page beyond the reserved NULL page")
+        self.n_blocks = int(n_blocks)
+        # LIFO: freed pages are reused first
+        self._free = list(range(self.n_blocks - 1, NULL_PAGE, -1))
+        self._used: set[int] = set()
+
+    @property
+    def capacity(self) -> int:
+        """Total allocatable pages (excludes the NULL page)."""
+        return self.n_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return len(self._used)
+
+    def allocate(self, n: int) -> list[int] | None:
+        """n pages, all-or-nothing. None when the pool can't cover it
+        (caller decides: defer admission, or fail the one row that
+        needed growth)."""
+        if n < 0:
+            raise ValueError(f"allocate({n})")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._used.update(pages)
+        return pages
+
+    def free(self, pages) -> None:
+        """Return a row's pages. Double-free and foreign ids raise —
+        both would alias live KV history."""
+        for p in pages:
+            if p not in self._used:
+                raise ValueError(
+                    f"free of page {p} which is not allocated "
+                    f"(double-free or foreign id)")
+            self._used.discard(p)
+            self._free.append(p)
+
+    def stats(self) -> dict:
+        """Occupancy snapshot (bench/engine observability)."""
+        return {"capacity": self.capacity, "used": self.num_used,
+                "free": self.num_free}

@@ -32,21 +32,33 @@ class DecodeEngine:
     reference: the fastdeploy/paddle-serving continuous-batching loop
     over masked_multihead_attention decode kernels).
 
-    The engine owns a [L, capacity, s_max, kvh, hd] cache and decodes in
-    bounded ``chunk``-token steps. Between chunks, finished rows RETIRE
-    (freeing their slot immediately instead of riding to the batch max)
-    and pending prompts are ADMITTED into free slots via a fixed-shape
-    prefill program — so late arrivals never wait out someone else's
-    generation. Per-row left-pad offsets (pad_len) keep rope positions
-    and attention masks exact for rows that joined at different global
-    steps; greedy outputs bit-match solo generation.
+    Default mode is PAGED (``paged=True``; reference shape: "Ragged
+    Paged Attention", arxiv 2604.15464 / vLLM's PagedAttention): the KV
+    cache is a ``[L, n_blocks, block_size, kvh, hd]`` block pool with a
+    per-row block table and a host-side free-list
+    (:class:`~paddle_tpu.inference.paged_cache.BlockAllocator`). Rows
+    own ragged per-row lengths starting at their own position 0 —
+    admission needs no global fill position, rows retire by freeing
+    their pages, and the engine NEVER resets under sustained traffic
+    (the contiguous cache's monotonic global fill shrank the admissible
+    budget toward zero until an idle reset). The block table and lens
+    are data arguments, so the two-compiled-programs discipline holds.
 
-    Two compiled programs total (one prefill, one decode chunk), reused
-    for the engine's lifetime. ``device_steps`` counts executed decode
-    steps — the efficiency metric batch-at-a-time loses (it always runs
-    batch x max(max_new))."""
+    ``paged=False`` keeps the contiguous right-aligned
+    [L, capacity, s_max, kvh, hd] cache: finished rows retire, pending
+    prompts admit into free slots, per-row left-pad offsets keep rope
+    positions exact. On cache exhaustion it now runs a final CLAMPED
+    chunk first: rows whose remaining max_new still fits in the leftover
+    fill finish normally; only rows that genuinely cannot fit fail.
 
-    def __init__(self, model, capacity=4, s_max=256, chunk=8, pad_id=0):
+    Both modes: greedy outputs bit-match solo generation.
+    ``device_steps`` counts executed decode steps — the efficiency
+    metric batch-at-a-time loses (it always runs batch x max(max_new));
+    ``resets`` counts cache resets (paged mode: stays at the
+    construction-time 1)."""
+
+    def __init__(self, model, capacity=4, s_max=256, chunk=8, pad_id=0,
+                 paged=True, block_size=16, n_blocks=None):
         from ..distributed.fleet.mp_layers import current_mesh
         from ..models.llama import _pp_degree
         if _pp_degree(current_mesh()) > 1:
@@ -59,8 +71,22 @@ class DecodeEngine:
         self.s_max = int(s_max)
         self.chunk = int(chunk)
         self.pad_id = int(pad_id)
+        self.paged = bool(paged)
+        self.block_size = int(block_size)
+        if self.paged:
+            # table width covers within-chunk overflow writes of rows
+            # that finish mid-chunk (their tail lands on the NULL page)
+            self._max_blocks = -(-(self.s_max + self.chunk)
+                                 // self.block_size)
+            if n_blocks is None:
+                # full occupancy never starves: every row can grow to
+                # s_max (ceil(s_max/bs) pages), plus the reserved NULL
+                n_blocks = self.capacity * -(-self.s_max
+                                             // self.block_size) + 1
+            self.n_blocks = int(n_blocks)
         self.device_steps = 0           # decode steps actually executed
         self.prefills = 0
+        self.resets = 0                 # cache resets (init counts as 1)
         self._build()
         self._reset()
 
@@ -97,27 +123,80 @@ class DecodeEngine:
                 last_index=g - 1)
             return jnp.argmax(logits, axis=-1), ks, vs
 
-        def decode_chunk(stacked, embed, fnorm, lm, scales, tok, ck, cv,
-                         g0, pad_len):
+        def make_decode(n):
+            """Contiguous decode program over ``n`` steps. ``n`` is the
+            engine chunk for the whole lifetime except ONE final
+            clamped chunk at cache exhaustion (satellite: near-finished
+            rows ride the leftover fill out instead of failing)."""
+
+            def decode_chunk(stacked, embed, fnorm, lm, scales, tok, ck,
+                             cv, g0, pad_len):
+                stacked, lm = _llama._dequantize_weights(cfg, stacked,
+                                                         lm, scales)
+                if lm is None:
+                    lm = embed.T
+
+                def body(carry, i):
+                    tok, ck, cv = carry
+                    logits, ck, cv = _llama._decode_step(
+                        cfg, stacked, embed, fnorm, lm, tok, ck, cv,
+                        g0 + i, pad_len=pad_len)
+                    nxt = jnp.argmax(logits, axis=-1)
+                    return (nxt, ck, cv), nxt
+
+                (tok, ck, cv), toks = jax.lax.scan(
+                    body, (tok, ck, cv), jnp.arange(n))
+                return toks, ck, cv
+
+            return decode_chunk
+
+        def prefill_paged(stacked, embed, fnorm, lm, scales, ids,
+                          pad_len, kp, vp, table_row):
+            """ids [1, s_max] right-aligned; the prompt's K/V scatter
+            into the block pools THROUGH table_row inside the program
+            (pad positions route to the NULL page), so admission is one
+            device call."""
+            stacked, lm = _llama._dequantize_weights(cfg, stacked, lm,
+                                                     scales)
+            if lm is None:
+                lm = embed.T
+            logits, ks, vs = _llama.masked_prefill(
+                cfg, stacked, embed, fnorm, lm, ids, pad_len,
+                last_index=self.s_max - 1)
+            kp, vp = _llama.scatter_prefill_kv(kp, vp, ks, vs,
+                                               table_row, pad_len[0])
+            return jnp.argmax(logits, axis=-1), kp, vp
+
+        def decode_chunk_paged(stacked, embed, fnorm, lm, scales, tok,
+                               kp, vp, tables, lens):
+            """One chunk against the block pool; tables/lens are DATA,
+            so every admission pattern reuses this one program."""
             stacked, lm = _llama._dequantize_weights(cfg, stacked, lm,
                                                      scales)
             if lm is None:
                 lm = embed.T
 
             def body(carry, i):
-                tok, ck, cv = carry
-                logits, ck, cv = _llama._decode_step(
-                    cfg, stacked, embed, fnorm, lm, tok, ck, cv, g0 + i,
-                    pad_len=pad_len)
+                tok, kp, vp = carry
+                logits, kp, vp = _llama._paged_decode_step(
+                    cfg, stacked, embed, fnorm, lm, tok, kp, vp,
+                    tables, lens + i)
                 nxt = jnp.argmax(logits, axis=-1)
-                return (nxt, ck, cv), nxt
+                return (nxt, kp, vp), nxt
 
-            (tok, ck, cv), toks = jax.lax.scan(
-                body, (tok, ck, cv), jnp.arange(self.chunk))
-            return toks, ck, cv
+            (tok, kp, vp), toks = jax.lax.scan(
+                body, (tok, kp, vp), jnp.arange(self.chunk))
+            return toks, kp, vp
 
-        self._prefill = jax.jit(prefill)
-        self._decode = jax.jit(decode_chunk, donate_argnums=(6, 7))
+        self._make_decode = make_decode
+        self._decode_progs = {}
+        if self.paged:
+            self._prefill = jax.jit(prefill_paged)
+            self._decode = jax.jit(decode_chunk_paged,
+                                   donate_argnums=(6, 7))
+        else:
+            self._prefill = jax.jit(prefill)
+            self._decode = self._decode_for(self.chunk)
         self._cfg = cfg
         self._kvh = cfg.num_key_value_heads
         self._hd = cfg.head_dim
@@ -125,15 +204,37 @@ class DecodeEngine:
         self._cache_dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" \
             else jnp.float32
 
+    def _decode_for(self, n):
+        """Compiled contiguous decode program for an ``n``-step chunk
+        (cached; in practice only self.chunk plus at most one clamped
+        tail length per workload)."""
+        import jax
+        fn = self._decode_progs.get(n)
+        if fn is None:
+            fn = jax.jit(self._make_decode(n), donate_argnums=(6, 7))
+            self._decode_progs[n] = fn
+        return fn
+
     def _reset(self):
         import jax.numpy as jnp
         import numpy as _np
+        self.resets += 1
         B = self.capacity
-        self._ck = jnp.zeros((self._L, B, self.s_max, self._kvh,
-                              self._hd), self._cache_dtype)
-        self._cv = jnp.zeros_like(self._ck)
-        self._g = 0
-        self._pad = _np.zeros((B,), _np.int32)
+        if self.paged:
+            from .paged_cache import BlockAllocator
+            self._kp = jnp.zeros((self._L, self.n_blocks,
+                                  self.block_size, self._kvh,
+                                  self._hd), self._cache_dtype)
+            self._vp = jnp.zeros_like(self._kp)
+            self._alloc = BlockAllocator(self.n_blocks)
+            self._tables = _np.zeros((B, self._max_blocks), _np.int32)
+            self._lens = _np.zeros((B,), _np.int32)
+        else:
+            self._ck = jnp.zeros((self._L, B, self.s_max, self._kvh,
+                                  self._hd), self._cache_dtype)
+            self._cv = jnp.zeros_like(self._ck)
+            self._g = 0
+            self._pad = _np.zeros((B,), _np.int32)
         self._tok = _np.zeros((B,), _np.int32)
         self._rows = [None] * B         # per-slot host state
 
@@ -143,12 +244,17 @@ class DecodeEngine:
 
     def admit(self, pending):
         """Move requests from ``pending`` (a list; consumed in order)
-        into free slots. A prompt longer than the current global fill
-        can only start when the engine is empty (its left-pad would
-        rewind other rows' history)."""
+        into free slots. Paged mode: any free slot with enough free
+        pages admits immediately — there is no global fill to respect;
+        when pages run short admission WAITS (retiring rows free
+        theirs). Contiguous mode: a prompt longer than the current
+        global fill can only start when the engine is empty (its
+        left-pad would rewind other rows' history)."""
         import jax
         import jax.numpy as jnp
         import numpy as _np
+        if self.paged:
+            return self._admit_paged(pending)
         if self.idle() and pending:
             # fresh fill: size it to the whole first wave so a longer
             # second prompt is not head-of-line deferred behind a
@@ -199,6 +305,51 @@ class DecodeEngine:
             self._rows[slot] = {"req": req, "prompt": prompt,
                                 "toks": [first_tok]}
 
+    def _admit_paged(self, pending):
+        import jax.numpy as jnp
+        import numpy as _np
+        bs = self.block_size
+        for slot in range(self.capacity):
+            if self._rows[slot] is not None or not pending:
+                continue
+            n = pending[0].ids.reshape(-1).size
+            if n > self.s_max - self.chunk:
+                req = pending.pop(0)
+                req.error = ValueError(
+                    f"prompt of {n} tokens exceeds engine s_max="
+                    f"{self.s_max}")
+                req.event.set()
+                continue
+            need = -(-n // bs)
+            pages = self._alloc.allocate(need)
+            if pages is None:
+                break       # pool short: wait for retiring rows' pages
+            req = pending.pop(0)
+            try:
+                ids = _np.full((1, self.s_max), self.pad_id, _np.int32)
+                prompt = req.ids.reshape(-1).astype(_np.int32)
+                ids[0, self.s_max - n:] = prompt
+                pad = self.s_max - n
+                table_row = _np.zeros((self._max_blocks,), _np.int32)
+                table_row[:need] = pages
+                st, embed, fnorm, lm = self._weights()
+                first, self._kp, self._vp = self._prefill(
+                    st, embed, fnorm, lm, self._scales,
+                    jnp.asarray(ids), jnp.asarray([pad], jnp.int32),
+                    self._kp, self._vp, jnp.asarray(table_row))
+            except Exception as e:  # noqa: BLE001 — fail THIS request,
+                self._alloc.free(pages)  # not the whole engine
+                req.error = e
+                req.event.set()
+                continue
+            self.prefills += 1
+            self._tables[slot] = table_row
+            self._lens[slot] = n
+            first_tok = int(first[0])
+            self._tok[slot] = first_tok
+            self._rows[slot] = {"req": req, "prompt": prompt,
+                                "toks": [first_tok], "pages": pages}
+
     def decode_once(self):
         """Run ONE bounded decode chunk, collect tokens, retire finished
         rows (their futures resolve immediately). Returns the number of
@@ -207,31 +358,44 @@ class DecodeEngine:
         import numpy as _np
         if self.idle():
             return 0
-        if self._g + self.chunk > self.s_max:
+        if self.paged:
+            return self._decode_once_paged()
+        steps = self.chunk
+        if self._g + steps > self.s_max:
+            # cache exhaustion: fail ONLY rows whose remaining demand
+            # cannot fit in the leftover fill; survivors ride one final
+            # CLAMPED chunk out instead of getting the exhaustion error
+            space = self.s_max - self._g
             for slot, row in enumerate(self._rows):
-                if row is not None:
+                if row is None:
+                    continue
+                need = row["req"].max_new - len(row["toks"])
+                if need > space:
                     row["req"].error = RuntimeError(
                         f"engine cache exhausted at fill {self._g} "
-                        f"(s_max={self.s_max})")
+                        f"(s_max={self.s_max}): {need} tokens still "
+                        f"needed, {space} slots left")
                     row["req"].event.set()
                     self._rows[slot] = None
-            self._reset()   # a wedged fill must not brick later bursts
-            return 0
+            if space <= 0 or self.idle():
+                self._reset()  # a wedged fill must not brick later
+                return 0       # bursts
+            steps = space      # every survivor finishes inside it
         st, embed, fnorm, lm = self._weights()
         t0 = time.perf_counter()   # decode-only window: admit()'s
         #                            prefill/compile must not read as a
         #                            phantom throughput collapse
-        toks, self._ck, self._cv = self._decode(
+        toks, self._ck, self._cv = self._decode_for(steps)(
             st, embed, fnorm, lm, self._scales, jnp.asarray(self._tok),
             self._ck, self._cv, self._g, jnp.asarray(self._pad))
-        toks = _np.asarray(toks)        # [chunk, B] (fetch = sync)
+        toks = _np.asarray(toks)        # [steps, B] (fetch = sync)
         wall = time.perf_counter() - t0
-        self._g += self.chunk
-        self.device_steps += self.chunk
+        self._g += steps
+        self.device_steps += steps
         n_busy = sum(r is not None for r in self._rows)
-        log_event("engine_chunk", steps=self.chunk, rows=n_busy,
+        log_event("engine_chunk", steps=steps, rows=n_busy,
                   fill=self._g, wall_s=round(wall, 4),
-                  tokens_per_s=round(self.chunk * n_busy
+                  tokens_per_s=round(steps * n_busy
                                      / max(wall, 1e-9), 1))
         alive = 0
         for slot, row in enumerate(self._rows):
@@ -250,6 +414,93 @@ class DecodeEngine:
                 alive += 1
         if alive == 0 and self.idle():
             self._reset()                # fresh fill for the next burst
+        return alive
+
+    # -- paged engine loop --------------------------------------------------
+    def _retire_paged(self, slot):
+        """Free the row's pages back to the pool and clear its lane."""
+        row = self._rows[slot]
+        self._alloc.free(row["pages"])
+        self._tables[slot] = 0          # all-NULL: inactive lane
+        self._lens[slot] = 0
+        self._tok[slot] = 0
+        self._rows[slot] = None
+
+    def _fail_row_paged(self, slot, err):
+        row = self._rows[slot]
+        row["req"].error = err
+        row["req"].event.set()
+        self._retire_paged(slot)
+
+    def _decode_once_paged(self):
+        import jax.numpy as jnp
+        import numpy as _np
+        bs = self.block_size
+        # grow each live row's page list to cover this chunk's writes.
+        # Ascending extra-page need: a starved row's freed pages rescue
+        # the rows processed after it, so one hungry row never drags
+        # innocents into the exhaustion error.
+        grow = []
+        for slot, row in enumerate(self._rows):
+            if row is None:
+                continue
+            use = min(self.chunk, row["req"].max_new - len(row["toks"]))
+            target = int(self._lens[slot]) + use
+            grow.append((slot, row, target,
+                         -(-target // bs) - len(row["pages"])))
+        for slot, row, target, extra in sorted(grow,
+                                               key=lambda t: t[3]):
+            if target > self.s_max:
+                self._fail_row_paged(slot, RuntimeError(
+                    f"row exceeds engine s_max={self.s_max} at length "
+                    f"{int(self._lens[slot])}"))
+                continue
+            if extra <= 0:
+                continue
+            pages = self._alloc.allocate(extra)
+            if pages is None:
+                self._fail_row_paged(slot, RuntimeError(
+                    f"paged KV pool exhausted: needed {extra} more "
+                    f"pages, {self._alloc.num_free} free "
+                    f"(n_blocks={self.n_blocks}, bs={bs})"))
+                continue
+            start = len(row["pages"])
+            row["pages"].extend(pages)
+            self._tables[slot, start:start + extra] = pages
+        if self.idle():
+            return 0
+        st, embed, fnorm, lm = self._weights()
+        t0 = time.perf_counter()
+        toks, self._kp, self._vp = self._decode(
+            st, embed, fnorm, lm, self._scales, jnp.asarray(self._tok),
+            self._kp, self._vp, jnp.asarray(self._tables),
+            jnp.asarray(self._lens))
+        toks = _np.asarray(toks)        # [chunk, B] (fetch = sync)
+        wall = time.perf_counter() - t0
+        self.device_steps += self.chunk
+        n_busy = sum(r is not None for r in self._rows)
+        log_event("engine_chunk", steps=self.chunk, rows=n_busy,
+                  fill=int(self._lens.max()), wall_s=round(wall, 4),
+                  tokens_per_s=round(self.chunk * n_busy
+                                     / max(wall, 1e-9), 1),
+                  blocks_used=self._alloc.num_used,
+                  blocks_free=self._alloc.num_free)
+        alive = 0
+        for slot, row in enumerate(self._rows):
+            if row is None:
+                continue
+            row["toks"].extend(int(t) for t in toks[:, slot])
+            self._tok[slot] = int(toks[-1, slot])
+            req = row["req"]
+            if len(row["toks"]) >= req.max_new:
+                req.result = _np.concatenate(
+                    [row["prompt"],
+                     _np.asarray(row["toks"][:req.max_new], _np.int32)])
+                req.event.set()
+                self._retire_paged(slot)  # pages free for next admit
+            else:
+                self._lens[slot] += self.chunk
+                alive += 1
         return alive
 
 

@@ -1,0 +1,207 @@
+"""Pallas ragged paged-attention decode kernel (TPU).
+
+The serving decode path's KV cache becomes a BLOCK POOL
+``[n_blocks, block_size, kvh, hd]`` with a per-row block table instead
+of one contiguous right-aligned region (reference shape: "Ragged Paged
+Attention", arxiv 2604.15464 — the TPU-native kernel form of
+vLLM/PagedAttention). Rows own ragged per-row lengths; the kernel
+gathers each row's K/V blocks through the table, so admission never
+needs a global fill position and the DecodeEngine never resets.
+
+Design (single-query decode, one token per row):
+- q: [B, kvh, G, hd] (grouped query heads for the token being decoded)
+- k_pages/v_pages: [N, bs, kvh, hd] block pool; page 0 is the reserved
+  NULL page (allocators never hand it out; padded table entries and
+  inactive rows write there, so fixed-shape programs need no masks)
+- block_table: [B, max_blocks] int32 page ids (data argument — shapes
+  stay fixed, so the two-compiled-programs serving discipline holds)
+- seq_lens: [B] int32 valid tokens per row (ragged lengths)
+- grid (B, kvh): each program owns one (row, kv head); the row's pages
+  stream HBM→VMEM through double-buffered ``make_async_copy`` DMA with
+  the page id scalar-prefetched from the table
+  (``PrefetchScalarGridSpec``) — the flash_attention.py streaming idiom
+  applied through one level of indirection
+- online softmax (f32 m/l/acc) over the row's ceil(len/bs) blocks; the
+  ragged tail masks positions >= seq_len
+- interpret-mode CPU fallback exactly like flash_attention.py: the DMA
+  and scalar prefetch execute faithfully under ``interpret=True``, so
+  CI proves the math without a TPU
+
+The XLA fallback (`_paged_attn_reference`) gathers the row's pages into
+a contiguous view and runs the same masked softmax math as
+``models.llama._decode_attention`` — bit-matching the contiguous-cache
+decode on CPU, which is what the engine parity tests pin.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on pure-CPU builds
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # noqa: BLE001
+    pltpu = None
+    _HAS_PLTPU = False
+
+__all__ = ["paged_decode_attention", "paged_attention_pallas",
+           "NULL_PAGE"]
+
+#: page id 0 is never allocated: padded block-table entries and
+#: inactive rows read/write it, keeping every program shape-static.
+NULL_PAGE = 0
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _paged_kernel(tables, lens, q_ref, k_hbm, v_hbm, o_ref, k_s, v_s,
+                  ksem, vsem, *, bs, scale):
+    """One program = one (row, kv_head): G query rows against the row's
+    ragged page list, pages double-buffered HBM→VMEM."""
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    q = q_ref[0, 0].astype(jnp.float32)               # [G, hd]
+    g, hd = q.shape
+
+    n = lens[b]                                        # ragged row length
+    n_blk = jax.lax.div(n + bs - 1, bs)                # pages this row
+
+    def kdma(slot, j):
+        return pltpu.make_async_copy(
+            k_hbm.at[tables[b, j], :, h, :], k_s.at[slot], ksem.at[slot])
+
+    def vdma(slot, j):
+        return pltpu.make_async_copy(
+            v_hbm.at[tables[b, j], :, h, :], v_s.at[slot], vsem.at[slot])
+
+    m0 = jnp.full((g,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g,), jnp.float32)
+    acc0 = jnp.zeros((g, hd), jnp.float32)
+
+    @pl.when(n_blk > 0)
+    def _start():
+        kdma(0, 0).start()
+        vdma(0, 0).start()
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, 2)
+        nxt = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < n_blk)
+        def _prefetch():
+            kdma(nxt, j + 1).start()
+            vdma(nxt, j + 1).start()
+
+        kdma(slot, j).wait()
+        vdma(slot, j).wait()
+        k = k_s[slot]                                  # [bs, hd]
+        v = v_s[slot]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [G, bs]
+        # ragged tail: positions at or past the row's length are invalid
+        k_ids = j * bs + jax.lax.broadcasted_iota(jnp.int32, (g, bs), 1)
+        s = jnp.where(k_ids < n, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(k_ids < n, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p, v.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_blk, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(
+        o_ref.dtype)
+
+
+def paged_attention_pallas(q, k_pages, v_pages, block_table, seq_lens,
+                           interpret=False):
+    """Raw Pallas launch. q [B, kvh, G, hd]; k/v_pages [N, bs, kvh, hd];
+    block_table [B, max_blocks] int32; seq_lens [B] int32. Returns
+    [B, kvh, G, hd] f32."""
+    B, kvh, G, hd = q.shape
+    bs = k_pages.shape[1]
+    scale = 1.0 / (hd ** 0.5)
+    kernel = functools.partial(_paged_kernel, bs=bs, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, kvh),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, hd), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, hd),
+                               lambda b, h, *_: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, bs, hd), k_pages.dtype),
+            pltpu.VMEM((2, bs, hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, kvh, G, hd), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(block_table, jnp.int32),
+      jnp.asarray(seq_lens, jnp.int32), q, k_pages, v_pages)
+
+
+# ---------------------------------------------------------------------------
+# XLA reference / fallback
+# ---------------------------------------------------------------------------
+
+def gather_pages(pages, block_table):
+    """[N, bs, kvh, hd] pool + [B, max_blocks] table -> contiguous
+    per-row view [B, max_blocks*bs, kvh, hd] (padded tail reads the
+    NULL page — masked out by seq_lens downstream)."""
+    B, mb = block_table.shape
+    bs = pages.shape[1]
+    g = jnp.take(pages, block_table.reshape(-1), axis=0)
+    return g.reshape(B, mb * bs, *pages.shape[2:])
+
+
+def _paged_attn_reference(q, k_pages, v_pages, block_table, seq_lens):
+    """Gather-then-masked-softmax, the exact math of
+    models.llama._decode_attention's single-softmax branch — masked
+    entries contribute exact zeros, so contiguous-cache decode and
+    paged decode bit-match on the same tokens."""
+    ck = gather_pages(k_pages, block_table)     # [B, S, kvh, hd]
+    cv = gather_pages(v_pages, block_table)
+    s_tot = ck.shape[1]
+    mask = jnp.arange(s_tot)[None, :] < seq_lens[:, None]
+    qf = q.astype(jnp.float32)                  # [B, kvh, G, hd]
+    scale = q.shape[-1] ** 0.5
+    s = jnp.einsum("bngd,btnd->bngt", qf,
+                   ck.astype(jnp.float32)) / scale
+    s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bngt,btnd->bngd", p, cv.astype(jnp.float32))
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens):
+    """Entry used by the llama paged decode step: the Pallas kernel on
+    TPU when the block pool is tileable, else the XLA gather reference
+    (CPU tests pin the reference's bit-parity with the contiguous
+    path; the kernel's own parity is pinned in interpret mode)."""
+    bs, hd = k_pages.shape[1], k_pages.shape[3]
+    if (_HAS_PLTPU and jax.default_backend() == "tpu"
+            and hd % 128 == 0 and bs % 8 == 0):
+        return paged_attention_pallas(q, k_pages, v_pages, block_table,
+                                      seq_lens)
+    return _paged_attn_reference(q, k_pages, v_pages, block_table,
+                                 seq_lens)

@@ -34,6 +34,7 @@ from ..core.tensor import Tensor
 from ..nn import functional as F
 from ..distributed.fleet.mp_layers import shard_hint
 from ..distributed.fleet.pipeline import safe_psum  # the ONE bf16-psum shim
+from ..kernels.paged_attention import paged_decode_attention
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "llama_loss_fn",
            "LLAMA_PRESETS", "quantize_weights_int8"]
@@ -241,9 +242,10 @@ def _attention(q, k, v, causal=True, sep_manual=None, key_mask=None):
         axis, n = sep_manual
         return ring_attention_local(q, k, v, axis_name=axis, n_shards=n,
                                     causal=causal)
+    from ..utils.compat import get_abstract_mesh
     mesh = current_mesh()
     in_manual_region = bool(getattr(
-        jax.sharding.get_abstract_mesh(), "manual_axes", ()))
+        get_abstract_mesh(), "manual_axes", ()))
     if _axis_size(mesh, "sep") > 1 and not in_manual_region:
         from ..distributed.sep import sep_attention
         return sep_attention(q, k, v, causal=causal, mesh=mesh)
@@ -553,17 +555,18 @@ def _pipelined_layers(cfg, stacked, x, mesh, mesh_hint, stacked_specs=None,
             _PIPELINE_CACHE.pop(next(iter(_PIPELINE_CACHE)))
         # check_vma must stay on: disabling it demotes the region to
         # full-manual over every mesh axis, breaking partial-manual specs
+        from ..utils.compat import shard_map as _shard_map
         if key_mask is None:
-            fn = jax.jit(jax.shard_map(apply, mesh=mesh,
-                                       in_specs=(param_specs, x_spec),
-                                       out_specs=(x_spec, P()),
-                                       axis_names=manual_axes))
+            fn = jax.jit(_shard_map(apply, mesh=mesh,
+                                    in_specs=(param_specs, x_spec),
+                                    out_specs=(x_spec, P()),
+                                    axis_names=manual_axes))
         else:
-            fn = jax.jit(jax.shard_map(apply, mesh=mesh,
-                                       in_specs=(param_specs, x_spec,
-                                                 P()),
-                                       out_specs=(x_spec, P()),
-                                       axis_names=manual_axes))
+            fn = jax.jit(_shard_map(apply, mesh=mesh,
+                                    in_specs=(param_specs, x_spec,
+                                              P()),
+                                    out_specs=(x_spec, P()),
+                                    axis_names=manual_axes))
         _PIPELINE_CACHE[cache_key] = fn
     if key_mask is None:
         out, aux = fn(stacked, x_mb)
@@ -960,6 +963,92 @@ def _decode_step(cfg, stacked, embed, final_norm, lm_head, token, cache_k,
     x = _rms(x, final_norm, cfg.rms_norm_eps)
     logits = (x[:, 0] @ lm_head).astype(jnp.float32)
     return logits, cks, cvs
+
+
+def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens):
+    """One decoder layer for ONE token per row against the PAGED KV
+    cache: kp/vp [N, bs, kvh, hd] block pool, tables [b, max_blocks]
+    int32 page ids, lens [b] int32 = tokens already cached (the new
+    token's 0-based position). No left-pad: every row's history starts
+    at its own position 0, so admission needs no global fill."""
+    hd = cfg.head_dim
+    h = lp["wq"].shape[-1] // hd
+    kvh = lp["wk"].shape[-1] // hd
+    b = x.shape[0]
+    bs = kp.shape[1]
+    g = h // kvh
+    pos = lens[:, None]                      # per-row rope position
+
+    y = _rms(x, lp["input_ln"], cfg.rms_norm_eps)
+    q = y @ lp["wq"]
+    k = y @ lp["wk"]
+    v = y @ lp["wv"]
+    if "bq" in lp:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = _rope(q.reshape(b, 1, h, hd), pos, cfg.rope_theta, hd)
+    k = _rope(k.reshape(b, 1, kvh, hd), pos, cfg.rope_theta, hd)
+    v = v.reshape(b, 1, kvh, hd)
+    # append through the block table: page = tables[row, len // bs].
+    # Inactive rows carry an all-NULL table, so their writes land on the
+    # reserved page 0 — fixed shapes, no active mask.
+    page = jnp.take_along_axis(tables, (lens // bs)[:, None],
+                               axis=1)[:, 0]
+    off = lens % bs
+    kp = kp.at[page, off].set(k[:, 0].astype(kp.dtype))
+    vp = vp.at[page, off].set(v[:, 0].astype(vp.dtype))
+    qg = q[:, 0].reshape(b, kvh, g, hd)
+    attn = paged_decode_attention(qg, kp, vp, tables, lens + 1)
+    attn = attn.astype(x.dtype).reshape(b, 1, h * hd)
+    x = x + attn @ lp["wo"]
+
+    y = _rms(x, lp["post_ln"], cfg.rms_norm_eps)
+    if cfg.num_experts > 0:
+        mlp_out, _ = _moe_mlp(cfg, lp, y, lambda a, spec: a,
+                              capacity_override=b * cfg.num_experts_per_tok)
+        x = x + mlp_out
+    else:
+        gate = jax.nn.silu(y @ lp["w_gate"])
+        x = x + (gate * (y @ lp["w_up"])) @ lp["w_down"]
+    return x, kp, vp
+
+
+def _paged_decode_step(cfg, stacked, embed, final_norm, lm_head, token,
+                       pages_k, pages_v, tables, lens):
+    """Jittable paged single-token step: [b] token ids +
+    [L, N, bs, kvh, hd] block pools + [b, max_blocks] tables + [b] lens
+    -> (logits [b, V], updated pools). The tables/lens are DATA, so one
+    compiled program serves every admission pattern."""
+    x = jnp.take(embed, token, axis=0)[:, None, :]       # [b, 1, d]
+
+    def layer_fn(carry, xs):
+        lp, kp, vp = xs
+        out, kp, vp = _paged_decode_layer_step(cfg, lp, carry, kp, vp,
+                                               tables, lens)
+        return out, (kp, vp)
+
+    x, (kps, vps) = jax.lax.scan(layer_fn, x, (stacked, pages_k, pages_v))
+    x = _rms(x, final_norm, cfg.rms_norm_eps)
+    logits = (x[:, 0] @ lm_head).astype(jnp.float32)
+    return logits, kps, vps
+
+
+def scatter_prefill_kv(kp, vp, ks, vs, table_row, pad):
+    """Insert ONE row's prefill K/V into the block pools. ks/vs
+    [L, 1, sp, kvh, hd] (right-aligned, ``pad`` left pads); table_row
+    [max_blocks] int32. Pad positions are routed to the NULL page, so
+    the scatter is shape-static."""
+    bs = kp.shape[2]
+    sp = ks.shape[2]
+    j = jnp.arange(sp)
+    cpos = jnp.maximum(j - pad, 0)
+    valid = j >= pad
+    page = jnp.where(valid, jnp.take(table_row, cpos // bs), 0)
+    off = jnp.where(valid, cpos % bs, 0)
+    kp = kp.at[:, page, off].set(ks[:, 0].astype(kp.dtype))
+    vp = vp.at[:, page, off].set(vs[:, 0].astype(vp.dtype))
+    return kp, vp
 
 
 _GEN_CACHE: dict = {}

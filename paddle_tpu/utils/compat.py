@@ -1,0 +1,47 @@
+"""jax version compatibility shims.
+
+The codebase targets the jax 0.5+ surface; the pinned toolchain may
+carry an older jax where some of those names live under
+``jax.experimental`` with an earlier API. Every shim resolves the NEW
+spelling first so nothing changes on a current jax.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              axis_names=None, check_vma=None):
+    """jax.shard_map with the new keyword surface, adapted to the old
+    ``jax.experimental.shard_map.shard_map`` when needed:
+    ``axis_names`` (manual axes) becomes its complement ``auto``, and
+    ``check_vma`` maps to ``check_rep``."""
+    if hasattr(jax, "shard_map"):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+    # the old replication checker predates vma tracking: it has no rules
+    # for primitives like checkpoint_name's `name`, and (unlike new
+    # jax's check_vma=False) turning it off does NOT demote the region
+    # to full-manual. ``axis_names`` is dropped on purpose: the old
+    # ``auto=`` partial-manual lowers axis_index to a PartitionId op the
+    # SPMD partitioner rejects (UNIMPLEMENTED, and an outright abort on
+    # a compile retry). Full manual with the same specs is value-
+    # equivalent — axes the specs don't mention are replicated instead
+    # of left to GSPMD, and the body only runs collectives over the
+    # manual axes either way.
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def get_abstract_mesh():
+    """jax.sharding.get_abstract_mesh, or None before jax 0.5 (callers
+    treat None as "not inside a manual region")."""
+    fn = getattr(jax.sharding, "get_abstract_mesh", None)
+    return fn() if fn is not None else None

@@ -5,10 +5,21 @@ via multi-process on localhost; the JAX analogue is a virtual device mesh).
 Note: the axon TPU plugin ignores JAX_PLATFORMS, so we must use jax.config
 before any backend initialization."""
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices; the pre-backend-init XLA
+    # flag is the same knob under its old spelling (safe here: conftest
+    # runs before any test touches a device)
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

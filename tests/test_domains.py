@@ -341,6 +341,7 @@ class TestMoreVisionModels:
                          [1], output_size=2)
         assert np.asarray(out._value).max() == 9.0
 
+    @pytest.mark.slow  # vision-zoo builder sweep, ~0.5 min on CPU
     def test_mobilenetv1_and_densenet_forward(self):
         from paddle_tpu.vision.models import densenet121, mobilenet_v1
         paddle.seed(0)
@@ -366,6 +367,7 @@ class TestMoreVisionModels:
         out2 = net(paddle.randn([1, 3, 64, 64]))
         assert list(out2.shape) == [1, 4]
 
+    @pytest.mark.slow  # vision-zoo builder sweep, ~0.5 min on CPU
     def test_mobilenetv3_forward(self):
         from paddle_tpu.vision.models import (mobilenet_v3_large,
                                               mobilenet_v3_small)

@@ -114,7 +114,10 @@ class TestToStatic:
 
             def forward(self, x):
                 h = self.fc1(x)
-                if float(paddle.sum(h)) > 0:     # host round trip: break
+                # branch on the INPUT sign (not the RNG-dependent
+                # weights) so x/xneg deterministically take different
+                # paths on any jax PRNG
+                if float(paddle.sum(x)) > 0:     # host round trip: break
                     h = h * 2.0
                 return self.fc2(h)
 

@@ -29,6 +29,7 @@ class TestResNet:
         grads = [p.grad for p in m.parameters() if not p.stop_gradient]
         assert all(g is not None for g in grads)
 
+    @pytest.mark.slow  # vision-zoo builder sweep, ~0.5 min on CPU
     def test_mobilenet_vgg_construct(self):
         from paddle_tpu.vision.models import mobilenet_v2, vgg11
         m = mobilenet_v2(num_classes=5)
@@ -262,6 +263,7 @@ class TestInceptionFamilies:
         assert isinstance(outs, list) and len(outs) == 3
         assert all(o.shape == [1, 6] for o in outs)
 
+    @pytest.mark.slow  # vision-zoo builder sweep, ~0.5 min on CPU
     def test_inception_v3_forward(self):
         from paddle_tpu.vision.models import inception_v3
         m = inception_v3(num_classes=5)
@@ -269,6 +271,7 @@ class TestInceptionFamilies:
         out = m(paddle.randn([1, 3, 299, 299]))
         assert out.shape == [1, 5]
 
+    @pytest.mark.slow  # vision-zoo builder sweep, ~0.5 min on CPU
     def test_new_variants_construct(self):
         from paddle_tpu.vision.models import (
             resnext50_64x4d, shufflenet_v2_x0_33, shufflenet_v2_swish,
@@ -288,6 +291,8 @@ class TestInceptionFamilies:
         """Every builder in the reference vision.models __all__ exists."""
         import re, pathlib
         import paddle_tpu.vision.models as M
+        if not pathlib.Path("/root/reference").exists():
+            pytest.skip("reference Paddle checkout not present")
         ref = pathlib.Path("/root/reference/python/paddle/vision/models/"
                            "__init__.py").read_text()
         names = set(re.findall(r"'([A-Za-z_][A-Za-z0-9_]*)'", ref))

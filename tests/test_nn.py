@@ -473,6 +473,8 @@ class TestExtraLayers:
 
     def test_nn_parity_vs_reference(self):
         import re, pathlib
+        if not pathlib.Path("/root/reference").exists():
+            pytest.skip("reference Paddle checkout not present")
         for mod, path in [(nn, "nn/__init__.py"),
                           (F, "nn/functional/__init__.py")]:
             ref = pathlib.Path(

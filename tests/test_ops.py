@@ -553,6 +553,8 @@ class TestFrameworkShims:
     def test_top_level_parity_vs_reference(self):
         """Every name in the reference's top-level __all__ exists."""
         import re, pathlib
+        if not pathlib.Path("/root/reference").exists():
+            pytest.skip("reference Paddle checkout not present")
         ref = pathlib.Path(
             "/root/reference/python/paddle/__init__.py").read_text()
         names = set(re.findall(r"^\s+'([A-Za-z_][A-Za-z0-9_]*)',\s*$",
@@ -566,6 +568,8 @@ class TestTensorMethodParity:
         """Every method in the reference tensor/__init__.py
         tensor_method_func list exists on Tensor."""
         import re, pathlib
+        if not pathlib.Path("/root/reference").exists():
+            pytest.skip("reference Paddle checkout not present")
         t = paddle.to_tensor([1.0])
         ref = pathlib.Path(
             "/root/reference/python/paddle/tensor/__init__.py").read_text()

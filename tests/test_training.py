@@ -228,8 +228,11 @@ class TestAmp:
         assert out.dtype == jnp.float32
 
     def test_grad_scaler_fp16_flow(self):
+        # seeded, and lr kept below the oscillation threshold: the test
+        # checks the scale/backward/step flow, not SGD at a hot lr
+        paddle.seed(0)
         model = MLP()
-        opt = paddle.optimizer.SGD(learning_rate=0.05,
+        opt = paddle.optimizer.SGD(learning_rate=0.02,
                                    parameters=model.parameters())
         scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
         x, y = make_blobs()
