@@ -13,6 +13,15 @@ Policy: LIFO free list (hot pages stay hot in HBM), O(1) allocate and
 free, loud double-free / unknown-page errors — an aliased page would
 silently corrupt another row's KV history, the one failure mode a paged
 cache must never have.
+
+Pages are REFCOUNTED (prefix-sharing layer, ISSUE 2): ``allocate``
+hands out pages at refcount 1; the radix prefix cache and every row
+that maps a shared page take additional references with :meth:`incref`
+and drop them with :meth:`decref`. A page returns to the free list only
+when its last reader lets go. ``free`` keeps its r6 loud-error
+semantics and additionally refuses to free a page something else still
+references — sharing makes a unilateral free exactly the aliasing bug
+the allocator exists to prevent.
 """
 
 from __future__ import annotations
@@ -23,7 +32,8 @@ __all__ = ["BlockAllocator"]
 
 
 class BlockAllocator:
-    """Free-list over page ids ``1..n_blocks-1`` (page 0 = NULL)."""
+    """Refcounted free-list over page ids ``1..n_blocks-1`` (page 0 =
+    NULL)."""
 
     def __init__(self, n_blocks: int):
         if n_blocks < 2:
@@ -33,7 +43,11 @@ class BlockAllocator:
         self.n_blocks = int(n_blocks)
         # LIFO: freed pages are reused first
         self._free = list(range(self.n_blocks - 1, NULL_PAGE, -1))
-        self._used: set[int] = set()
+        self._rc: dict[int, int] = {}   # page -> live reference count
+        self.high_watermark = 0         # max pages ever in use at once
+        self.total_allocated = 0        # cumulative allocate() pages —
+        #                                 prefix hits show up as a FLAT
+        #                                 counter across re-submissions
 
     @property
     def capacity(self) -> int:
@@ -46,32 +60,66 @@ class BlockAllocator:
 
     @property
     def num_used(self) -> int:
-        return len(self._used)
+        return len(self._rc)
+
+    def refcount(self, page: int) -> int:
+        """Live references on ``page`` (0 = not allocated)."""
+        return self._rc.get(page, 0)
 
     def allocate(self, n: int) -> list[int] | None:
-        """n pages, all-or-nothing. None when the pool can't cover it
-        (caller decides: defer admission, or fail the one row that
-        needed growth)."""
+        """n pages at refcount 1, all-or-nothing. None when the pool
+        can't cover it (caller decides: defer admission, evict cached
+        pages, preempt a row, or fail the one row that needed growth)."""
         if n < 0:
             raise ValueError(f"allocate({n})")
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
-        self._used.update(pages)
+        for p in pages:
+            self._rc[p] = 1
+        self.total_allocated += n
+        self.high_watermark = max(self.high_watermark, len(self._rc))
         return pages
 
+    def incref(self, page: int) -> None:
+        """A new reader maps an already-allocated page (prefix hit)."""
+        if page not in self._rc:
+            raise ValueError(
+                f"incref of page {page} which is not allocated")
+        self._rc[page] += 1
+
+    def decref(self, page: int) -> None:
+        """Drop one reference; the last reference frees the page."""
+        rc = self._rc.get(page)
+        if rc is None:
+            raise ValueError(
+                f"decref of page {page} which is not allocated "
+                f"(double-free or foreign id)")
+        if rc > 1:
+            self._rc[page] = rc - 1
+        else:
+            del self._rc[page]
+            self._free.append(page)
+
     def free(self, pages) -> None:
-        """Return a row's pages. Double-free and foreign ids raise —
-        both would alias live KV history."""
+        """Return a row's EXCLUSIVELY-owned pages. Double-free, foreign
+        ids, and shared pages raise — all three would alias live KV
+        history. (Shared pages must go through decref.)"""
         for p in pages:
-            if p not in self._used:
+            rc = self._rc.get(p)
+            if rc is None:
                 raise ValueError(
                     f"free of page {p} which is not allocated "
                     f"(double-free or foreign id)")
-            self._used.discard(p)
+            if rc != 1:
+                raise ValueError(
+                    f"free of page {p} with {rc} live references — "
+                    f"shared pages release via decref")
+            del self._rc[p]
             self._free.append(p)
 
     def stats(self) -> dict:
         """Occupancy snapshot (bench/engine observability)."""
         return {"capacity": self.capacity, "used": self.num_used,
-                "free": self.num_free}
+                "free": self.num_free,
+                "high_watermark": self.high_watermark}

@@ -1,0 +1,224 @@
+"""Prefix-sharing radix cache over the paged KV pool (ISSUE 2 tentpole;
+reference shape: vLLM/SGLang RadixAttention — a radix tree over token-id
+sequences at PAGE granularity, refcounts layered into the block
+allocator, copy-on-write for partially-shared pages, LRU eviction of
+unreferenced leaves).
+
+Everything here is HOST-side bookkeeping: the tree maps token prefixes
+to page ids inside the device block pool; it never touches device
+memory. The DecodeEngine consults :meth:`PrefixCache.match` at
+admission (seeding the row's block table from cached pages and
+prefilling only the uncached tail), and :meth:`PrefixCache.insert` at
+retire/preempt (publishing the row's now-immutable prefix pages).
+
+Granularity rules:
+- INTERIOR nodes cover exactly ``block_size`` tokens. Their pages are
+  shared READ-ONLY — a row that matches them maps them into its table
+  and takes a reference; its own writes start strictly after them.
+- A node shorter than ``block_size`` is a LEAF (a partially-filled
+  page). A leaf can never be mapped shared, because the matching row's
+  next token writes into that very page: the row gets a COPY-ON-WRITE
+  private copy instead (the engine copies the page on device, the tree
+  is untouched).
+- Ownership: the tree holds ONE reference per node page. Eviction
+  (LRU, childless nodes only, cascading upward) drops that reference;
+  the allocator frees the page when no row still reads it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .paged_cache import BlockAllocator
+
+__all__ = ["PrefixCache", "PrefixMatch"]
+
+
+@dataclass
+class PrefixMatch:
+    """One admission's view of the cache: ``pages`` are full shared
+    pages (a reference is held on each), ``cow_src`` an optional
+    partially-matching page to copy privately (also referenced), and
+    ``cached_len`` the total matched token count
+    (``len(pages) * block_size + cow_len``)."""
+
+    pages: list[int] = field(default_factory=list)
+    cow_src: int | None = None
+    cow_len: int = 0
+
+    @property
+    def cached_len(self) -> int:
+        return self._full_tokens + self.cow_len
+
+    _full_tokens: int = 0
+
+
+class _Node:
+    __slots__ = ("key", "page", "children", "parent", "clock")
+
+    def __init__(self, key, page, parent):
+        self.key = key                  # tuple of token ids, len <= bs
+        self.page = page
+        self.children = {}              # key tuple -> _Node
+        self.parent = parent
+        self.clock = 0
+
+
+class PrefixCache:
+    """Radix tree of cached KV pages, keyed by token ids."""
+
+    def __init__(self, alloc: BlockAllocator, block_size: int):
+        self._alloc = alloc
+        self._bs = int(block_size)
+        self._root = _Node((), None, None)
+        self._clock = 0                 # LRU tick (touch on match/insert)
+        self._n_nodes = 0
+        self.hits = 0                   # matches with cached_len > 0
+        self.queries = 0
+        self.evicted_pages = 0
+
+    def __len__(self) -> int:
+        return self._n_nodes
+
+    @property
+    def num_pages(self) -> int:
+        return self._n_nodes
+
+    def _tick(self, node: _Node) -> None:
+        self._clock += 1
+        node.clock = self._clock
+
+    # -- lookup -------------------------------------------------------------
+    def match(self, tokens, limit: int) -> PrefixMatch:
+        """Longest cached prefix of ``tokens[:limit]``.
+
+        Walks full-page children exactly, then picks the child with the
+        longest common partial prefix as a COW source. References are
+        taken on every returned page — the caller MUST either adopt
+        them (map the full pages into a row's table, copy the COW page
+        then :meth:`release_cow`) or give everything back via
+        :meth:`release`. ``limit`` caps the match so the admitting row
+        always keeps at least one uncached token to prefill (logits
+        need a real forward position)."""
+        bs = self._bs
+        tokens = [int(t) for t in tokens]
+        self.queries += 1
+        m = PrefixMatch()
+        node = self._root
+        f = 0
+        while (f + 1) * bs <= limit:
+            key = tuple(tokens[f * bs:(f + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                break
+            self._alloc.incref(child.page)
+            self._tick(child)
+            m.pages.append(child.page)
+            node = child
+            f += 1
+        m._full_tokens = f * bs
+        # partial tail: longest common prefix against any child
+        cap = min(bs, limit - f * bs)
+        best_t, best = 0, None
+        for child in node.children.values():
+            t = 0
+            for a, b in zip(child.key, tokens[f * bs:f * bs + cap]):
+                if a != b:
+                    break
+                t += 1
+            if t > best_t:
+                best_t, best = t, child
+        if best is not None:
+            self._alloc.incref(best.page)
+            self._tick(best)
+            m.cow_src = best.page
+            m.cow_len = best_t
+        if m.cached_len:
+            self.hits += 1
+        return m
+
+    def release_cow(self, m: PrefixMatch) -> None:
+        """Drop the COW-source reference (after the device copy, or when
+        the caller decides not to use it)."""
+        if m.cow_src is not None:
+            self._alloc.decref(m.cow_src)
+            m.cow_src = None
+            m.cow_len = 0
+
+    def release(self, m: PrefixMatch) -> None:
+        """Give back every reference ``match`` took (admission failed)."""
+        for p in m.pages:
+            self._alloc.decref(p)
+        m.pages = []
+        m._full_tokens = 0
+        self.release_cow(m)
+
+    # -- publish ------------------------------------------------------------
+    def insert(self, tokens, pages) -> int:
+        """Publish a retiring/preempted row's prefix: ``tokens`` are the
+        ids whose KV is VALID in ``pages`` (``ceil(len(tokens)/bs)``
+        pages, in table order). First-wins: segments already cached keep
+        their incumbent page (the row's duplicate page simply loses its
+        last reference when the row releases). The tree takes one
+        reference per adopted page. Returns the number of pages
+        adopted."""
+        bs = self._bs
+        tokens = [int(t) for t in tokens]
+        node = self._root
+        adopted = 0
+        n_full = len(tokens) // bs
+        for i in range(n_full):
+            key = tuple(tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key, pages[i], node)
+                self._alloc.incref(pages[i])
+                node.children[key] = child
+                self._n_nodes += 1
+                adopted += 1
+            self._tick(child)
+            node = child
+        rem = len(tokens) - n_full * bs
+        if rem:
+            key = tuple(tokens[n_full * bs:])
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key, pages[n_full], node)
+                self._alloc.incref(pages[n_full])
+                node.children[key] = child
+                self._n_nodes += 1
+                adopted += 1
+            self._tick(child)
+        return adopted
+
+    # -- reclaim ------------------------------------------------------------
+    def evict(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` pages by dropping LRU UNREFERENCED
+        childless nodes (refcount 1 = only the tree's own reference).
+        Removing a leaf can expose its parent; the scan loops until the
+        target is met or nothing evictable remains. Returns pages
+        actually freed."""
+        freed = 0
+        while freed < n_pages:
+            victim = None
+            stack = [self._root]
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                if (node is not self._root and not node.children
+                        and self._alloc.refcount(node.page) == 1
+                        and (victim is None or node.clock < victim.clock)):
+                    victim = node
+            if victim is None:
+                break
+            del victim.parent.children[victim.key]
+            self._alloc.decref(victim.page)     # rc 1 -> page freed
+            self._n_nodes -= 1
+            freed += 1
+        self.evicted_pages += freed
+        return freed
+
+    def stats(self) -> dict:
+        return {"nodes": self._n_nodes, "hits": self.hits,
+                "queries": self.queries,
+                "evicted_pages": self.evicted_pages}

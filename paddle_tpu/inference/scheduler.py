@@ -1,0 +1,66 @@
+"""Request scheduler for the paged DecodeEngine (ISSUE 2 tentpole;
+reference shape: vLLM's Scheduler — priority + FCFS admission over a
+shared block pool, with preemption-and-recompute when the pool runs
+dry).
+
+The scheduler owns the PENDING side only: a priority queue of requests
+not yet holding a slot. Ordering is (priority desc, arrival order asc);
+a preempted request re-enters with its ORIGINAL arrival sequence, so
+preemption never costs a request its FCFS position. Admission charging
+(only the uncached suffix pages) and the preemption policy itself live
+in the engine — the scheduler just answers "who goes next".
+"""
+
+from __future__ import annotations
+
+import heapq
+
+__all__ = ["RequestScheduler"]
+
+
+class RequestScheduler:
+    """Priority + FCFS queue of pending generation requests.
+
+    Requests may carry a ``priority`` attribute (int, higher = sooner;
+    default 0). The first :meth:`add` stamps the request with a
+    monotonic arrival sequence used as the FCFS tiebreaker and kept for
+    life — re-queued (preempted) requests resume their original place
+    among equal priorities."""
+
+    def __init__(self):
+        self._heap: list = []
+        self._arrivals = 0
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __bool__(self) -> bool:
+        return bool(self._heap)
+
+    def add(self, req) -> None:
+        if getattr(req, "_sched_seq", None) is None:
+            req._sched_seq = self._arrivals
+            self._arrivals += 1
+        prio = int(getattr(req, "priority", 0) or 0)
+        heapq.heappush(self._heap, (-prio, req._sched_seq, req))
+
+    def peek(self):
+        """Highest-priority, earliest-arrival pending request (None when
+        empty). Does not remove it — admission peeks, tries to fund the
+        pages, and only pops on success (head-of-line blocking is the
+        POINT: a starved high-priority request must not be overtaken by
+        cheaper later ones)."""
+        return self._heap[0][2] if self._heap else None
+
+    def pop(self):
+        if not self._heap:
+            raise IndexError("pop from an empty RequestScheduler")
+        return heapq.heappop(self._heap)[2]
+
+    def drain(self) -> list:
+        """Remove and return every pending request in queue order
+        (server shutdown: fail them all loudly)."""
+        out = []
+        while self._heap:
+            out.append(heapq.heappop(self._heap)[2])
+        return out

@@ -58,7 +58,8 @@ class DecodeEngine:
     construction-time 1)."""
 
     def __init__(self, model, capacity=4, s_max=256, chunk=8, pad_id=0,
-                 paged=True, block_size=16, n_blocks=None):
+                 paged=True, block_size=16, n_blocks=None,
+                 prefix_cache=True):
         from ..distributed.fleet.mp_layers import current_mesh
         from ..models.llama import _pp_degree
         if _pp_degree(current_mesh()) > 1:
@@ -73,7 +74,10 @@ class DecodeEngine:
         self.pad_id = int(pad_id)
         self.paged = bool(paged)
         self.block_size = int(block_size)
+        self._prefix_on = bool(prefix_cache) and self.paged
+        self._sched = None
         if self.paged:
+            from .scheduler import RequestScheduler
             # table width covers within-chunk overflow writes of rows
             # that finish mid-chunk (their tail lands on the NULL page)
             self._max_blocks = -(-(self.s_max + self.chunk)
@@ -84,9 +88,12 @@ class DecodeEngine:
                 n_blocks = self.capacity * -(-self.s_max
                                              // self.block_size) + 1
             self.n_blocks = int(n_blocks)
+            self._sched = RequestScheduler()
         self.device_steps = 0           # decode steps actually executed
         self.prefills = 0
         self.resets = 0                 # cache resets (init counts as 1)
+        self._counters = {"admitted": 0, "retired": 0, "failed": 0,
+                          "preempted": 0, "prefix_hit_tokens": 0}
         self._build()
         self._reset()
 
@@ -188,12 +195,43 @@ class DecodeEngine:
                 body, (tok, kp, vp), jnp.arange(self.chunk))
             return toks, kp, vp
 
+        def make_prefix_prefill(sc):
+            """Prefix-hit prefill over a BUCKETED tail window of ``sc``
+            slots: the cached prefix stays in the pool, only the
+            uncached tail runs the forward — the TTFT win prefix
+            sharing exists for. One program per bucket (powers of two),
+            cold admissions keep the untouched full-window program."""
+
+            def prefill_prefix(stacked, embed, fnorm, lm, scales, ids,
+                               pad_len, prefix_len, kp, vp, table_row):
+                stacked, lm = _llama._dequantize_weights(cfg, stacked,
+                                                         lm, scales)
+                if lm is None:
+                    lm = embed.T
+                logits, kp, vp = _llama.prefix_prefill(
+                    cfg, stacked, embed, fnorm, lm, ids, pad_len,
+                    prefix_len, kp, vp, table_row)
+                return jnp.argmax(logits, axis=-1), kp, vp
+
+            return prefill_prefix
+
+        def cow_copy(kp, vp, src, dst):
+            """Copy-on-write: clone page ``src`` into the row's private
+            page ``dst`` (both pools, all layers). src/dst are DATA, so
+            every COW admission reuses this one program."""
+            kp = kp.at[:, dst].set(kp[:, src])
+            vp = vp.at[:, dst].set(vp[:, src])
+            return kp, vp
+
         self._make_decode = make_decode
         self._decode_progs = {}
+        self._make_prefix_prefill = make_prefix_prefill
+        self._prefix_progs = {}
         if self.paged:
             self._prefill = jax.jit(prefill_paged)
             self._decode = jax.jit(decode_chunk_paged,
                                    donate_argnums=(6, 7))
+            self._cow = jax.jit(cow_copy, donate_argnums=(0, 1))
         else:
             self._prefill = jax.jit(prefill)
             self._decode = self._decode_for(self.chunk)
@@ -215,6 +253,25 @@ class DecodeEngine:
             self._decode_progs[n] = fn
         return fn
 
+    def _bucket_window(self, n: int) -> int:
+        """Tail-window bucket for prefix-hit prefill: powers of two from
+        16, capped at s_max — mixed tail lengths share a few compiled
+        programs, and the bucket being SMALLER than the full s_max
+        window is where the cached-TTFT win comes from."""
+        b = 16
+        while b < n:
+            b *= 2
+        return min(b, self.s_max)
+
+    def _prefix_prefill_for(self, sc):
+        import jax
+        fn = self._prefix_progs.get(sc)
+        if fn is None:
+            fn = jax.jit(self._make_prefix_prefill(sc),
+                         donate_argnums=(8, 9))
+            self._prefix_progs[sc] = fn
+        return fn
+
     def _reset(self):
         import jax.numpy as jnp
         import numpy as _np
@@ -222,11 +279,14 @@ class DecodeEngine:
         B = self.capacity
         if self.paged:
             from .paged_cache import BlockAllocator
+            from .prefix_cache import PrefixCache
             self._kp = jnp.zeros((self._L, self.n_blocks,
                                   self.block_size, self._kvh,
                                   self._hd), self._cache_dtype)
             self._vp = jnp.zeros_like(self._kp)
             self._alloc = BlockAllocator(self.n_blocks)
+            self._cache = PrefixCache(self._alloc, self.block_size) \
+                if self._prefix_on else None
             self._tables = _np.zeros((B, self._max_blocks), _np.int32)
             self._lens = _np.zeros((B,), _np.int32)
         else:
@@ -239,22 +299,58 @@ class DecodeEngine:
         self._rows = [None] * B         # per-slot host state
 
     # -- engine loop pieces -------------------------------------------------
-    def idle(self) -> bool:
+    def _no_rows(self) -> bool:
         return all(r is None for r in self._rows)
+
+    def idle(self) -> bool:
+        """Nothing to do: no live rows AND no scheduler backlog (a
+        request waiting on pages is work in flight, not idleness — the
+        serving loop and drive harnesses key off this)."""
+        return self._no_rows() and not self.backlog
+
+    @property
+    def backlog(self) -> int:
+        """Requests the scheduler holds that no slot/pages could fund
+        yet."""
+        return len(self._sched) if self._sched is not None else 0
+
+    def drain_pending(self) -> list:
+        """Remove and return every scheduled-but-unadmitted request
+        (server shutdown path)."""
+        return self._sched.drain() if self._sched is not None else []
+
+    def stats(self) -> dict:
+        """Engine observability: lifecycle counters plus pool occupancy
+        (including the allocator's high-watermark) and prefix-cache hit
+        accounting."""
+        s = dict(self._counters)
+        s.update(device_steps=self.device_steps, prefills=self.prefills,
+                 resets=self.resets)
+        if self.paged:
+            s["pool"] = self._alloc.stats()
+            s["backlog"] = self.backlog
+            if self._cache is not None:
+                s["prefix_cache"] = self._cache.stats()
+        return s
 
     def admit(self, pending):
         """Move requests from ``pending`` (a list; consumed in order)
-        into free slots. Paged mode: any free slot with enough free
-        pages admits immediately — there is no global fill to respect;
-        when pages run short admission WAITS (retiring rows free
-        theirs). Contiguous mode: a prompt longer than the current
-        global fill can only start when the engine is empty (its
-        left-pad would rewind other rows' history)."""
+        into free slots. Paged mode: every request enters the
+        RequestScheduler (priority + FCFS) and admission runs highest
+        priority first, charging only the UNCACHED suffix pages after a
+        prefix-cache match; when the pool runs short, unreferenced
+        cached pages are evicted and strictly-lower-priority running
+        rows are preempted for recompute-resume before admission waits.
+        Contiguous mode: a prompt longer than the current global fill
+        can only start when the engine is empty (its left-pad would
+        rewind other rows' history)."""
         import jax
         import jax.numpy as jnp
         import numpy as _np
         if self.paged:
-            return self._admit_paged(pending)
+            while pending:
+                self._sched.add(pending.pop(0))
+            return self._admit_scheduled()
         if self.idle() and pending:
             # fresh fill: size it to the whole first wave so a longer
             # second prompt is not head-of-line deferred behind a
@@ -270,10 +366,9 @@ class DecodeEngine:
             n = pending[0].ids.reshape(-1).size
             if n > self.s_max - self.chunk:
                 req = pending.pop(0)
-                req.error = ValueError(
+                self._fail_request(req, ValueError(
                     f"prompt of {n} tokens exceeds engine s_max="
-                    f"{self.s_max}")
-                req.event.set()
+                    f"{self.s_max}"))
                 continue
             if n > self._g:
                 if not self.idle():
@@ -290,10 +385,10 @@ class DecodeEngine:
                     st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
                     jnp.asarray([pad], jnp.int32), self._g)
             except Exception as e:  # noqa: BLE001 — fail THIS request,
-                req.error = e       # not the whole engine
-                req.event.set()
+                self._fail_request(req, e)  # not the whole engine
                 continue
             self.prefills += 1
+            self._counters["admitted"] += 1
             # insert this row's lane: [L, 1, sc, kvh, hd] -> slot
             self._ck = jax.lax.dynamic_update_slice(
                 self._ck, ks.astype(self._ck.dtype), (0, slot, 0, 0, 0))
@@ -305,50 +400,217 @@ class DecodeEngine:
             self._rows[slot] = {"req": req, "prompt": prompt,
                                 "toks": [first_tok]}
 
-    def _admit_paged(self, pending):
+    # -- paged admission: scheduler + prefix cache + preemption -------------
+    @staticmethod
+    def _prio(req) -> int:
+        return int(getattr(req, "priority", 0) or 0)
+
+    def _fail_request(self, req, err):
+        req.error = err
+        req.event.set()
+        self._counters["failed"] += 1
+
+    def _pick_victim(self, prio, exclude=None):
+        """Slot of the running row to preempt for a priority-``prio``
+        claimant: STRICTLY lower priority only (equal priorities wait
+        instead — no preemption cycles), lowest priority first, newest
+        arrival first among equals. None when no row qualifies."""
+        best = None
+        for slot, row in enumerate(self._rows):
+            if row is None or slot == exclude:
+                continue
+            p = self._prio(row["req"])
+            if p >= prio:
+                continue
+            if best is None or (p, -row["req"]._sched_seq) < \
+                    (self._prio(self._rows[best]["req"]),
+                     -self._rows[best]["req"]._sched_seq):
+                best = slot
+        return best
+
+    def _release_row_pages(self, row):
+        """Drop the row's reference on every page it maps (shared prefix
+        pages survive under the cache's/other rows' references; private
+        pages return to the free list)."""
+        for p in row["pages"]:
+            self._alloc.decref(p)
+
+    def _cached_seq(self, row):
+        """The token sequence whose KV is resident for the row right
+        now: prompt plus all emitted tokens except the last (the last
+        token is the next decode input — its KV is written by the next
+        step). Length == lens[slot] by the engine invariant."""
+        import numpy as _np
+        return _np.concatenate(
+            [row["prompt"],
+             _np.asarray(row["toks"][:-1], _np.int32)]) \
+            if len(row["toks"]) > 1 else row["prompt"]
+
+    def _preempt_row(self, slot):
+        """Evict a running row for recompute-resume: publish its
+        resident prefix to the cache (kept as cached prefix — eviction
+        reclaims it page-by-page only as the pool actually needs),
+        release the row's references, and re-queue the request with its
+        emitted tokens so resumption is lossless."""
+        bs = self.block_size
+        row = self._rows[slot]
+        req = row["req"]
+        valid = int(self._lens[slot])
+        if self._cache is not None and valid > 0:
+            seq = self._cached_seq(row)[:valid]
+            self._cache.insert(seq, row["pages"][:-(-valid // bs)])
+        self._release_row_pages(row)
+        req._resume_toks = list(row["toks"])
+        self._counters["preempted"] += 1
+        self._tables[slot] = 0
+        self._lens[slot] = 0
+        self._tok[slot] = 0
+        self._rows[slot] = None
+        self._sched.add(req)
+
+    def _reclaim_allocate(self, need, prio, exclude=None):
+        """allocate() with reclamation: evict unreferenced cached pages
+        first, then preempt strictly-lower-priority rows (each
+        preemption parks its pages in the cache, so the follow-up evict
+        actually frees them). None when the pool still can't cover
+        ``need``."""
+        pages = self._alloc.allocate(need)
+        if pages is not None:
+            return pages
+        if self._cache is not None:
+            self._cache.evict(need - self._alloc.num_free)
+            pages = self._alloc.allocate(need)
+            if pages is not None:
+                return pages
+        while True:
+            victim = self._pick_victim(prio, exclude=exclude)
+            if victim is None:
+                return None
+            self._preempt_row(victim)
+            if self._cache is not None:
+                self._cache.evict(need - self._alloc.num_free)
+            pages = self._alloc.allocate(need)
+            if pages is not None:
+                return pages
+
+    def _admit_scheduled(self):
+        import numpy as _np
+        bs = self.block_size
+        while self._sched:
+            slot = next((i for i, r in enumerate(self._rows)
+                         if r is None), None)
+            if slot is None:
+                return              # no slot: wait for a retire
+            req = self._sched.peek()
+            prompt = req.ids.reshape(-1).astype(_np.int32)
+            n = prompt.size
+            if n > self.s_max - self.chunk:
+                self._sched.pop()
+                self._fail_request(req, ValueError(
+                    f"prompt of {n} tokens exceeds engine s_max="
+                    f"{self.s_max}"))
+                continue
+            resume = getattr(req, "_resume_toks", None)
+            # the sequence that must be KV-resident before decode runs:
+            # prompt + emitted tokens minus the last (= the next input)
+            seq = prompt if not resume else _np.concatenate(
+                [prompt, _np.asarray(resume[:-1], _np.int32)])
+            ns = seq.size
+            total_need = -(-ns // bs)
+            m = self._cache.match(seq, ns - 1) \
+                if self._cache is not None else None
+            f = len(m.pages) if m is not None else 0
+            pages = self._reclaim_allocate(total_need - f,
+                                           self._prio(req))
+            if pages is None and m is not None and m.cached_len:
+                # the match's own references pin otherwise-evictable
+                # pages: retry COLD so the infeasibility test below is
+                # exact
+                self._cache.release(m)
+                m, f = None, 0
+                pages = self._reclaim_allocate(total_need,
+                                               self._prio(req))
+            if pages is None:
+                if m is not None:
+                    self._cache.release(m)
+                if self._no_rows():
+                    # nothing left to retire/evict/preempt — the pool
+                    # genuinely cannot hold this request
+                    self._sched.pop()
+                    self._fail_request(req, RuntimeError(
+                        f"prompt needs {total_need} pages but the pool "
+                        f"holds {self._alloc.capacity} "
+                        f"(n_blocks={self.n_blocks}, bs={bs})"))
+                    continue
+                return          # wait: running rows will free pages
+            self._sched.pop()
+            # snapshot BEFORE the prefill: release_cow inside it zeroes
+            # the match's cow_len, which would undercount the hit
+            hit_tokens = m.cached_len if m is not None else 0
+            try:
+                first_tok = self._prefill_row(slot, seq, m, pages)
+            except Exception as e:  # noqa: BLE001 — fail THIS request,
+                if m is not None:   # not the whole engine
+                    self._cache.release(m)
+                self._alloc.free(pages)
+                self._fail_request(req, e)
+                continue
+            all_pages = (m.pages if m is not None else []) + pages
+            toks = list(resume) if resume else [first_tok]
+            req._resume_toks = None
+            self.prefills += 1
+            self._counters["admitted"] += 1
+            self._counters["prefix_hit_tokens"] += hit_tokens
+            self._lens[slot] = ns
+            self._tok[slot] = toks[-1]
+            self._rows[slot] = {"req": req, "prompt": prompt,
+                                "toks": toks, "pages": all_pages}
+
+    def _prefill_row(self, slot, seq, m, pages):
+        """Run the admission prefill for ``seq`` into ``pages`` (plus
+        the match's shared pages), seeding the slot's block table.
+        Cold (no cached prefix): the untouched full-window program.
+        Prefix hit: COW-copy the partially-shared page if any, then the
+        position-offset tail prefill over a bucketed window. Returns
+        the argmax token at the last real position."""
         import jax.numpy as jnp
         import numpy as _np
         bs = self.block_size
-        for slot in range(self.capacity):
-            if self._rows[slot] is not None or not pending:
-                continue
-            n = pending[0].ids.reshape(-1).size
-            if n > self.s_max - self.chunk:
-                req = pending.pop(0)
-                req.error = ValueError(
-                    f"prompt of {n} tokens exceeds engine s_max="
-                    f"{self.s_max}")
-                req.event.set()
-                continue
-            need = -(-n // bs)
-            pages = self._alloc.allocate(need)
-            if pages is None:
-                break       # pool short: wait for retiring rows' pages
-            req = pending.pop(0)
-            try:
-                ids = _np.full((1, self.s_max), self.pad_id, _np.int32)
-                prompt = req.ids.reshape(-1).astype(_np.int32)
-                ids[0, self.s_max - n:] = prompt
-                pad = self.s_max - n
-                table_row = _np.zeros((self._max_blocks,), _np.int32)
-                table_row[:need] = pages
-                st, embed, fnorm, lm = self._weights()
-                first, self._kp, self._vp = self._prefill(
-                    st, embed, fnorm, lm, self._scales,
-                    jnp.asarray(ids), jnp.asarray([pad], jnp.int32),
-                    self._kp, self._vp, jnp.asarray(table_row))
-            except Exception as e:  # noqa: BLE001 — fail THIS request,
-                self._alloc.free(pages)  # not the whole engine
-                req.error = e
-                req.event.set()
-                continue
-            self.prefills += 1
-            self._tables[slot] = table_row
-            self._lens[slot] = n
-            first_tok = int(first[0])
-            self._tok[slot] = first_tok
-            self._rows[slot] = {"req": req, "prompt": prompt,
-                                "toks": [first_tok], "pages": pages}
+        ns = seq.size
+        cached = m.cached_len if m is not None else 0
+        table_row = _np.zeros((self._max_blocks,), _np.int32)
+        allp = (m.pages if m is not None else []) + pages
+        table_row[:len(allp)] = allp
+        st, embed, fnorm, lm = self._weights()
+        if cached == 0:
+            ids = _np.full((1, self.s_max), self.pad_id, _np.int32)
+            ids[0, self.s_max - ns:] = seq
+            pad = self.s_max - ns
+            first, self._kp, self._vp = self._prefill(
+                st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
+                jnp.asarray([pad], jnp.int32), self._kp, self._vp,
+                jnp.asarray(table_row))
+        else:
+            if m.cow_src is not None:
+                # private copy of the partially-shared page: the tail's
+                # first write lands mid-page at position ``cached``
+                self._kp, self._vp = self._cow(
+                    self._kp, self._vp,
+                    jnp.asarray(m.cow_src, jnp.int32),
+                    jnp.asarray(pages[0], jnp.int32))
+                self._cache.release_cow(m)
+            tail = seq[cached:]
+            sc = self._bucket_window(tail.size)
+            ids = _np.full((1, sc), self.pad_id, _np.int32)
+            ids[0, sc - tail.size:] = tail
+            pad = sc - tail.size
+            first, self._kp, self._vp = self._prefix_prefill_for(sc)(
+                st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
+                jnp.asarray([pad], jnp.int32),
+                jnp.asarray([cached], jnp.int32), self._kp, self._vp,
+                jnp.asarray(table_row))
+        self._tables[slot] = table_row
+        return int(first[0])
 
     def decode_once(self):
         """Run ONE bounded decode chunk, collect tokens, retire finished
@@ -417,10 +679,25 @@ class DecodeEngine:
         return alive
 
     # -- paged engine loop --------------------------------------------------
-    def _retire_paged(self, slot):
-        """Free the row's pages back to the pool and clear its lane."""
+    def _retire_paged(self, slot, publish=True):
+        """Release the row's page references and clear its lane. On a
+        clean retire the row's now-immutable prefix (prompt + generated
+        tokens whose KV is resident) is PUBLISHED to the prefix cache
+        first, so an identical re-submission allocates zero new pages
+        for it; failed rows release without publishing."""
+        import numpy as _np
         row = self._rows[slot]
-        self._alloc.free(row["pages"])
+        if publish and self._cache is not None:
+            req = row["req"]
+            valid = row["prompt"].size + req.max_new - 1
+            seq = _np.concatenate(
+                [row["prompt"],
+                 _np.asarray(row["toks"][:req.max_new - 1], _np.int32)])
+            self._cache.insert(seq, row["pages"][:-(-valid //
+                                                    self.block_size)])
+        if publish:
+            self._counters["retired"] += 1
+        self._release_row_pages(row)
         self._tables[slot] = 0          # all-NULL: inactive lane
         self._lens[slot] = 0
         self._tok[slot] = 0
@@ -428,9 +705,8 @@ class DecodeEngine:
 
     def _fail_row_paged(self, slot, err):
         row = self._rows[slot]
-        row["req"].error = err
-        row["req"].event.set()
-        self._retire_paged(slot)
+        self._fail_request(row["req"], err)
+        self._retire_paged(slot, publish=False)
 
     def _decode_once_paged(self):
         import jax.numpy as jnp
@@ -450,6 +726,8 @@ class DecodeEngine:
                          -(-target // bs) - len(row["pages"])))
         for slot, row, target, extra in sorted(grow,
                                                key=lambda t: t[3]):
+            if self._rows[slot] is not row:
+                continue                # preempted by an earlier claim
             if target > self.s_max:
                 self._fail_row_paged(slot, RuntimeError(
                     f"row exceeds engine s_max={self.s_max} at length "
@@ -457,17 +735,26 @@ class DecodeEngine:
                 continue
             if extra <= 0:
                 continue
-            pages = self._alloc.allocate(extra)
+            pages = self._reclaim_allocate(extra, self._prio(row["req"]),
+                                           exclude=slot)
             if pages is None:
+                others = any(r is not None and i != slot
+                             for i, r in enumerate(self._rows))
+                if others and self._cache is not None:
+                    # lossless self-preemption: park this row's prefix
+                    # in the cache and re-queue it — it resumes when the
+                    # survivors retire, instead of erroring out
+                    self._preempt_row(slot)
+                    continue
                 self._fail_row_paged(slot, RuntimeError(
                     f"paged KV pool exhausted: needed {extra} more "
                     f"pages, {self._alloc.num_free} free "
                     f"(n_blocks={self.n_blocks}, bs={bs})"))
                 continue
             start = len(row["pages"])
-            row["pages"].extend(pages)
+            row["pages"] = row["pages"] + pages
             self._tables[slot, start:start + extra] = pages
-        if self.idle():
+        if self._no_rows():
             return 0
         st, embed, fnorm, lm = self._weights()
         t0 = time.perf_counter()
@@ -582,12 +869,17 @@ class GenerationPredictor:
 
 
 class _Request:
-    def __init__(self, ids, max_new):
+    def __init__(self, ids, max_new, priority=0):
         self.ids = np.asarray(ids)
         self.max_new = max_new
+        self.priority = int(priority)   # higher = sooner; can preempt
+        #                                 strictly-lower running rows
         self.event = threading.Event()
         self.result = None
         self.error = None
+        self._sched_seq = None          # FCFS stamp (RequestScheduler)
+        self._resume_toks = None        # preemption: emitted tokens to
+        #                                 resume from losslessly
 
     def wait(self, timeout=None):
         if not self.event.wait(timeout):
@@ -640,17 +932,32 @@ class BatchingServer:
         self._q: queue.Queue[_Request] = queue.Queue()
         self._pending: list[_Request] = []
         self._stop = threading.Event()
+        self._closed = False
         self._worker = threading.Thread(
             target=self._loop_continuous if self.engine is not None
             else self._loop, daemon=True)
         self._worker.start()
 
-    def submit(self, input_ids, max_new_tokens=None) -> _Request:
-        req = _Request(input_ids, max_new_tokens or self.max_new_tokens)
+    def submit(self, input_ids, max_new_tokens=None,
+               priority=0) -> _Request:
+        """``priority`` (continuous mode): higher-priority requests
+        admit first and may preempt strictly-lower running rows when
+        the KV pool runs dry."""
+        if self._closed:
+            raise RuntimeError(
+                "submit() on a closed BatchingServer: the worker is "
+                "gone, the request would never be served")
+        req = _Request(input_ids, max_new_tokens or self.max_new_tokens,
+                       priority=priority)
         self._q.put(req)
         return req
 
     def close(self):
+        """Idempotent: the first call stops the worker and fails every
+        unserved request; later calls are no-ops."""
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
         # generous join: the first compile of a chunk can take tens of
         # seconds — touching engine state while the worker is still
@@ -679,6 +986,8 @@ class BatchingServer:
                 if row is not None:
                     _fail(row["req"])
                     self.engine._rows[slot] = None
+            for req in self.engine.drain_pending():
+                _fail(req)
 
     # -- worker -------------------------------------------------------------
     def _take_batch(self):

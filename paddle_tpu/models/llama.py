@@ -1034,15 +1034,18 @@ def _paged_decode_step(cfg, stacked, embed, final_norm, lm_head, token,
     return logits, kps, vps
 
 
-def scatter_prefill_kv(kp, vp, ks, vs, table_row, pad):
+def scatter_prefill_kv(kp, vp, ks, vs, table_row, pad, offset=0):
     """Insert ONE row's prefill K/V into the block pools. ks/vs
     [L, 1, sp, kvh, hd] (right-aligned, ``pad`` left pads); table_row
     [max_blocks] int32. Pad positions are routed to the NULL page, so
-    the scatter is shape-static."""
+    the scatter is shape-static. ``offset`` shifts the write positions
+    by a cached-prefix length (prefix-hit admission: the tail's first
+    real token lands at context position ``offset``, which may sit
+    mid-page inside the row's private COW copy)."""
     bs = kp.shape[2]
     sp = ks.shape[2]
     j = jnp.arange(sp)
-    cpos = jnp.maximum(j - pad, 0)
+    cpos = jnp.maximum(j - pad, 0) + offset
     valid = j >= pad
     page = jnp.where(valid, jnp.take(table_row, cpos // bs), 0)
     off = jnp.where(valid, cpos % bs, 0)
@@ -1113,6 +1116,124 @@ def masked_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
                                      keepdims=False)
     logits = (last @ lm_head).astype(jnp.float32)
     return logits, ks, vs
+
+
+def _attention_prefix(q, k, v, key_mask, pk, pv, prefix_mask):
+    """Causal window attention PLUS a cached-prefix context (prefix-hit
+    admission, ISSUE 2): the window q/k/v cover the uncached TAIL
+    (right-aligned, ``key_mask`` marks real positions) and pk/pv
+    [b, sp, kvh, hd] hold the row's gathered prefix pages with
+    ``prefix_mask`` [b, sp] marking valid cached positions. The prefix
+    keys sit chronologically BEFORE every window query, so they join
+    every query's softmax unconditionally (under their mask) while the
+    window stays causal — the same masked-softmax math as
+    _attention_keymask, with masked entries contributing exact zeros."""
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    qh = jnp.swapaxes(q, 1, 2).reshape(B, Hkv, G, S, D)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    pkh = jnp.swapaxes(pk, 1, 2)
+    pvh = jnp.swapaxes(pv, 1, 2)
+    scale = D ** 0.5
+    sw = jnp.einsum("bngsd,bntd->bngst", qh, kh).astype(jnp.float32)
+    sw = sw / scale
+    sp = jnp.einsum("bngsd,bntd->bngst", qh, pkh).astype(jnp.float32)
+    sp = sp / scale
+    causal = jnp.tril(jnp.ones((S, S), bool))
+    valid_w = causal[None, :, :] & key_mask[:, None, :].astype(bool)
+    sw = jnp.where(valid_w[:, None, None, :, :], sw, -jnp.inf)
+    sp = jnp.where(prefix_mask[:, None, None, None, :].astype(bool),
+                   sp, -jnp.inf)
+    s = jnp.concatenate([sp, sw], axis=-1)   # prefix first: chrono order
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isfinite(s).any(-1, keepdims=True), p, 0.0)
+    vall = jnp.concatenate([pvh, vh], axis=2)
+    out = jnp.einsum("bngst,bntd->bngsd", p.astype(q.dtype), vall)
+    return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
+
+
+def _prefix_decoder_layer(cfg, lp, x, positions, key_mask, pk, pv,
+                          prefix_mask):
+    """One decoder layer over an uncached TAIL window attending to a
+    cached paged prefix (single-program GSPMD path, mirrors
+    _decoder_layer's math with _attention_prefix in place of
+    _attention). Returns (x, k, v) — the tail's post-rope K/V, scattered
+    into the block pool by the caller."""
+    hd = cfg.head_dim
+    h = lp["wq"].shape[-1] // hd
+    kvh = lp["wk"].shape[-1] // hd
+    b, s, d = x.shape
+
+    y = _rms(x, lp["input_ln"], cfg.rms_norm_eps)
+    q = y @ lp["wq"]
+    k = y @ lp["wk"]
+    v = y @ lp["wv"]
+    if "bq" in lp:  # Qwen2-style attention biases
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = _rope(q.reshape(b, s, h, hd), positions, cfg.rope_theta, hd)
+    k = _rope(k.reshape(b, s, kvh, hd), positions, cfg.rope_theta, hd)
+    v = v.reshape(b, s, kvh, hd)
+    attn = _attention_prefix(q, k, v, key_mask, pk, pv, prefix_mask)
+    x = x + attn.reshape(b, s, h * hd) @ lp["wo"]
+
+    y = _rms(x, lp["post_ln"], cfg.rms_norm_eps)
+    if cfg.num_experts > 0:
+        mlp_out, _ = _moe_mlp(cfg, lp, y, lambda a, spec: a,
+                              capacity_override=max(
+                                  1, b * s * cfg.num_experts_per_tok))
+        x = x + mlp_out
+    else:
+        gate = jax.nn.silu(y @ lp["w_gate"])
+        x = x + (gate * (y @ lp["w_up"])) @ lp["w_down"]
+    return x, k, v
+
+
+def prefix_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
+                   pad_len, prefix_len, kp, vp, table_row,
+                   last_index=None):
+    """Position-offset prefill of an UNCACHED TAIL over a prefix already
+    resident in the paged pool (prefix-hit admission, ISSUE 2).
+
+    ``ids`` [1, sc]: the tail tokens right-aligned (``pad_len`` left
+    pads); ``prefix_len`` [1]: cached tokens already in the pool through
+    ``table_row`` [max_blocks] (shared full pages + the row's private
+    COW page). Rope positions offset by ``prefix_len``; each layer
+    gathers its prefix K/V through the table (stale positions masked
+    with exact zeros), the tail attends over prefix + causal window,
+    and the tail's K/V scatter into the pool at ``offset=prefix_len``.
+    Returns (last-real-position logits [1, V], kp, vp)."""
+    from ..kernels.paged_attention import gather_pages
+    b, sc = ids.shape
+    bs = kp.shape[2]
+    mb = table_row.shape[0]
+    positions = jnp.maximum(
+        jnp.arange(sc)[None, :] - pad_len[:, None], 0) \
+        + prefix_len[:, None]
+    key_mask = jnp.arange(sc)[None, :] >= pad_len[:, None]
+    prefix_mask = jnp.arange(mb * bs)[None, :] < prefix_len[:, None]
+    x = jnp.take(embed, ids, axis=0)
+
+    def layer_fn(carry, xs):
+        lp, kpl, vpl = xs
+        pk = gather_pages(kpl, table_row[None, :]).astype(x.dtype)
+        pv = gather_pages(vpl, table_row[None, :]).astype(x.dtype)
+        out, k, v = _prefix_decoder_layer(cfg, lp, carry, positions,
+                                          key_mask, pk, pv, prefix_mask)
+        return out, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, (stacked, kp, vp))
+    x = _rms(x, final_norm, cfg.rms_norm_eps)
+    last = x[:, -1] if last_index is None else \
+        jax.lax.dynamic_index_in_dim(x, last_index, axis=1,
+                                     keepdims=False)
+    logits = (last @ lm_head).astype(jnp.float32)
+    kp, vp = scatter_prefill_kv(kp, vp, ks, vs, table_row, pad_len[0],
+                                offset=prefix_len[0])
+    return logits, kp, vp
 
 
 def _generate_all(cfg, max_new_tokens, greedy, top_k, has_mask, stacked,

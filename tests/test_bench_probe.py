@@ -40,6 +40,32 @@ def test_infra_skip_metric_follows_preset(monkeypatch, capsys):
     bench._emit_infra_skip("tunnel down")
     out = json.loads(capsys.readouterr().out.strip())
     assert out["metric"] == "flash_attention_32k_fwd_bwd_ms"
+    monkeypatch.setenv("BENCH_PRESET", "prefix")
+    bench._emit_infra_skip("tunnel down")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "prefix_cached_ttft_ms"
+
+
+@pytest.mark.slow
+def test_prefix_preset_cpu_smoke():
+    """End-to-end CPU run of BENCH_PRESET=prefix (ISSUE 2 satellite):
+    one JSON line, cached TTFT strictly below uncached (vs_baseline is
+    their ratio), and the engine actually served prefix hits."""
+    env = dict(os.environ, BENCH_PRESET="prefix", BENCH_ALLOW_CPU="1",
+               BENCH_NO_WALL="1", BENCH_SKIP_PROBE="1",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, bench.__file__], env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1                         # one-JSON-line contract
+    out = json.loads(lines[0])
+    assert out["metric"] == "prefix_cached_ttft_ms"
+    assert out["value"] > 0
+    assert out["vs_baseline"] > 1.0    # cached strictly beats uncached
+    assert out["extra"]["prefix_hit_tokens"] > 0
+    assert out["extra"]["uncached_ttft_ms"] > out["value"]
 
 
 def test_env_flag_tolerant(monkeypatch):

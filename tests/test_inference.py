@@ -2,6 +2,7 @@
 tests — save with jit.save, load via Config/create_predictor, run)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -249,6 +250,25 @@ class TestServing:
             np.testing.assert_array_equal(outs[0], solo)
         finally:
             srv.close()
+
+    def test_close_is_idempotent_and_submit_after_close_raises(self):
+        """Regression (ISSUE 2 satellite): a second close() must be a
+        no-op, and submit() on a closed server must raise immediately
+        instead of parking a request no worker will ever serve."""
+        from paddle_tpu.inference.serving import (BatchingServer,
+                                                  GenerationPredictor)
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        paddle.seed(0)
+        m = LlamaForCausalLM("debug")
+        pred = GenerationPredictor(m)
+        srv = BatchingServer(pred, max_batch=2, max_wait_ms=50,
+                             max_new_tokens=2)
+        p = np.random.randint(1, 128, (5,)).astype(np.int32)
+        srv.submit(p).wait(timeout=300)    # server demonstrably works
+        srv.close()
+        srv.close()                        # second close: no-op, no error
+        with pytest.raises(RuntimeError, match="closed BatchingServer"):
+            srv.submit(p)
 
 
 class TestOnnxBridge:
