@@ -348,6 +348,23 @@ def bench_decode():
     }))
 
 
+def _dump_metrics_snapshot(eng, preset: str) -> str | None:
+    """Write the engine's full metrics-registry snapshot (lifecycle
+    counters, TTFT/TPOT/queue-wait histograms, pool gauges) next to the
+    event log so a BENCH row links to the telemetry behind its number.
+    Returns the path, or None when the directory is unwritable (the
+    one-JSON-line stdout contract must survive a read-only checkout)."""
+    out_dir = os.environ.get("BENCH_METRICS_DIR", "log")
+    path = os.path.join(out_dir, f"bench_metrics_{preset}.json")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(eng.metrics.snapshot(), f, indent=1)
+    except OSError:
+        return None
+    return path
+
+
 def bench_engine():
     """Continuous-batching serving throughput: staggered arrivals with
     mixed max_new through the paged DecodeEngine. tokens/s comes from
@@ -426,6 +443,7 @@ def bench_engine():
     baseline_steps = sum(max(r.max_new for r in reqs[i:i + capacity])
                          for i in range(0, n_req, capacity))
     engine_steps = eng.device_steps - steps0
+    snap_path = _dump_metrics_snapshot(eng, "engine")
     print(json.dumps({
         "metric": "engine_decode_tokens_per_sec",
         "value": round(tps, 1),
@@ -438,6 +456,7 @@ def bench_engine():
                   "decode_chunks": len(chunks),
                   "blocks": eng._alloc.stats() if eng.paged else None,
                   "paged": bool(eng.paged),
+                  "metrics_snapshot": snap_path,
                   "backend": jax.default_backend()},
     }))
 
@@ -526,6 +545,7 @@ def bench_prefix():
     uncached_ms = ttfts[0] * 1e3
     cached_ms = sum(ttfts[1:]) / len(ttfts[1:]) * 1e3
     stats = eng.stats()
+    snap_path = _dump_metrics_snapshot(eng, "prefix")
     print(json.dumps({
         "metric": "prefix_cached_ttft_ms",
         "value": round(cached_ms, 3),
@@ -539,6 +559,7 @@ def bench_prefix():
                   "prefix_hit_tokens": stats["prefix_hit_tokens"],
                   "prefix_cache": stats["prefix_cache"],
                   "pool": stats["pool"],
+                  "metrics_snapshot": snap_path,
                   "backend": jax.default_backend()},
     }))
 

@@ -9,14 +9,27 @@ communication module's multihost paths, KV-store p2p) CAN stall when a
 peer dies. ``CommWatchdog`` tracks entry/exit of every eager collective
 and a daemon thread flags any op outstanding past the timeout — logging
 the op, peer info, and elapsed time, then optionally raising in the
-stalled thread via an exception callback."""
+stalled thread via an exception callback.
+
+``EngineStallWatchdog`` (ISSUE 3 satellite) watches the serving side
+instead: the DecodeEngine's ``engine_device_steps_total`` counter is a
+heartbeat that advances every decode chunk. When the counter stops
+moving while the engine still has work (occupancy or backlog gauges
+above zero), the watchdog fires once per stall episode, dumping the
+full registry snapshot so the wedged state is diagnosable post-mortem."""
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
-__all__ = ["CommWatchdog", "comm_guard", "get_watchdog"]
+from ..utils.log import get_logger, log_event, log_kv
+
+__all__ = ["CommWatchdog", "EngineStallWatchdog", "comm_guard",
+           "get_watchdog"]
+
+_log = get_logger("paddle_tpu.distributed.watchdog")
 
 
 class _Inflight:
@@ -81,13 +94,11 @@ class CommWatchdog:
                         "elapsed_s": round(now - t.start, 1),
                         "detail": t.detail}
                 self.timed_out.append(info)
-                from ..utils.log import log_event
                 log_event("comm_timeout", **info)
-                print(f"[comm watchdog] collective {t.name!r} outstanding "
-                      f"{info['elapsed_s']}s (> {self.timeout_s}s) on "
-                      f"thread {t.thread} {t.detail} — a peer is likely "
-                      f"down (reference CommTaskManager would abort the "
-                      f"communicator)")
+                log_kv(_log, "comm_timeout", level=logging.ERROR,
+                       op=t.name, thread=t.thread,
+                       elapsed_s=info["elapsed_s"],
+                       timeout_s=self.timeout_s, detail=t.detail or None)
                 if self.on_timeout is not None:
                     self.on_timeout(info)
 
@@ -104,6 +115,107 @@ def get_watchdog() -> CommWatchdog:
     if _WATCHDOG[0] is None:
         _WATCHDOG[0] = CommWatchdog()
     return _WATCHDOG[0]
+
+
+class EngineStallWatchdog:
+    """Serving-side stall detector over a metrics registry (ISSUE 3).
+
+    Heartbeat: a monotone counter — by default the DecodeEngine's
+    ``engine_device_steps_total``, which advances every decode chunk.
+    The engine counts as BUSY when any busy gauge reads above zero
+    (``engine_batch_occupancy``, ``engine_backlog``); a heartbeat that
+    sits still for ``stall_s`` seconds while busy is a stall. Fires
+    ONCE per episode (re-arms when the heartbeat moves again), dumping
+    the FULL registry snapshot through the structured event log so the
+    wedged state — pool occupancy, backlog, latency histograms — is
+    diagnosable post-mortem.
+
+    :meth:`check` is public and deterministic (pass ``now`` to drive
+    time by hand in tests); :meth:`start` runs it on a daemon thread
+    every ``poll_s`` seconds."""
+
+    def __init__(self, registry, stall_s=30.0, poll_s=5.0,
+                 counter="engine_device_steps_total",
+                 busy_gauges=("engine_batch_occupancy",
+                              "engine_backlog"),
+                 on_stall=None):
+        self.registry = registry
+        self.stall_s = float(stall_s)
+        self.poll_s = float(poll_s)
+        self.counter = counter
+        self.busy_gauges = tuple(busy_gauges)
+        self.on_stall = on_stall
+        self.stalls: list[dict] = []
+        self._last_value = None
+        self._last_advance = None      # monotonic time of last movement
+        self._fired = False            # one report per stall episode
+        self._thread = None
+        self._stop = threading.Event()
+
+    def _busy(self) -> bool:
+        for name in self.busy_gauges:
+            g = self.registry.get(name)
+            if g is None:
+                continue
+            v = g.value
+            if v and v == v:           # nonzero, and NaN-safe
+                return True
+        return False
+
+    def check(self, now: float | None = None):
+        """One deterministic poll. Returns the stall info dict when THIS
+        call fires (first detection of the current episode), else
+        None."""
+        now = time.monotonic() if now is None else now
+        m = self.registry.get(self.counter)
+        if m is None:
+            return None                # engine not constructed yet
+        v = float(m.value)
+        if self._last_value is None or v != self._last_value:
+            self._last_value = v
+            self._last_advance = now
+            self._fired = False        # heartbeat moved: re-arm
+            return None
+        if not self._busy():
+            self._last_advance = now   # idle quiet is not a stall
+            return None
+        stalled_s = now - self._last_advance
+        if stalled_s < self.stall_s or self._fired:
+            return None
+        self._fired = True
+        info = {"counter": self.counter, "value": v,
+                "stalled_s": round(stalled_s, 3),
+                "snapshot": self.registry.snapshot()}
+        self.stalls.append(info)
+        log_event("engine_stall", counter=self.counter, value=v,
+                  stalled_s=info["stalled_s"],
+                  snapshot=info["snapshot"])
+        backlog = self.registry.get("engine_backlog")
+        log_kv(_log, "engine_stall", level=logging.ERROR,
+               counter=self.counter, value=v,
+               stalled_s=info["stalled_s"],
+               backlog=backlog.value if backlog is not None else None)
+        if self.on_stall is not None:
+            self.on_stall(info)
+        return info
+
+    # -- background polling -------------------------------------------------
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._watch,
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def _watch(self):
+        while not self._stop.wait(self.poll_s):
+            self.check()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1)
 
 
 class comm_guard:

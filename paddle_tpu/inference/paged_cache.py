@@ -48,6 +48,11 @@ class BlockAllocator:
         self.total_allocated = 0        # cumulative allocate() pages —
         #                                 prefix hits show up as a FLAT
         #                                 counter across re-submissions
+        self.total_freed = 0            # cumulative pages returned to
+        #                                 the free list; the conservation
+        #                                 invariant total_allocated -
+        #                                 total_freed == in_use holds at
+        #                                 every step (ISSUE 3 satellite)
 
     @property
     def capacity(self) -> int:
@@ -60,6 +65,14 @@ class BlockAllocator:
 
     @property
     def num_used(self) -> int:
+        return len(self._rc)
+
+    @property
+    def in_use(self) -> int:
+        """The ONE source of truth for occupancy (alias of num_used):
+        the refcount map's size. ``allocator_in_use`` gauges read this
+        at collection time instead of mirroring a hand-maintained
+        counter that could drift from the free list."""
         return len(self._rc)
 
     def refcount(self, page: int) -> int:
@@ -100,6 +113,7 @@ class BlockAllocator:
         else:
             del self._rc[page]
             self._free.append(page)
+            self.total_freed += 1
 
     def free(self, pages) -> None:
         """Return a row's EXCLUSIVELY-owned pages. Double-free, foreign
@@ -117,9 +131,12 @@ class BlockAllocator:
                     f"shared pages release via decref")
             del self._rc[p]
             self._free.append(p)
+            self.total_freed += 1
 
     def stats(self) -> dict:
         """Occupancy snapshot (bench/engine observability)."""
         return {"capacity": self.capacity, "used": self.num_used,
                 "free": self.num_free,
-                "high_watermark": self.high_watermark}
+                "high_watermark": self.high_watermark,
+                "total_allocated": self.total_allocated,
+                "total_freed": self.total_freed}

@@ -75,6 +75,7 @@ class PrefixCache:
         self._n_nodes = 0
         self.hits = 0                   # matches with cached_len > 0
         self.queries = 0
+        self.hit_tokens = 0             # cumulative cached_len matched
         self.evicted_pages = 0
 
     def __len__(self) -> int:
@@ -135,6 +136,7 @@ class PrefixCache:
             m.cow_len = best_t
         if m.cached_len:
             self.hits += 1
+            self.hit_tokens += m.cached_len
         return m
 
     def release_cow(self, m: PrefixMatch) -> None:
@@ -218,7 +220,15 @@ class PrefixCache:
         self.evicted_pages += freed
         return freed
 
+    @property
+    def hit_rate(self) -> float:
+        """Fraction of match() calls that found ANY cached prefix —
+        read at collection time by the engine's hit-rate gauge."""
+        return self.hits / self.queries if self.queries else 0.0
+
     def stats(self) -> dict:
         return {"nodes": self._n_nodes, "hits": self.hits,
                 "queries": self.queries,
+                "hit_tokens": self.hit_tokens,
+                "hit_rate": round(self.hit_rate, 4),
                 "evicted_pages": self.evicted_pages}

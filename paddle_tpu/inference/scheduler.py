@@ -42,6 +42,12 @@ class RequestScheduler:
             req._sched_seq = self._arrivals
             self._arrivals += 1
         prio = int(getattr(req, "priority", 0) or 0)
+        trace = getattr(req, "trace", None)
+        if trace is not None:
+            # lifecycle tracing (ISSUE 3): every enqueue — initial OR a
+            # re-queue after preemption — opens a queued->admitted stint
+            # that RequestTrace.queue_wait sums over
+            trace.mark("queued")
         heapq.heappush(self._heap, (-prio, req._sched_seq, req))
 
     def peek(self):

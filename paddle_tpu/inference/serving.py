@@ -16,15 +16,27 @@ TPU-native pieces:
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 
 import numpy as np
 
-from ..utils.log import log_event
+from ..observability import MetricsRegistry, RequestTrace, now as _now
+from ..profiler import RecordEvent
+from ..utils.log import get_logger, log_event, log_kv
 
 __all__ = ["GenerationPredictor", "BatchingServer", "DecodeEngine"]
+
+_log = get_logger("paddle_tpu.inference.engine")
+
+
+def _tmark(req, state):
+    """Mark a lifecycle transition on the request's trace (requests
+    without one — foreign test doubles — are silently skipped)."""
+    tr = getattr(req, "trace", None)
+    return None if tr is None else tr.mark(state)
 
 
 class DecodeEngine:
@@ -59,7 +71,7 @@ class DecodeEngine:
 
     def __init__(self, model, capacity=4, s_max=256, chunk=8, pad_id=0,
                  paged=True, block_size=16, n_blocks=None,
-                 prefix_cache=True):
+                 prefix_cache=True, registry=None):
         from ..distributed.fleet.mp_layers import current_mesh
         from ..models.llama import _pp_degree
         if _pp_degree(current_mesh()) > 1:
@@ -92,10 +104,64 @@ class DecodeEngine:
         self.device_steps = 0           # decode steps actually executed
         self.prefills = 0
         self.resets = 0                 # cache resets (init counts as 1)
-        self._counters = {"admitted": 0, "retired": 0, "failed": 0,
-                          "preempted": 0, "prefix_hit_tokens": 0}
+        # ISSUE 3: lifecycle counters, latency histograms, and pool
+        # gauges live in a metrics registry (private by default so two
+        # engines in one process never pollute each other; pass
+        # observability.get_registry() to aggregate process-wide).
+        # stats() is a thin view over it.
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        self._init_metrics()
         self._build()
         self._reset()
+
+    def _init_metrics(self):
+        r = self.metrics
+        self._c_admitted = r.counter(
+            "engine_admitted_total", "requests admitted into a slot")
+        self._c_retired = r.counter(
+            "engine_retired_total", "requests finished cleanly")
+        self._c_failed = r.counter(
+            "engine_failed_total", "requests failed (admission or growth)")
+        self._c_preempted = r.counter(
+            "engine_preempted_total", "rows evicted for recompute-resume")
+        self._c_prefix_hit = r.counter(
+            "engine_prefix_hit_tokens_total",
+            "prompt tokens served from the prefix cache")
+        self._c_steps = r.counter(
+            "engine_device_steps_total",
+            "decode steps executed on device (stall-watchdog heartbeat)")
+        self._c_prefills = r.counter(
+            "engine_prefills_total", "admission prefill programs run")
+        self._h_ttft = r.histogram(
+            "engine_ttft_seconds", "arrival to first emitted token")
+        self._h_tpot = r.histogram(
+            "engine_tpot_seconds", "per-output-token decode latency")
+        self._h_queue_wait = r.histogram(
+            "engine_queue_wait_seconds",
+            "queued->admitted wait summed over preemption stints")
+        self._h_chunk = r.histogram(
+            "engine_chunk_seconds", "decode chunk device wall time")
+        self._g_occupancy = r.gauge(
+            "engine_batch_occupancy", "rows occupied by the last chunk")
+        r.gauge("engine_backlog", "scheduler backlog depth",
+                fn=lambda: self.backlog)
+        if self.paged:
+            # pool gauges read the allocator at COLLECTION time — one
+            # source of truth, no mirrored counters to drift
+            r.gauge("engine_pool_free", "free pages in the block pool",
+                    fn=lambda: self._alloc.num_free)
+            r.gauge("allocator_in_use", "pages with live references",
+                    fn=lambda: self._alloc.in_use)
+            r.gauge("engine_pool_high_watermark",
+                    "max pages ever in use at once",
+                    fn=lambda: self._alloc.high_watermark)
+            if self._prefix_on:
+                r.gauge("engine_prefix_hit_rate",
+                        "fraction of admissions matching any cached "
+                        "prefix",
+                        fn=lambda: (self._cache.hit_rate
+                                    if self._cache is not None else 0.0))
 
     # -- compiled programs --------------------------------------------------
     def _build(self):
@@ -320,18 +386,64 @@ class DecodeEngine:
         return self._sched.drain() if self._sched is not None else []
 
     def stats(self) -> dict:
-        """Engine observability: lifecycle counters plus pool occupancy
-        (including the allocator's high-watermark) and prefix-cache hit
-        accounting."""
-        s = dict(self._counters)
-        s.update(device_steps=self.device_steps, prefills=self.prefills,
-                 resets=self.resets)
+        """Engine observability: a thin view over the metrics registry
+        (lifecycle counters) plus pool occupancy (including the
+        allocator's high-watermark) and prefix-cache hit accounting.
+        ``metrics.snapshot()`` is the full registry (histograms with
+        TTFT/TPOT/queue-wait buckets included); this keeps the r6/r7
+        dict shape."""
+        s = {"admitted": int(self._c_admitted.value),
+             "retired": int(self._c_retired.value),
+             "failed": int(self._c_failed.value),
+             "preempted": int(self._c_preempted.value),
+             "prefix_hit_tokens": int(self._c_prefix_hit.value),
+             "device_steps": self.device_steps,
+             "prefills": self.prefills,
+             "resets": self.resets}
         if self.paged:
             s["pool"] = self._alloc.stats()
             s["backlog"] = self.backlog
             if self._cache is not None:
                 s["prefix_cache"] = self._cache.stats()
         return s
+
+    # -- lifecycle telemetry (ISSUE 3) --------------------------------------
+    def _trace_admission(self, req):
+        """Close this stint's queued->admitted wait (a preempted
+        request opens a fresh stint per re-queue). The admitted COUNTER
+        only increments after the prefill succeeds — this runs when
+        admission starts, so queue wait excludes prefill time."""
+        tr = getattr(req, "trace", None)
+        if tr is None:
+            return
+        t_adm = tr.mark("admitted")
+        tq = tr.last("queued")
+        self._h_queue_wait.observe(
+            t_adm - (tq if tq is not None else tr.arrival))
+
+    def _observe_first_token(self, req):
+        """TTFT from the trace — only on the FIRST token ever (a
+        resumed request already emitted one before preemption)."""
+        tr = getattr(req, "trace", None)
+        if tr is None:
+            return
+        tf = tr.mark_once("first_token")
+        if tf is not None:
+            self._h_ttft.observe(tf - tr.arrival)
+
+    def _observe_retired(self, req):
+        self._c_retired.inc()
+        tr = getattr(req, "trace", None)
+        if tr is None:
+            return
+        t_ret = tr.mark("retired")
+        tf = tr.first("first_token")
+        if tf is not None and req.max_new > 1:
+            self._h_tpot.observe((t_ret - tf) / (req.max_new - 1))
+        log_kv(_log, "retired", level=logging.DEBUG,
+               req=tr.request_id, new_tokens=req.max_new,
+               ttft_s=round(tr.ttft, 6) if tr.ttft is not None else None,
+               preemptions=tr.preemptions)
 
     def admit(self, pending):
         """Move requests from ``pending`` (a list; consumed in order)
@@ -375,20 +487,24 @@ class DecodeEngine:
                     break               # wait for the fill to reach n
                 self._g = n
             req = pending.pop(0)
+            self._trace_admission(req)
             try:
                 ids = _np.full((1, self.s_max), self.pad_id, _np.int32)
                 prompt = req.ids.reshape(-1).astype(_np.int32)
                 ids[0, self._g - n:self._g] = prompt
                 pad = self._g - n
                 st, embed, fnorm, lm = self._weights()
-                first, ks, vs = self._prefill(
-                    st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
-                    jnp.asarray([pad], jnp.int32), self._g)
+                with RecordEvent("engine.prefill", "engine"):
+                    first, ks, vs = self._prefill(
+                        st, embed, fnorm, lm, self._scales,
+                        jnp.asarray(ids), jnp.asarray([pad], jnp.int32),
+                        self._g)
             except Exception as e:  # noqa: BLE001 — fail THIS request,
                 self._fail_request(req, e)  # not the whole engine
                 continue
             self.prefills += 1
-            self._counters["admitted"] += 1
+            self._c_prefills.inc()
+            self._c_admitted.inc()
             # insert this row's lane: [L, 1, sc, kvh, hd] -> slot
             self._ck = jax.lax.dynamic_update_slice(
                 self._ck, ks.astype(self._ck.dtype), (0, slot, 0, 0, 0))
@@ -397,6 +513,7 @@ class DecodeEngine:
             self._pad[slot] = pad
             first_tok = int(first[0])
             self._tok[slot] = first_tok
+            self._observe_first_token(req)
             self._rows[slot] = {"req": req, "prompt": prompt,
                                 "toks": [first_tok]}
 
@@ -408,7 +525,12 @@ class DecodeEngine:
     def _fail_request(self, req, err):
         req.error = err
         req.event.set()
-        self._counters["failed"] += 1
+        self._c_failed.inc()
+        tr = getattr(req, "trace", None)
+        _tmark(req, "failed")
+        log_kv(_log, "request_failed", level=logging.WARNING,
+               req=tr.request_id if tr is not None else None,
+               error=type(err).__name__, detail=str(err))
 
     def _pick_victim(self, prio, exclude=None):
         """Slot of the running row to preempt for a priority-``prio``
@@ -455,18 +577,25 @@ class DecodeEngine:
         bs = self.block_size
         row = self._rows[slot]
         req = row["req"]
-        valid = int(self._lens[slot])
-        if self._cache is not None and valid > 0:
-            seq = self._cached_seq(row)[:valid]
-            self._cache.insert(seq, row["pages"][:-(-valid // bs)])
-        self._release_row_pages(row)
-        req._resume_toks = list(row["toks"])
-        self._counters["preempted"] += 1
-        self._tables[slot] = 0
-        self._lens[slot] = 0
-        self._tok[slot] = 0
-        self._rows[slot] = None
-        self._sched.add(req)
+        with RecordEvent("engine.preempt", "engine"):
+            valid = int(self._lens[slot])
+            if self._cache is not None and valid > 0:
+                seq = self._cached_seq(row)[:valid]
+                self._cache.insert(seq, row["pages"][:-(-valid // bs)])
+            self._release_row_pages(row)
+            req._resume_toks = list(row["toks"])
+            self._c_preempted.inc()
+            _tmark(req, "preempted")
+            self._tables[slot] = 0
+            self._lens[slot] = 0
+            self._tok[slot] = 0
+            self._rows[slot] = None
+            self._sched.add(req)
+        tr = getattr(req, "trace", None)
+        log_kv(_log, "preempted", level=logging.DEBUG,
+               req=tr.request_id if tr is not None else None,
+               slot=slot, resident_tokens=valid,
+               emitted=len(req._resume_toks))
 
     def _reclaim_allocate(self, need, prio, exclude=None):
         """allocate() with reclamation: evict unreferenced cached pages
@@ -478,7 +607,7 @@ class DecodeEngine:
         if pages is not None:
             return pages
         if self._cache is not None:
-            self._cache.evict(need - self._alloc.num_free)
+            self._evict_cached(need - self._alloc.num_free)
             pages = self._alloc.allocate(need)
             if pages is not None:
                 return pages
@@ -488,10 +617,20 @@ class DecodeEngine:
                 return None
             self._preempt_row(victim)
             if self._cache is not None:
-                self._cache.evict(need - self._alloc.num_free)
+                self._evict_cached(need - self._alloc.num_free)
             pages = self._alloc.allocate(need)
             if pages is not None:
                 return pages
+
+    def _evict_cached(self, n):
+        """Cache eviction under a timeline span (the unified trace
+        shows WHEN pool pressure forced reclamation)."""
+        with RecordEvent("engine.evict", "engine"):
+            freed = self._cache.evict(n)
+        if freed:
+            log_kv(_log, "cache_evicted", level=logging.DEBUG,
+                   pages=freed, pool_free=self._alloc.num_free)
+        return freed
 
     def _admit_scheduled(self):
         import numpy as _np
@@ -544,6 +683,7 @@ class DecodeEngine:
                     continue
                 return          # wait: running rows will free pages
             self._sched.pop()
+            self._trace_admission(req)
             # snapshot BEFORE the prefill: release_cow inside it zeroes
             # the match's cow_len, which would undercount the hit
             hit_tokens = m.cached_len if m is not None else 0
@@ -559,8 +699,15 @@ class DecodeEngine:
             toks = list(resume) if resume else [first_tok]
             req._resume_toks = None
             self.prefills += 1
-            self._counters["admitted"] += 1
-            self._counters["prefix_hit_tokens"] += hit_tokens
+            self._c_prefills.inc()
+            self._c_admitted.inc()
+            self._c_prefix_hit.inc(hit_tokens)
+            self._observe_first_token(req)
+            tr = getattr(req, "trace", None)
+            log_kv(_log, "admitted", level=logging.DEBUG,
+                   req=tr.request_id if tr is not None else None,
+                   slot=slot, tokens=int(ns), cached_tokens=hit_tokens,
+                   pages=len(all_pages), resumed=bool(resume))
             self._lens[slot] = ns
             self._tok[slot] = toks[-1]
             self._rows[slot] = {"req": req, "prompt": prompt,
@@ -573,6 +720,10 @@ class DecodeEngine:
         Prefix hit: COW-copy the partially-shared page if any, then the
         position-offset tail prefill over a bucketed window. Returns
         the argmax token at the last real position."""
+        with RecordEvent("engine.prefill", "engine"):
+            return self._prefill_row_inner(slot, seq, m, pages)
+
+    def _prefill_row_inner(self, slot, seq, m, pages):
         import jax.numpy as jnp
         import numpy as _np
         bs = self.block_size
@@ -633,28 +784,32 @@ class DecodeEngine:
                     continue
                 need = row["req"].max_new - len(row["toks"])
                 if need > space:
-                    row["req"].error = RuntimeError(
+                    self._fail_request(row["req"], RuntimeError(
                         f"engine cache exhausted at fill {self._g} "
                         f"(s_max={self.s_max}): {need} tokens still "
-                        f"needed, {space} slots left")
-                    row["req"].event.set()
+                        f"needed, {space} slots left"))
                     self._rows[slot] = None
             if space <= 0 or self.idle():
                 self._reset()  # a wedged fill must not brick later
                 return 0       # bursts
             steps = space      # every survivor finishes inside it
         st, embed, fnorm, lm = self._weights()
-        t0 = time.perf_counter()   # decode-only window: admit()'s
+        t0 = _now()                # decode-only window: admit()'s
         #                            prefill/compile must not read as a
         #                            phantom throughput collapse
-        toks, self._ck, self._cv = self._decode_for(steps)(
-            st, embed, fnorm, lm, self._scales, jnp.asarray(self._tok),
-            self._ck, self._cv, self._g, jnp.asarray(self._pad))
-        toks = _np.asarray(toks)        # [steps, B] (fetch = sync)
-        wall = time.perf_counter() - t0
+        with RecordEvent("engine.decode_chunk", "engine"):
+            toks, self._ck, self._cv = self._decode_for(steps)(
+                st, embed, fnorm, lm, self._scales,
+                jnp.asarray(self._tok), self._ck, self._cv, self._g,
+                jnp.asarray(self._pad))
+            toks = _np.asarray(toks)    # [steps, B] (fetch = sync)
+        wall = _now() - t0
         self._g += steps
         self.device_steps += steps
+        self._c_steps.inc(steps)
+        self._h_chunk.observe(wall)
         n_busy = sum(r is not None for r in self._rows)
+        self._g_occupancy.set(n_busy)
         log_event("engine_chunk", steps=steps, rows=n_busy,
                   fill=self._g, wall_s=round(wall, 4),
                   tokens_per_s=round(steps * n_busy
@@ -666,10 +821,12 @@ class DecodeEngine:
             row["toks"].extend(int(t) for t in toks[:, slot])
             self._tok[slot] = int(toks[-1, slot])
             req = row["req"]
+            _tmark(req, "decode_chunk")
             if len(row["toks"]) >= req.max_new:
                 req.result = _np.concatenate(
                     [row["prompt"],
                      _np.asarray(row["toks"][:req.max_new], _np.int32)])
+                self._observe_retired(req)
                 req.event.set()
                 self._rows[slot] = None  # slot free for the next admit
             else:
@@ -696,7 +853,7 @@ class DecodeEngine:
             self._cache.insert(seq, row["pages"][:-(-valid //
                                                     self.block_size)])
         if publish:
-            self._counters["retired"] += 1
+            self._observe_retired(row["req"])
         self._release_row_pages(row)
         self._tables[slot] = 0          # all-NULL: inactive lane
         self._lens[slot] = 0
@@ -757,15 +914,19 @@ class DecodeEngine:
         if self._no_rows():
             return 0
         st, embed, fnorm, lm = self._weights()
-        t0 = time.perf_counter()
-        toks, self._kp, self._vp = self._decode(
-            st, embed, fnorm, lm, self._scales, jnp.asarray(self._tok),
-            self._kp, self._vp, jnp.asarray(self._tables),
-            jnp.asarray(self._lens))
-        toks = _np.asarray(toks)        # [chunk, B] (fetch = sync)
-        wall = time.perf_counter() - t0
+        t0 = _now()
+        with RecordEvent("engine.decode_chunk", "engine"):
+            toks, self._kp, self._vp = self._decode(
+                st, embed, fnorm, lm, self._scales,
+                jnp.asarray(self._tok), self._kp, self._vp,
+                jnp.asarray(self._tables), jnp.asarray(self._lens))
+            toks = _np.asarray(toks)    # [chunk, B] (fetch = sync)
+        wall = _now() - t0
         self.device_steps += self.chunk
+        self._c_steps.inc(self.chunk)
+        self._h_chunk.observe(wall)
         n_busy = sum(r is not None for r in self._rows)
+        self._g_occupancy.set(n_busy)
         log_event("engine_chunk", steps=self.chunk, rows=n_busy,
                   fill=int(self._lens.max()), wall_s=round(wall, 4),
                   tokens_per_s=round(self.chunk * n_busy
@@ -779,12 +940,13 @@ class DecodeEngine:
             row["toks"].extend(int(t) for t in toks[:, slot])
             self._tok[slot] = int(toks[-1, slot])
             req = row["req"]
+            _tmark(req, "decode_chunk")
             if len(row["toks"]) >= req.max_new:
                 req.result = _np.concatenate(
                     [row["prompt"],
                      _np.asarray(row["toks"][:req.max_new], _np.int32)])
-                req.event.set()
                 self._retire_paged(slot)  # pages free for next admit
+                req.event.set()
             else:
                 self._lens[slot] += self.chunk
                 alive += 1
@@ -852,13 +1014,13 @@ class GenerationPredictor:
         from ..core.tensor import Tensor
         from ..utils.log import log_event
         ids = np.asarray(input_ids)
-        t0 = time.perf_counter()
+        t0 = _now()
         out = self.model.generate(Tensor(ids),
                                   max_new_tokens=max_new_tokens,
                                   temperature=temperature, top_k=top_k,
                                   seed=seed, attention_mask=attention_mask)
         arr = np.asarray(out._value)
-        dt = time.perf_counter() - t0
+        dt = _now() - t0
         log_event("serve_generate", batch=int(ids.shape[0]),
                   prompt_len=int(ids.shape[1]),
                   new_tokens=int(max_new_tokens),
@@ -874,6 +1036,8 @@ class _Request:
         self.max_new = max_new
         self.priority = int(priority)   # higher = sooner; can preempt
         #                                 strictly-lower running rows
+        self.trace = RequestTrace()     # lifecycle trace from arrival;
+        #                                 TTFT/queue-wait derive from it
         self.event = threading.Event()
         self.result = None
         self.error = None
@@ -929,6 +1093,12 @@ class BatchingServer:
                 self.engine = DecodeEngine(
                     predictor.model, capacity=max_batch,
                     pad_id=predictor.pad_id, **(engine_kwargs or {}))
+        # share the engine's registry so server + engine metrics land in
+        # one snapshot; batch-at-a-time mode gets its own
+        self.metrics = self.engine.metrics if self.engine is not None \
+            else MetricsRegistry()
+        self._c_submitted = self.metrics.counter(
+            "server_submitted_total", "requests accepted by submit()")
         self._q: queue.Queue[_Request] = queue.Queue()
         self._pending: list[_Request] = []
         self._stop = threading.Event()
@@ -949,8 +1119,20 @@ class BatchingServer:
                 "gone, the request would never be served")
         req = _Request(input_ids, max_new_tokens or self.max_new_tokens,
                        priority=priority)
+        self._c_submitted.inc()
         self._q.put(req)
         return req
+
+    def stats(self) -> dict:
+        """Server observability: a thin view over the shared metrics
+        registry plus live queue depths. ``metrics.snapshot()`` has the
+        full registry (engine histograms included in continuous mode)."""
+        s = {"submitted": int(self._c_submitted.value),
+             "queue_depth": self._q.qsize(),
+             "pending": len(self._pending)}
+        if self.engine is not None:
+            s["engine"] = self.engine.stats()
+        return s
 
     def close(self):
         """Idempotent: the first call stops the worker and fails every

@@ -1,0 +1,27 @@
+"""paddle_tpu.observability — serving telemetry (ISSUE 3 tentpole).
+
+Dependency-free metrics + tracing for the inference stack:
+
+- :mod:`.metrics` — thread-safe :class:`Counter` / :class:`Gauge` /
+  :class:`Histogram` (fixed log-spaced latency buckets) behind a
+  :class:`MetricsRegistry` with Prometheus text exposition and a
+  JSON snapshot. Engines own a private registry by default;
+  :func:`get_registry` is the process-wide instance.
+- :mod:`.tracing` — :class:`RequestTrace`, the per-request lifecycle
+  record every latency metric (TTFT / TPOT / queue wait / preemption
+  cost) is derived from.
+
+The engine-step timeline rides the existing profiler: serving code
+wraps admissions, prefills, decode chunks and evictions in
+``profiler.RecordEvent(..., "engine")`` spans, so
+``export_chrome_tracing`` renders one unified host timeline of request
+lifecycle next to op-dispatch spans (PAPER §L0–L4 host+device merge).
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      DEFAULT_LATENCY_BUCKETS, get_registry, now)
+from .tracing import (RequestTrace, LIFECYCLE_STATES, TERMINAL_STATES)
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "DEFAULT_LATENCY_BUCKETS", "get_registry", "now",
+           "RequestTrace", "LIFECYCLE_STATES", "TERMINAL_STATES"]

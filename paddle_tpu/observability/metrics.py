@@ -1,0 +1,319 @@
+"""Thread-safe metrics primitives + registry (ISSUE 3 tentpole;
+reference shape: the Prometheus client-library data model — Counter /
+Gauge / Histogram with text exposition — kept dependency-free so the
+serving hot path can emit without pulling a client stack in).
+
+Design rules:
+- one lock per metric, no allocation on the observe path (histogram
+  bucket search is a bisect over a fixed tuple);
+- ``Gauge`` optionally reads a callback at COLLECTION time (``fn=``),
+  so values like allocator occupancy stay derived from one source of
+  truth instead of being mirrored by hand at every mutation site;
+- ``Histogram`` uses fixed log-spaced latency buckets (powers of two
+  from 0.1 ms to ~100 s) — TTFT, TPOT and queue-wait all live in that
+  range, and fixed edges make snapshots mergeable across hosts later
+  (ROADMAP: off-host shipping).
+
+Prometheus bucket convention: ``le`` is an INCLUSIVE upper bound and
+exposed bucket counts are cumulative, ending at ``+Inf == _count``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_left
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "get_registry", "now", "DEFAULT_LATENCY_BUCKETS"]
+
+#: monotonic high-resolution clock used by every telemetry call site —
+#: hot-path code imports this instead of calling time.perf_counter
+#: directly (tests/test_no_adhoc_timers.py enforces it for inference/).
+now = time.perf_counter
+
+# 0.1 ms .. ~104.8 s in powers of two: 21 edges + implicit +Inf.
+DEFAULT_LATENCY_BUCKETS = tuple(1e-4 * 2 ** i for i in range(21))
+
+
+class Counter:
+    """Monotonically increasing float counter."""
+
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, v: float = 1.0) -> None:
+        if v < 0:
+            raise ValueError(f"Counter {self.name}: inc({v}) < 0")
+        with self._lock:
+            self._value += v
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value; either set()/inc()/dec() or a read-time
+    callback (``fn``) for values owned by another object."""
+
+    __slots__ = ("name", "help", "_value", "_fn", "_lock")
+
+    def __init__(self, name: str, help: str = "", fn=None):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._fn = fn
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._value += v
+
+    def dec(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._value -= v
+
+    def bind(self, fn) -> None:
+        """Re-point the collection callback (a fresh engine re-binding a
+        shared registry's gauge)."""
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:  # noqa: BLE001 — collection must not throw
+                return float("nan")
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram (log-spaced latency edges by default).
+
+    ``observe`` is O(log buckets); per-bucket counts are stored
+    NON-cumulative and cumulated only at exposition time."""
+
+    __slots__ = ("name", "help", "buckets", "_counts", "_overflow",
+                 "_sum", "_count", "_min", "_max", "_lock")
+
+    def __init__(self, name: str, help: str = "", buckets=None):
+        self.name = name
+        self.help = help
+        edges = tuple(float(b) for b in
+                      (buckets if buckets is not None
+                       else DEFAULT_LATENCY_BUCKETS))
+        if list(edges) != sorted(edges) or len(set(edges)) != len(edges):
+            raise ValueError(
+                f"Histogram {name}: bucket edges must be strictly "
+                f"increasing, got {edges}")
+        self.buckets = edges
+        self._counts = [0] * len(edges)
+        self._overflow = 0              # > last edge (the +Inf bucket)
+        self._sum = 0.0
+        self._count = 0
+        self._min = None
+        self._max = None
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect_left(self.buckets, v)    # le is INCLUSIVE: v == edge
+        with self._lock:                    # counts in that edge's bucket
+            if i < len(self._counts):
+                self._counts[i] += 1
+            else:
+                self._overflow += 1
+            self._sum += v
+            self._count += 1
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    class _Timer:
+        __slots__ = ("_h", "_t0")
+
+        def __init__(self, h):
+            self._h = h
+
+        def __enter__(self):
+            self._t0 = now()
+            return self
+
+        def __exit__(self, *exc):
+            self._h.observe(now() - self._t0)
+            return False
+
+    def time(self) -> "_Timer":
+        """``with hist.time(): ...`` observes the elapsed seconds."""
+        return Histogram._Timer(self)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """[(le, cumulative_count), ..., (inf, count)]."""
+        with self._lock:
+            out, acc = [], 0
+            for le, c in zip(self.buckets, self._counts):
+                acc += c
+                out.append((le, acc))
+            out.append((float("inf"), acc + self._overflow))
+            return out
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from the bucket counts (upper edge of
+        the bucket holding the q-th observation; observed max caps the
+        +Inf bucket). 0.0 when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile({q})")
+        cum = self.cumulative()
+        total = cum[-1][1]
+        if total == 0:
+            return 0.0
+        rank = q * total
+        for le, acc in cum:
+            if acc >= rank:
+                if le == float("inf"):
+                    return self._max if self._max is not None else 0.0
+                return le
+        return self._max if self._max is not None else 0.0
+
+    def summary(self) -> dict:
+        with self._lock:
+            mn, mx, s, n = self._min, self._max, self._sum, self._count
+        return {"count": n, "sum": s, "min": mn, "max": mx,
+                "p50": self.quantile(0.5), "p99": self.quantile(0.99)}
+
+
+class MetricsRegistry:
+    """Name -> metric map with get-or-create accessors, a JSON-able
+    snapshot, and Prometheus text exposition.
+
+    Each :class:`~paddle_tpu.inference.serving.DecodeEngine` owns a
+    private registry by default (so two engines in one process — e.g. a
+    tiny-pool vs ample-pool comparison — never pollute each other's
+    counters); :func:`get_registry` is the process-default instance for
+    cross-cutting consumers like the stall watchdog."""
+
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name, cls, *args, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help)
+
+    def gauge(self, name: str, help: str = "", fn=None) -> Gauge:
+        g = self._get_or_create(name, Gauge, help)
+        if fn is not None:
+            g.bind(fn)          # a fresh owner re-points the callback
+        return g
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=None) -> Histogram:
+        return self._get_or_create(name, Histogram, help, buckets)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._metrics
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    # -- collection ---------------------------------------------------------
+    @staticmethod
+    def _fmt_le(le: float) -> str:
+        return "+Inf" if le == float("inf") else format(le, "g")
+
+    def snapshot(self) -> dict:
+        """JSON-able point-in-time view: scalar counters/gauges plus
+        histogram summaries with cumulative bucket counts."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name in self.names():
+            m = self._metrics[name]
+            if isinstance(m, Counter):
+                out["counters"][name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.value
+            elif isinstance(m, Histogram):
+                h = m.summary()
+                h["buckets"] = {self._fmt_le(le): c
+                                for le, c in m.cumulative()}
+                out["histograms"][name] = h
+        return out
+
+    def prometheus_text(self) -> str:
+        """Standard text exposition (one scrape body)."""
+        lines = []
+        for name in self.names():
+            m = self._metrics[name]
+            if isinstance(m, Counter):
+                if m.help:
+                    lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {format(m.value, 'g')}")
+            elif isinstance(m, Gauge):
+                if m.help:
+                    lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {format(m.value, 'g')}")
+            elif isinstance(m, Histogram):
+                if m.help:
+                    lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# TYPE {name} histogram")
+                for le, c in m.cumulative():
+                    lines.append(
+                        f'{name}_bucket{{le="{self._fmt_le(le)}"}} {c}')
+                lines.append(f"{name}_sum {format(m.sum, 'g')}")
+                lines.append(f"{name}_count {m.count}")
+        return "\n".join(lines) + "\n"
+
+
+_DEFAULT: list[MetricsRegistry | None] = [None]
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """Process-default registry (watchdogs, ad-hoc tooling). Engines
+    default to a PRIVATE registry — pass ``registry=get_registry()`` to
+    aggregate into this one."""
+    with _DEFAULT_LOCK:
+        if _DEFAULT[0] is None:
+            _DEFAULT[0] = MetricsRegistry()
+        return _DEFAULT[0]

@@ -1,0 +1,182 @@
+"""Per-request lifecycle tracing (ISSUE 3 tentpole; reference shape:
+vLLM's RequestMetrics / the serving-system convention of deriving TTFT,
+TPOT and queue wait from ONE timestamped transition record instead of
+ad-hoc perf_counter pairs scattered through the engine).
+
+A :class:`RequestTrace` is a append-only list of ``(state, t)`` pairs
+stamped with the shared monotonic clock. The engine marks transitions
+(``queued`` → ``admitted`` → ``first_token`` → ``decode_chunk``* →
+``retired`` | ``preempted`` | ``failed``); every latency metric is then
+DERIVED from the trace, so the numbers the histograms see and the
+numbers an operator reads off a dumped trace can never disagree.
+
+Preemption keeps the same trace: a preempted request re-enters with a
+second ``queued``/``admitted`` stint, and :attr:`queue_wait` sums every
+stint — the preemption cost is visible in the same metric that covers
+cold admission."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from .metrics import now
+
+__all__ = ["RequestTrace", "TERMINAL_STATES", "LIFECYCLE_STATES"]
+
+#: canonical transition vocabulary, in lifecycle order
+LIFECYCLE_STATES = ("arrival", "queued", "admitted", "prefill",
+                    "first_token", "decode_chunk", "preempted",
+                    "retired", "failed")
+TERMINAL_STATES = frozenset({"retired", "failed"})
+
+_ids = itertools.count(1)
+_ids_lock = threading.Lock()
+
+
+def _next_id() -> int:
+    with _ids_lock:
+        return next(_ids)
+
+
+class RequestTrace:
+    """Timestamped lifecycle record for one generation request."""
+
+    __slots__ = ("request_id", "events")
+
+    def __init__(self, request_id=None, t=None):
+        self.request_id = (_next_id() if request_id is None
+                           else request_id)
+        self.events: list[tuple[str, float]] = [
+            ("arrival", now() if t is None else t)]
+
+    def mark(self, state: str, t: float | None = None) -> float:
+        """Append a transition; returns its timestamp. ``t`` overrides
+        the clock (tests only)."""
+        t = now() if t is None else t
+        self.events.append((state, t))
+        return t
+
+    def mark_once(self, state: str, t: float | None = None):
+        """Mark only if ``state`` was never recorded; returns the new
+        timestamp, or None when the state already exists (a resumed
+        request does not get a second ``first_token``)."""
+        if self.first(state) is not None:
+            return None
+        return self.mark(state, t)
+
+    # -- lookups ------------------------------------------------------------
+    def times(self, state: str) -> list[float]:
+        return [t for s, t in self.events if s == state]
+
+    def first(self, state: str):
+        for s, t in self.events:
+            if s == state:
+                return t
+        return None
+
+    def last(self, state: str):
+        for s, t in reversed(self.events):
+            if s == state:
+                return t
+        return None
+
+    def count(self, state: str) -> int:
+        return sum(1 for s, _ in self.events if s == state)
+
+    @property
+    def arrival(self) -> float:
+        return self.events[0][1]
+
+    @property
+    def terminal(self):
+        """The terminal state reached, or None while in flight."""
+        for s, _ in reversed(self.events):
+            if s in TERMINAL_STATES:
+                return s
+        return None
+
+    # -- derived metrics ----------------------------------------------------
+    @property
+    def ttft(self):
+        """Arrival -> first emitted token (None before the first
+        token). Includes queueing, admission, and the prefill — the
+        latency a CALLER sees, not just device time."""
+        tf = self.first("first_token")
+        return None if tf is None else tf - self.arrival
+
+    def tpot(self, n_new_tokens: int):
+        """Average per-output-token latency over the decode phase:
+        (terminal - first_token) / (n - 1). None until terminal or for
+        single-token requests."""
+        tf = self.first("first_token")
+        term = self.terminal
+        if tf is None or term is None or n_new_tokens <= 1:
+            return None
+        return (self.last(term) - tf) / (n_new_tokens - 1)
+
+    @property
+    def queue_wait(self) -> float:
+        """Total time spent waiting for admission, summed over every
+        queued->admitted stint (re-queues after preemption count). A
+        request admitted without an explicit ``queued`` mark (the
+        contiguous engine's direct path) charges arrival->admitted."""
+        total, tq, saw_pair = 0.0, None, False
+        for s, t in self.events:
+            if s == "queued" and tq is None:
+                tq = t
+            elif s == "admitted":
+                if tq is not None:
+                    total += t - tq
+                    tq = None
+                    saw_pair = True
+        if not saw_pair:
+            ta = self.first("admitted")
+            return 0.0 if ta is None else ta - self.arrival
+        return total
+
+    @property
+    def preemptions(self) -> int:
+        return self.count("preempted")
+
+    @property
+    def decode_chunks(self) -> int:
+        return self.count("decode_chunk")
+
+    # -- validation ---------------------------------------------------------
+    def is_monotone(self) -> bool:
+        """Timestamps never go backwards (append order == time order)."""
+        ts = [t for _, t in self.events]
+        return all(b >= a for a, b in zip(ts, ts[1:]))
+
+    def is_complete(self) -> bool:
+        """A retired request passed through every mandatory state in
+        order; a failed request just needs the terminal mark."""
+        if self.terminal == "failed":
+            return True
+        if self.terminal != "retired":
+            return False
+        order = [self.arrival, self.first("admitted"),
+                 self.first("first_token"), self.last("retired")]
+        if any(t is None for t in order):
+            return False
+        return all(b >= a for a, b in zip(order, order[1:]))
+
+    def summary(self) -> dict:
+        """JSON-able digest (stall-watchdog dumps, debug logging)."""
+        term = self.terminal
+        return {
+            "request_id": self.request_id,
+            "state": term or (self.events[-1][0] if self.events
+                              else "arrival"),
+            "ttft_s": self.ttft,
+            "queue_wait_s": self.queue_wait,
+            "preemptions": self.preemptions,
+            "decode_chunks": self.decode_chunks,
+            "events": [(s, round(t, 6)) for s, t in self.events],
+        }
+
+    def __repr__(self):
+        return (f"RequestTrace(id={self.request_id}, "
+                f"state={self.events[-1][0]}, "
+                f"events={len(self.events)})")

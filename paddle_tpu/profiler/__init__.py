@@ -288,11 +288,15 @@ class Profiler:
                                "ts": s.start_ns / 1e3, "pid": os.getpid(),
                                "tid": s.tid, "s": "t", "cat": "op"})
             else:
+                # cat carries the span kind ("user", "engine", ...), so
+                # the serving lifecycle spans the DecodeEngine emits
+                # render as their own category in one unified timeline
+                # next to op-dispatch instants (ISSUE 3)
                 events.append({"name": s.name, "ph": "X",
                                "ts": s.start_ns / 1e3,
                                "dur": (s.end_ns - s.start_ns) / 1e3,
                                "pid": os.getpid(), "tid": s.tid,
-                               "cat": "user"})
+                               "cat": s.kind})
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)

@@ -22,12 +22,27 @@ import sys
 import time
 from collections import deque
 
-__all__ = ["get_logger", "EventLog", "log_event", "default_event_log"]
+__all__ = ["get_logger", "EventLog", "log_event", "default_event_log",
+           "kv_line", "log_kv"]
 
 _FMT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
 
+_LEVEL_NAMES = {"debug": logging.DEBUG, "info": logging.INFO,
+                "warning": logging.WARNING, "warn": logging.WARNING,
+                "error": logging.ERROR, "critical": logging.CRITICAL}
+
 
 def _glog_level() -> int:
+    """Level resolution: ``PT_LOG_LEVEL`` (name or numeric, the serving
+    stack's knob) wins over the reference's ``GLOG_v`` verbosity."""
+    pt = os.environ.get("PT_LOG_LEVEL", "").strip().lower()
+    if pt:
+        if pt in _LEVEL_NAMES:
+            return _LEVEL_NAMES[pt]
+        try:
+            return int(pt)
+        except ValueError:
+            pass
     try:
         v = int(os.environ.get("GLOG_v", "0"))
     except ValueError:
@@ -46,6 +61,24 @@ def get_logger(name, level=None, fmt=_FMT):
         logger.addHandler(h)
     logger.propagate = False
     return logger
+
+
+def kv_line(event: str, **fields) -> str:
+    """``event key=value key=value`` — the structured single-line form
+    engine/server log lines use instead of bare prints (ISSUE 3
+    satellite: greppable fields like request id / row / pages)."""
+    if not fields:
+        return event
+    return event + " " + " ".join(
+        f"{k}={v}" for k, v in fields.items())
+
+
+def log_kv(logger, event: str, *, level=logging.INFO, **fields) -> str:
+    """Emit a ``key=value`` structured line through a classic logger
+    (level-gated by ``PT_LOG_LEVEL``/``GLOG_v``). Returns the line."""
+    line = kv_line(event, **fields)
+    logger.log(level, line)
+    return line
 
 
 class EventLog:

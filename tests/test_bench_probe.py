@@ -47,12 +47,15 @@ def test_infra_skip_metric_follows_preset(monkeypatch, capsys):
 
 
 @pytest.mark.slow
-def test_prefix_preset_cpu_smoke():
+def test_prefix_preset_cpu_smoke(tmp_path):
     """End-to-end CPU run of BENCH_PRESET=prefix (ISSUE 2 satellite):
     one JSON line, cached TTFT strictly below uncached (vs_baseline is
-    their ratio), and the engine actually served prefix hits."""
+    their ratio), and the engine actually served prefix hits. r8: the
+    run also dumps the engine's metrics-registry snapshot and links it
+    from extra.metrics_snapshot."""
     env = dict(os.environ, BENCH_PRESET="prefix", BENCH_ALLOW_CPU="1",
                BENCH_NO_WALL="1", BENCH_SKIP_PROBE="1",
+               BENCH_METRICS_DIR=str(tmp_path),
                JAX_PLATFORMS="cpu")
     r = subprocess.run([sys.executable, bench.__file__], env=env,
                        capture_output=True, text=True, timeout=540)
@@ -66,6 +69,11 @@ def test_prefix_preset_cpu_smoke():
     assert out["vs_baseline"] > 1.0    # cached strictly beats uncached
     assert out["extra"]["prefix_hit_tokens"] > 0
     assert out["extra"]["uncached_ttft_ms"] > out["value"]
+    snap_path = out["extra"]["metrics_snapshot"]
+    assert snap_path == str(tmp_path / "bench_metrics_prefix.json")
+    snap = json.load(open(snap_path))
+    assert snap["counters"]["engine_prefix_hit_tokens_total"] > 0
+    assert snap["histograms"]["engine_ttft_seconds"]["count"] > 0
 
 
 def test_env_flag_tolerant(monkeypatch):

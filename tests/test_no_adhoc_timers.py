@@ -1,0 +1,36 @@
+"""Timer-discipline lint (ISSUE 3 satellite): serving code must stamp
+time through ``paddle_tpu.observability.now`` — the one clock the
+metrics registry, request traces, and engine spans share — never via
+ad-hoc ``time.perf_counter()`` pairs. A raw call sneaking back into the
+inference package would let a hand-rolled latency number disagree with
+the trace-derived histograms, which is exactly the drift the
+observability layer exists to end."""
+
+import pathlib
+
+INFERENCE = (pathlib.Path(__file__).resolve().parent.parent
+             / "paddle_tpu" / "inference")
+
+BANNED = "time.perf_counter"
+
+
+def test_inference_package_has_no_raw_perf_counter():
+    offenders = []
+    for py in sorted(INFERENCE.glob("*.py")):
+        text = py.read_text()
+        for lineno, line in enumerate(text.splitlines(), 1):
+            if BANNED in line:
+                offenders.append(f"{py.name}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "raw time.perf_counter() in paddle_tpu/inference/ — use "
+        "`from ..observability import now` instead:\n"
+        + "\n".join(offenders))
+
+
+def test_shared_clock_is_perf_counter():
+    """The alias must BE the high-resolution monotonic clock (the lint
+    bans the spelling, not the clock)."""
+    import time
+
+    from paddle_tpu.observability import now
+    assert now is time.perf_counter

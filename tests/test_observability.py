@@ -1,0 +1,562 @@
+"""Serving telemetry (ISSUE 3): metrics primitives (thread safety,
+bucket edges, Prometheus exposition), request lifecycle traces with
+injected clocks, the engine's end-to-end trace/registry wiring over the
+debug llama, unified chrome-trace engine spans, the allocator
+conservation invariant under preemption stress, and the engine stall
+watchdog driven deterministically."""
+
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import (Counter, Gauge, Histogram,
+                                      MetricsRegistry, RequestTrace,
+                                      DEFAULT_LATENCY_BUCKETS)
+
+
+class TestCounter:
+    def test_inc_and_value(self):
+        c = Counter("c_total")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+
+    def test_negative_inc_raises(self):
+        c = Counter("c_total")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_concurrent_incs_lose_nothing(self):
+        c = Counter("c_total")
+
+        def worker():
+            for _ in range(1000):
+                c.inc()
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 8000
+
+
+class TestGauge:
+    def test_set_inc_dec(self):
+        g = Gauge("g")
+        g.set(5)
+        g.inc(2)
+        g.dec()
+        assert g.value == 6.0
+
+    def test_fn_reads_at_collection_time(self):
+        """The one-source-of-truth contract: the gauge re-reads the
+        callback on every .value, never caching a stale mirror."""
+        box = {"v": 1}
+        g = Gauge("g", fn=lambda: box["v"])
+        assert g.value == 1.0
+        box["v"] = 7
+        assert g.value == 7.0
+
+    def test_fn_exception_reads_nan(self):
+        g = Gauge("g", fn=lambda: 1 / 0)
+        assert g.value != g.value          # NaN, not a raised scrape
+
+
+class TestHistogram:
+    def test_default_buckets_cover_latency_range(self):
+        assert DEFAULT_LATENCY_BUCKETS[0] == pytest.approx(1e-4)
+        assert DEFAULT_LATENCY_BUCKETS[-1] > 100.0
+        assert list(DEFAULT_LATENCY_BUCKETS) == \
+            sorted(DEFAULT_LATENCY_BUCKETS)
+
+    def test_le_edge_is_inclusive(self):
+        h = Histogram("h", buckets=(1.0, 2.0))
+        h.observe(1.0)                     # == edge: counts in le=1.0
+        cum = dict(h.cumulative())
+        assert cum[1.0] == 1
+
+    def test_overflow_lands_in_inf_only(self):
+        h = Histogram("h", buckets=(1.0, 2.0))
+        h.observe(99.0)
+        cum = h.cumulative()
+        assert cum[-1] == (float("inf"), 1)
+        assert all(c == 0 for _, c in cum[:-1])
+
+    def test_cumulative_monotone_and_inf_equals_count(self):
+        h = Histogram("h")
+        rng = np.random.default_rng(0)
+        for v in rng.uniform(1e-5, 200.0, 500):
+            h.observe(float(v))
+        cum = h.cumulative()
+        counts = [c for _, c in cum]
+        assert counts == sorted(counts)
+        assert cum[-1][1] == h.count == 500
+
+    def test_sum_min_max_quantiles(self):
+        h = Histogram("h", buckets=(1.0, 2.0, 4.0, 8.0))
+        for v in (0.5, 1.5, 3.0, 7.0):
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 4 and s["sum"] == pytest.approx(12.0)
+        assert s["min"] == 0.5 and s["max"] == 7.0
+        assert h.quantile(0.5) == 2.0      # upper edge of holding bucket
+        assert h.quantile(1.0) == 8.0
+
+    def test_quantile_inf_bucket_caps_at_observed_max(self):
+        h = Histogram("h", buckets=(1.0,))
+        h.observe(42.0)
+        assert h.quantile(0.99) == 42.0
+
+    def test_timer_observes_elapsed(self):
+        h = Histogram("h")
+        with h.time():
+            pass
+        assert h.count == 1 and h.sum >= 0.0
+
+    def test_non_increasing_edges_raise(self):
+        with pytest.raises(ValueError):
+            Histogram("h", buckets=(2.0, 1.0))
+        with pytest.raises(ValueError):
+            Histogram("h", buckets=(1.0, 1.0))
+
+
+class TestMetricsRegistry:
+    def test_get_or_create_returns_same_object(self):
+        r = MetricsRegistry()
+        assert r.counter("a_total") is r.counter("a_total")
+        assert "a_total" in r and r.get("a_total") is not None
+
+    def test_type_conflict_raises(self):
+        r = MetricsRegistry()
+        r.counter("x")
+        with pytest.raises(TypeError, match="Counter"):
+            r.gauge("x")
+
+    def test_snapshot_is_json_able(self):
+        r = MetricsRegistry()
+        r.counter("c_total").inc(3)
+        r.gauge("g").set(2.5)
+        h = r.histogram("lat_seconds")
+        h.observe(0.01)
+        snap = json.loads(json.dumps(r.snapshot()))
+        assert snap["counters"]["c_total"] == 3
+        assert snap["gauges"]["g"] == 2.5
+        hs = snap["histograms"]["lat_seconds"]
+        assert hs["count"] == 1 and hs["buckets"]["+Inf"] == 1
+
+    def test_prometheus_text_format(self):
+        r = MetricsRegistry()
+        r.counter("req_total", "requests").inc(2)
+        r.gauge("depth", "queue depth").set(4)
+        h = r.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        text = r.prometheus_text()
+        assert "# HELP req_total requests" in text
+        assert "# TYPE req_total counter" in text
+        assert "req_total 2" in text
+        assert "# TYPE depth gauge" in text and "depth 4" in text
+        assert "# TYPE lat_seconds histogram" in text
+        assert 'lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 2' in text
+        assert "lat_seconds_sum 5.05" in text
+        assert "lat_seconds_count 2" in text
+
+
+class TestRequestTrace:
+    def test_derived_metrics_from_injected_clock(self):
+        tr = RequestTrace(t=0.0)
+        tr.mark("queued", t=1.0)
+        tr.mark("admitted", t=3.0)
+        tr.mark("first_token", t=4.0)
+        tr.mark("retired", t=10.0)
+        assert tr.ttft == 4.0
+        assert tr.queue_wait == 2.0        # queued->admitted only
+        assert tr.tpot(4) == pytest.approx(2.0)  # (10-4)/3
+        assert tr.terminal == "retired"
+        assert tr.is_monotone() and tr.is_complete()
+
+    def test_queue_wait_sums_preemption_stints(self):
+        tr = RequestTrace(t=0.0)
+        tr.mark("queued", t=0.0)
+        tr.mark("admitted", t=1.0)
+        tr.mark("first_token", t=1.5)
+        tr.mark("preempted", t=2.0)
+        tr.mark("queued", t=2.0)
+        tr.mark("admitted", t=5.0)
+        tr.mark("retired", t=6.0)
+        assert tr.queue_wait == pytest.approx(4.0)   # 1.0 + 3.0
+        assert tr.preemptions == 1
+        assert tr.is_complete()
+
+    def test_no_queued_mark_charges_arrival_to_admitted(self):
+        tr = RequestTrace(t=2.0)           # contiguous-mode direct admit
+        tr.mark("admitted", t=5.0)
+        assert tr.queue_wait == pytest.approx(3.0)
+
+    def test_mark_once_skips_duplicates(self):
+        tr = RequestTrace(t=0.0)
+        assert tr.mark_once("first_token", t=1.0) == 1.0
+        assert tr.mark_once("first_token", t=2.0) is None
+        assert tr.times("first_token") == [1.0]
+
+    def test_incomplete_without_first_token(self):
+        tr = RequestTrace(t=0.0)
+        tr.mark("admitted", t=1.0)
+        tr.mark("retired", t=2.0)
+        assert not tr.is_complete()
+
+    def test_failed_is_terminal_and_complete(self):
+        tr = RequestTrace(t=0.0)
+        tr.mark("failed", t=1.0)
+        assert tr.terminal == "failed" and tr.is_complete()
+
+    def test_summary_json_able_and_ids_unique(self):
+        a, b = RequestTrace(), RequestTrace()
+        assert a.request_id != b.request_id
+        json.dumps(a.summary())
+
+
+def _debug_model():
+    paddle.seed(0)
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    m = LlamaForCausalLM("debug")
+    m.eval()
+    return m
+
+
+def _drive(eng, pending, iters=500):
+    for _ in range(iters):
+        eng.admit(pending)
+        eng.decode_once()
+        if eng.idle() and not pending:
+            return
+    raise AssertionError("engine did not drain the workload")
+
+
+class TestEngineLifecycleTelemetry:
+    def test_every_retired_request_has_complete_trace(self):
+        from paddle_tpu.inference.serving import DecodeEngine, _Request
+        m = _debug_model()
+        rng = np.random.RandomState(7)
+        eng = DecodeEngine(m, capacity=2, s_max=64, chunk=4,
+                           block_size=8)
+        reqs = [_Request(rng.randint(1, 128,
+                                     (int(rng.randint(3, 12)),))
+                         .astype(np.int32), int(rng.choice([3, 6])))
+                for _ in range(5)]
+        _drive(eng, list(reqs))
+        for r in reqs:
+            r.wait(timeout=5)
+            tr = r.trace
+            assert tr.terminal == "retired"
+            assert tr.is_monotone() and tr.is_complete()
+            states = {s for s, _ in tr.events}
+            assert {"arrival", "queued", "admitted", "first_token",
+                    "decode_chunk", "retired"} <= states
+            assert tr.ttft is not None and tr.ttft >= 0.0
+
+    def test_registry_histograms_match_lifecycle_counts(self):
+        from paddle_tpu.inference.serving import DecodeEngine, _Request
+        m = _debug_model()
+        rng = np.random.RandomState(9)
+        eng = DecodeEngine(m, capacity=2, s_max=64, chunk=4,
+                           block_size=8)
+        reqs = [_Request(rng.randint(1, 128, (6,)).astype(np.int32), 6)
+                for _ in range(4)]
+        _drive(eng, list(reqs))
+        snap = eng.metrics.snapshot()
+        assert snap["counters"]["engine_admitted_total"] == 4
+        assert snap["counters"]["engine_retired_total"] == 4
+        assert snap["counters"]["engine_failed_total"] == 0
+        # one TTFT / queue-wait observation per admission, one TPOT per
+        # multi-token retire — the histograms ARE the lifecycle record
+        assert snap["histograms"]["engine_ttft_seconds"]["count"] == 4
+        assert snap["histograms"]["engine_queue_wait_seconds"][
+            "count"] == 4
+        assert snap["histograms"]["engine_tpot_seconds"]["count"] == 4
+        assert snap["histograms"]["engine_chunk_seconds"]["count"] >= 1
+        g = snap["gauges"]
+        for name in ("engine_backlog", "engine_pool_free",
+                     "allocator_in_use", "engine_pool_high_watermark",
+                     "engine_batch_occupancy", "engine_prefix_hit_rate"):
+            assert name in g, name
+        assert g["engine_backlog"] == 0
+        # stats() is a THIN view over the same registry
+        st = eng.stats()
+        # rows are gone but their published prefix pages stay cached —
+        # the gauge reads the allocator, not a drifting mirror
+        assert g["allocator_in_use"] == st["pool"]["used"]
+        assert st["admitted"] == 4 and st["retired"] == 4
+        assert st["pool"]["high_watermark"] == \
+            g["engine_pool_high_watermark"]
+        json.dumps(snap)
+        assert "engine_ttft_seconds_bucket" in \
+            eng.metrics.prometheus_text()
+
+    def test_private_registries_do_not_cross_pollute(self):
+        from paddle_tpu.inference.serving import DecodeEngine, _Request
+        m = _debug_model()
+        rng = np.random.RandomState(3)
+        p = rng.randint(1, 128, (6,)).astype(np.int32)
+        e1 = DecodeEngine(m, capacity=2, s_max=64, chunk=4,
+                          block_size=8)
+        e2 = DecodeEngine(m, capacity=2, s_max=64, chunk=4,
+                          block_size=8)
+        _drive(e1, [_Request(p, 4)])
+        assert e1.stats()["retired"] == 1
+        assert e2.stats()["retired"] == 0
+
+    def test_ttft_observed_once_across_preemption(self):
+        """A preempted-and-resumed request keeps ONE first_token mark:
+        the TTFT histogram must not double-count the resume."""
+        from paddle_tpu.inference.serving import DecodeEngine, _Request
+        m = _debug_model()
+        rng = np.random.RandomState(18)
+        eng = DecodeEngine(m, capacity=3, s_max=64, chunk=4,
+                           block_size=8, n_blocks=6)
+        reqs = [_Request(rng.randint(1, 128,
+                                     (int(rng.randint(3, 14)),))
+                         .astype(np.int32),
+                         int(rng.choice([3, 6, 10])),
+                         priority=int(rng.randint(0, 3)))
+                for _ in range(8)]
+        queue, pending = list(reqs), []
+        for _ in range(2000):
+            while queue and len(pending) < 2:
+                pending.append(queue.pop(0))
+            eng.admit(pending)
+            eng.decode_once()
+            if not queue and not pending and eng.idle():
+                break
+        else:
+            raise AssertionError("stress workload did not drain")
+        preempted = sum(r.trace.preemptions for r in reqs)
+        assert preempted >= 1              # the tiny pool forced some
+        for r in reqs:
+            assert r.trace.count("first_token") <= 1
+            if r.trace.terminal == "retired":
+                assert r.trace.is_complete()
+        snap = eng.metrics.snapshot()
+        assert snap["counters"]["engine_preempted_total"] == preempted
+        assert snap["histograms"]["engine_ttft_seconds"]["count"] == \
+            snap["counters"]["engine_admitted_total"] - preempted
+
+
+class TestAllocatorConservation:
+    def test_invariant_across_preemption_stress(self):
+        """total_allocated - total_freed == in_use at EVERY engine step
+        of a pool-starved preempting workload, and the pool drains to
+        zero — the counter-drift class the satellite closes."""
+        from paddle_tpu.inference.serving import DecodeEngine, _Request
+        m = _debug_model()
+        rng = np.random.RandomState(18)
+        eng = DecodeEngine(m, capacity=3, s_max=64, chunk=4,
+                           block_size=8, n_blocks=6)
+        reqs = [_Request(rng.randint(1, 128,
+                                     (int(rng.randint(3, 14)),))
+                         .astype(np.int32),
+                         int(rng.choice([3, 6, 10])),
+                         priority=int(rng.randint(0, 3)))
+                for _ in range(8)]
+        queue, pending = list(reqs), []
+        a = eng._alloc
+        for _ in range(2000):
+            while queue and len(pending) < 2:
+                pending.append(queue.pop(0))
+            eng.admit(pending)
+            eng.decode_once()
+            assert a.total_allocated - a.total_freed == a.in_use
+            if not queue and not pending and eng.idle():
+                break
+        else:
+            raise AssertionError("stress workload did not drain")
+        # cached prefix pages may legitimately stay resident; evicting
+        # everything must take the pool back to exactly zero in use
+        if eng._cache is not None:
+            eng._cache.evict(eng.n_blocks)
+        assert a.in_use == 0
+        assert a.total_allocated == a.total_freed
+        # the gauge reads the same source of truth
+        assert eng.metrics.get("allocator_in_use").value == 0
+
+    def test_gauge_tracks_live_allocator(self):
+        from paddle_tpu.inference.paged_cache import BlockAllocator
+        r = MetricsRegistry()
+        a = BlockAllocator(8)
+        r.gauge("allocator_in_use", fn=lambda: a.in_use)
+        pages = a.allocate(3)
+        assert r.get("allocator_in_use").value == 3
+        a.free(pages)
+        assert r.get("allocator_in_use").value == 0
+        assert a.total_allocated - a.total_freed == a.in_use == 0
+
+
+class TestChromeTraceUnifiedTimeline:
+    def test_engine_spans_and_op_events_share_one_export(self, tmp_path):
+        """The unified timeline: engine lifecycle spans (cat=engine)
+        and op-dispatch instants land in ONE chrome trace."""
+        from paddle_tpu import profiler
+        from paddle_tpu.inference.serving import DecodeEngine, _Request
+        m = _debug_model()
+        rng = np.random.RandomState(5)
+        eng = DecodeEngine(m, capacity=2, s_max=64, chunk=4,
+                           block_size=8)
+        prof = profiler.Profiler()
+        prof.start()
+        reqs = [_Request(rng.randint(1, 128, (6,)).astype(np.int32), 4)
+                for _ in range(2)]
+        pending = list(reqs)
+        for _ in range(200):
+            eng.admit(pending)
+            eng.decode_once()
+            # a host-side paddle op inside the window: the op instant
+            # must interleave with the engine spans in the same export
+            (paddle.to_tensor(np.ones((2, 2), np.float32)) * 2.0)
+            if eng.idle() and not pending:
+                break
+        prof.stop()
+        path = str(tmp_path / "trace.json")
+        prof.export_chrome_tracing(path)
+        data = json.load(open(path))
+        by_cat = {}
+        for e in data["traceEvents"]:
+            by_cat.setdefault(e.get("cat"), set()).add(e["name"])
+        assert "engine.prefill" in by_cat.get("engine", set())
+        assert "engine.decode_chunk" in by_cat.get("engine", set())
+        assert any(c != "engine" and c is not None for c in by_cat)
+
+    def test_record_event_is_cheap_when_disabled(self):
+        """Engine spans ride RecordEvent unconditionally — with no
+        profiler enabled they must not emit anything."""
+        from paddle_tpu import profiler
+        from paddle_tpu.profiler import RecordEvent
+        with RecordEvent("engine.decode_chunk", "engine"):
+            pass
+        prof = profiler.Profiler()
+        prof.start()
+        prof.stop()
+        assert "engine.decode_chunk" not in prof.summary()["events"]
+
+
+class TestEngineStallWatchdog:
+    def _registry(self, steps=0, occupancy=1, backlog=0):
+        r = MetricsRegistry()
+        r.counter("engine_device_steps_total").inc(steps)
+        r.gauge("engine_batch_occupancy").set(occupancy)
+        r.gauge("engine_backlog").set(backlog)
+        return r
+
+    def _wd(self, registry, **kw):
+        from paddle_tpu.distributed.watchdog import EngineStallWatchdog
+        kw.setdefault("stall_s", 10.0)
+        return EngineStallWatchdog(registry, **kw)
+
+    def test_fires_once_per_stall_episode(self):
+        r = self._registry(steps=5)
+        events = []
+        wd = self._wd(r, on_stall=events.append)
+        assert wd.check(now=0.0) is None       # baseline
+        assert wd.check(now=5.0) is None       # under threshold
+        info = wd.check(now=15.0)              # static 15s while busy
+        assert info is not None
+        assert info["counter"] == "engine_device_steps_total"
+        assert info["stalled_s"] == pytest.approx(15.0)
+        assert info["snapshot"]["gauges"]["engine_batch_occupancy"] == 1
+        assert wd.check(now=30.0) is None      # same episode: no re-fire
+        assert events == [info] and wd.stalls == [info]
+
+    def test_advancing_heartbeat_rearms(self):
+        r = self._registry(steps=0)
+        wd = self._wd(r)
+        assert wd.check(now=0.0) is None
+        assert wd.check(now=15.0) is not None  # first stall
+        r.counter("engine_device_steps_total").inc(4)
+        assert wd.check(now=20.0) is None      # moved: re-armed
+        assert wd.check(now=35.0) is not None  # second distinct episode
+        assert len(wd.stalls) == 2
+
+    def test_idle_engine_never_stalls(self):
+        r = self._registry(steps=3, occupancy=0, backlog=0)
+        wd = self._wd(r)
+        assert wd.check(now=0.0) is None
+        assert wd.check(now=100.0) is None     # quiet != stalled
+        # backlog alone (requests waiting, no rows) still counts as busy
+        r.gauge("engine_backlog").set(2)
+        assert wd.check(now=101.0) is None     # busy clock starts here
+        assert wd.check(now=120.0) is not None
+
+    def test_stall_dump_hits_event_log(self):
+        from paddle_tpu.utils.log import default_event_log
+        r = self._registry(steps=1)
+        wd = self._wd(r)
+        wd.check(now=0.0)
+        mark = len(default_event_log.events("engine_stall"))
+        assert wd.check(now=60.0) is not None
+        evts = default_event_log.events("engine_stall")[mark:]
+        assert len(evts) == 1
+        assert evts[0]["snapshot"]["counters"][
+            "engine_device_steps_total"] == 1
+
+    def test_missing_counter_is_not_a_stall(self):
+        wd = self._wd(MetricsRegistry())
+        assert wd.check(now=0.0) is None
+        assert wd.check(now=100.0) is None
+
+
+class TestStructuredLogging:
+    def test_kv_line_format(self):
+        from paddle_tpu.utils.log import kv_line
+        assert kv_line("admitted", req=3, slot=0) == \
+            "admitted req=3 slot=0"
+        assert kv_line("tick") == "tick"
+
+    def test_log_kv_respects_logger_level(self, caplog):
+        from paddle_tpu.utils.log import log_kv
+        logger = logging.getLogger("pt.test.obs")
+        logger.setLevel(logging.INFO)
+        logger.propagate = True
+        with caplog.at_level(logging.INFO, logger="pt.test.obs"):
+            log_kv(logger, "retired", req=1, ttft_s=0.5)
+            log_kv(logger, "chatter", level=logging.DEBUG, x=1)
+        assert "retired req=1 ttft_s=0.5" in caplog.text
+        assert "chatter" not in caplog.text
+
+    def test_pt_log_level_env_knob(self, monkeypatch):
+        from paddle_tpu.utils import log as ptlog
+        monkeypatch.setenv("PT_LOG_LEVEL", "debug")
+        assert ptlog._glog_level() == logging.DEBUG
+        monkeypatch.setenv("PT_LOG_LEVEL", "40")
+        assert ptlog._glog_level() == logging.ERROR
+        monkeypatch.delenv("PT_LOG_LEVEL")
+        monkeypatch.setenv("GLOG_v", "0")
+        assert ptlog._glog_level() == logging.WARNING
+
+    def test_server_stats_is_registry_view(self):
+        """BatchingServer counts submissions through the registry and
+        exposes a thin stats() view (engine stats ride along in
+        continuous mode)."""
+        from paddle_tpu.inference.serving import (BatchingServer,
+                                                  GenerationPredictor)
+        m = _debug_model()
+        srv = BatchingServer(GenerationPredictor(m), max_batch=2,
+                             max_new_tokens=4, continuous=True,
+                             engine_kwargs={"s_max": 64, "chunk": 4,
+                                            "block_size": 8})
+        try:
+            assert srv.metrics is srv.engine.metrics
+            r = srv.submit(np.array([1, 5, 9], np.int32))
+            r.wait(timeout=120)
+            st = srv.stats()
+            assert st["submitted"] == 1
+            assert st["engine"]["retired"] == 1
+            snap = srv.metrics.snapshot()
+            assert snap["counters"]["server_submitted_total"] == 1
+            assert snap["counters"]["engine_retired_total"] == 1
+        finally:
+            srv.close()

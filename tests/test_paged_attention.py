@@ -129,7 +129,8 @@ class TestBlockAllocator:
         assert sorted(again) == sorted(rows[0] + rows[2])
         assert a.num_free == 0
         assert a.stats() == {"capacity": 16, "used": 16, "free": 0,
-                             "high_watermark": 16}
+                             "high_watermark": 16,
+                             "total_allocated": 24, "total_freed": 8}
 
     def test_rejects_degenerate_pool(self):
         from paddle_tpu.inference.paged_cache import BlockAllocator
