@@ -132,7 +132,10 @@ class EngineStallWatchdog:
 
     :meth:`check` is public and deterministic (pass ``now`` to drive
     time by hand in tests); :meth:`start` runs it on a daemon thread
-    every ``poll_s`` seconds."""
+    every ``poll_s`` seconds. ``on_stall=`` is called once per episode
+    right after the snapshot dump — the ServingFleet uses it to mark a
+    worker unhealthy without polling ``stalls``; callback exceptions
+    are logged, never propagated."""
 
     def __init__(self, registry, stall_s=30.0, poll_s=5.0,
                  counter="engine_device_steps_total",
@@ -196,7 +199,16 @@ class EngineStallWatchdog:
                stalled_s=info["stalled_s"],
                backlog=backlog.value if backlog is not None else None)
         if self.on_stall is not None:
-            self.on_stall(info)
+            # fleet hook: ServingFleet marks the worker unhealthy here
+            # (fired once per episode, AFTER the snapshot dump above).
+            # A raising callback must not wedge the poll thread — the
+            # dump already happened, so swallow and log.
+            try:
+                self.on_stall(info)
+            except Exception as e:      # noqa: BLE001
+                log_kv(_log, "on_stall_callback_failed",
+                       level=logging.ERROR,
+                       error=type(e).__name__, detail=str(e))
         return info
 
     # -- background polling -------------------------------------------------

@@ -1,0 +1,479 @@
+"""Serving fleet: prefix-affinity router + worker failover (ISSUE 4
+tentpole; reference shape: GSPMD's lesson that multi-worker placement
+wants to be a first-class LAYER, and the Ragged Paged Attention stance
+that per-engine KV state stays local — only the cheap host-side index
+is shared).
+
+A :class:`ServingFleet` owns N in-process :class:`DecodeEngine` workers
+(each with its PRIVATE metrics registry and KV block pool) behind one
+``submit()`` API. Three load-bearing parts:
+
+- :class:`GlobalPrefixDirectory` — a host-side index mapping token
+  prefixes (at page granularity, as incremental chain hashes over full
+  blocks) to the workers whose ``PrefixCache`` holds them. Each
+  worker's cache notifies the directory on publish/evict through the
+  ``PrefixCache(listener=)`` hook, so the router can score workers by
+  ``cached_tokens(prefix) − load_penalty(backlog, occupancy)`` and
+  shared-system-prompt traffic lands where its pages already live.
+
+  CONSISTENCY RULE: the directory is a routing HINT, never a
+  correctness input. Only the owning worker's ``PrefixCache.match`` at
+  admission decides what is actually reused — a stale directory entry
+  costs one cold prefill, nothing more. That is why listener faults
+  are swallowed and why ``drop_worker`` can be a blunt wipe.
+
+- Failover — a worker whose :class:`EngineStallWatchdog` fires (via
+  ``on_stall=``) or whose step raises is drained: its in-flight rows
+  are harvested exactly like r7's lossless preemption
+  (``req._resume_toks = emitted tokens``, trace marked "preempted")
+  and re-routed to healthy workers, where recompute-resume admission
+  replays them bit-identically to an undisturbed run (greedy decode).
+  The dead engine's device state and allocator are never touched —
+  harvest is host-side only.
+
+- Metrics — per-worker registries aggregate through
+  :class:`~paddle_tpu.inference.fleet_metrics.MetricsAggregator`
+  (merged fleet snapshot + Prometheus exposition with ``worker="w3"``
+  labels) and can be served from a stdlib scrape endpoint
+  (:meth:`ServingFleet.serve_metrics`).
+
+The fleet is driven synchronously (:meth:`step` /
+:meth:`run_until_drained`) so failover tests are deterministic;
+watchdog poll threads are opt-in via :meth:`start_watchdogs`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..distributed.watchdog import EngineStallWatchdog
+from ..observability import MetricsRegistry
+from ..utils.log import get_logger, log_event, log_kv
+from .serving import DecodeEngine, _Request, _tmark
+
+__all__ = ["GlobalPrefixDirectory", "ServingFleet"]
+
+_log = get_logger("paddle_tpu.inference.fleet")
+
+
+class _DirectoryListener:
+    """Per-worker adapter bound into that worker's ``PrefixCache``."""
+
+    __slots__ = ("_dir", "_wid")
+
+    def __init__(self, directory, worker_id):
+        self._dir = directory
+        self._wid = worker_id
+
+    def on_insert(self, tokens):
+        self._dir.on_insert(self._wid, tokens)
+
+    def on_evict(self, tokens):
+        self._dir.on_evict(self._wid, tokens)
+
+
+class GlobalPrefixDirectory:
+    """Host-side prefix → workers index at page granularity.
+
+    Each cached full block is recorded as an incremental CHAIN hash:
+    ``h_i = hash((h_{i-1}, tokens[i*bs:(i+1)*bs]))`` with ``h_0 = 0``,
+    so membership of a prefix of ``i`` full blocks is one set lookup
+    per block and the directory never stores token ids. Partial
+    (sub-block) leaves are not indexed — they can't be mapped shared
+    at admission anyway (COW copies are private), so they carry no
+    routing signal.
+
+    Updates arrive via the per-worker :meth:`listener` objects wired
+    into each ``PrefixCache``: ``insert`` adds every full-block chain
+    hash of the published prefix (idempotent — sets), ``evict``
+    removes the evicted node's own (deepest) chain hash; parents keep
+    theirs until their own eviction cascades. See the module docstring
+    for the consistency rule: this is a hint, correctness lives in the
+    owning worker's cache."""
+
+    def __init__(self, block_size: int):
+        self._bs = int(block_size)
+        self._by_worker: dict[str, set[int]] = {}
+        self._lock = threading.Lock()
+
+    def listener(self, worker_id: str) -> _DirectoryListener:
+        with self._lock:
+            self._by_worker.setdefault(worker_id, set())
+        return _DirectoryListener(self, worker_id)
+
+    def _chain(self, tokens):
+        """Yield (depth, chain-hash) for every FULL block of tokens."""
+        bs = self._bs
+        h = 0
+        for i in range(len(tokens) // bs):
+            h = hash((h, tuple(int(t) for t in
+                               tokens[i * bs:(i + 1) * bs])))
+            yield i + 1, h
+
+    def on_insert(self, worker_id: str, tokens) -> None:
+        with self._lock:
+            entries = self._by_worker.setdefault(worker_id, set())
+            for _, h in self._chain(tokens):
+                entries.add(h)
+
+    def on_evict(self, worker_id: str, tokens) -> None:
+        """``tokens`` is the root→victim path; the victim is childless,
+        so only the DEEPEST chain hash leaves the index. A path ending
+        in a partial leaf was never indexed — nothing to remove."""
+        if not tokens or len(tokens) % self._bs:
+            return
+        last = None
+        for _, h in self._chain(tokens):
+            last = h
+        with self._lock:
+            self._by_worker.get(worker_id, set()).discard(last)
+
+    def cached_tokens(self, worker_id: str, tokens) -> int:
+        """Longest directory-known full-block prefix of ``tokens`` on
+        ``worker_id``, in TOKENS (the router's affinity term)."""
+        with self._lock:
+            entries = self._by_worker.get(worker_id)
+            if not entries:
+                return 0
+            depth = 0
+            for i, h in self._chain(tokens):
+                if h not in entries:
+                    break
+                depth = i
+            return depth * self._bs
+
+    def drop_worker(self, worker_id: str) -> None:
+        """Failover wipe: a dead worker's pages are unreachable, so its
+        whole index entry goes (blunt is fine — hint, not truth)."""
+        with self._lock:
+            self._by_worker.pop(worker_id, None)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {wid: len(s) for wid, s in self._by_worker.items()}
+
+
+class _Worker:
+    __slots__ = ("wid", "engine", "registry", "watchdog", "pending",
+                 "healthy", "fail_reason")
+
+    def __init__(self, wid, engine, registry, watchdog):
+        self.wid = wid
+        self.engine = engine
+        self.registry = registry
+        self.watchdog = watchdog
+        self.pending: list = []         # routed, not yet handed to admit
+        self.healthy = True
+        self.fail_reason = None
+
+    @property
+    def occupancy(self) -> int:
+        return sum(1 for r in self.engine._rows if r is not None)
+
+    @property
+    def load(self) -> int:
+        return self.engine.backlog + self.occupancy + len(self.pending)
+
+
+class ServingFleet:
+    """N decode engines behind one ``submit()`` with prefix-affinity
+    routing, stall/step failover, and aggregated metrics.
+
+    ``policy`` is ``"affinity"`` (default — score each healthy worker
+    by ``directory.cached_tokens(prompt) − load_penalty * load`` where
+    ``load = backlog + occupancy + routed-but-unadmitted``, ties broken
+    by lowest load then lowest index) or ``"round_robin"`` (the bench
+    baseline). ``load_penalty`` defaults to ``block_size``: one unit of
+    queued work offsets one cached page, so affinity wins only when
+    reuse outweighs the imbalance it creates.
+
+    Drive it synchronously: ``submit()`` routes immediately onto a
+    per-worker pending list; each :meth:`step` runs failover for
+    workers flagged unhealthy, then ``admit`` + one decode chunk on
+    every healthy worker. Futures resolve as rows retire (same
+    ``_Request.wait()`` contract as the engine)."""
+
+    def __init__(self, model, n_workers=2, policy="affinity",
+                 load_penalty=None, engine_kwargs=None,
+                 stall_s=30.0, registry=None):
+        if n_workers < 1:
+            raise ValueError(f"n_workers={n_workers}")
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self.policy = policy
+        kw = dict(engine_kwargs or {})
+        kw.setdefault("paged", True)
+        block_size = int(kw.get("block_size", 16))
+        self.load_penalty = (float(load_penalty)
+                             if load_penalty is not None
+                             else float(block_size))
+        self.directory = GlobalPrefixDirectory(block_size)
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        self._c_submitted = self.metrics.counter(
+            "fleet_submitted_total", "requests accepted by the router")
+        self._c_affinity_hits = self.metrics.counter(
+            "fleet_affinity_hits_total",
+            "submissions routed to a worker with a cached prefix")
+        self._c_failovers = self.metrics.counter(
+            "fleet_failovers_total", "workers drained after stall/fault")
+        self._c_rerouted = self.metrics.counter(
+            "fleet_rerouted_total",
+            "requests re-routed off a failed worker")
+        self.metrics.gauge(
+            "fleet_healthy_workers", "workers currently routable",
+            fn=lambda: sum(1 for w in self.workers if w.healthy))
+        self.workers: list[_Worker] = []
+        for i in range(n_workers):
+            wid = f"w{i}"
+            reg = MetricsRegistry()
+            eng = DecodeEngine(
+                model, registry=reg, worker_id=wid,
+                prefix_listener=self.directory.listener(wid), **kw)
+            wd = EngineStallWatchdog(
+                reg, stall_s=stall_s,
+                on_stall=lambda info, w=wid: self._mark_unhealthy(
+                    w, "stall", info))
+            self.workers.append(_Worker(wid, eng, reg, wd))
+        self._rr = 0                    # round-robin cursor
+        self._seq = 0                   # fleet-wide FCFS stamp: keeps
+        #                                 _sched_seq unique across the
+        #                                 per-worker schedulers, so a
+        #                                 re-routed request never
+        #                                 collides (or loses its global
+        #                                 arrival order) on the new
+        #                                 worker's heap
+        self._lock = threading.Lock()
+        self._http = None
+
+    # -- routing ------------------------------------------------------------
+    def _healthy(self) -> list[_Worker]:
+        return [w for w in self.workers if w.healthy]
+
+    def _route(self, ids) -> _Worker:
+        """Pick the worker for a prompt. MUST be called with the lock
+        held. Raises when no healthy worker remains."""
+        healthy = self._healthy()
+        if not healthy:
+            raise RuntimeError("ServingFleet has no healthy workers")
+        if self.policy == "round_robin" or len(healthy) == 1:
+            w = healthy[self._rr % len(healthy)]
+            self._rr += 1
+            return w
+        scored = []
+        for w in healthy:
+            cached = self.directory.cached_tokens(w.wid, ids)
+            load = w.load
+            score = cached - self.load_penalty * load
+            scored.append((-score, load, w.wid, w, cached))
+        scored.sort(key=lambda t: t[:3])
+        w, cached = scored[0][3], scored[0][4]
+        if cached > 0:
+            self._c_affinity_hits.inc()
+        return w
+
+    def submit(self, input_ids, max_new_tokens=32,
+               priority=0) -> _Request:
+        """Route one request and return its future (``req.wait()``
+        resolves once some worker retires it — drive :meth:`step` or
+        :meth:`run_until_drained` to make progress)."""
+        import numpy as _np
+        ids = _np.asarray(input_ids).reshape(-1)
+        req = _Request(input_ids, max_new_tokens, priority=priority)
+        with self._lock:
+            req._sched_seq = self._seq
+            self._seq += 1
+            w = self._route(ids)
+            w.pending.append(req)
+            self._c_submitted.inc()
+        log_kv(_log, "routed", level=logging.DEBUG, worker=w.wid,
+               req=req.trace.request_id, tokens=int(ids.size),
+               policy=self.policy)
+        return req
+
+    # -- health / failover --------------------------------------------------
+    def _mark_unhealthy(self, wid, reason, info=None):
+        """Flag only — safe from watchdog threads; the harvest itself
+        runs inside :meth:`step` on the driving thread."""
+        for w in self.workers:
+            if w.wid == wid and w.healthy:
+                w.healthy = False
+                w.fail_reason = reason
+                log_kv(_log, "worker_unhealthy", level=logging.ERROR,
+                       worker=wid, reason=reason)
+                log_event("fleet_worker_unhealthy", worker=wid,
+                          reason=reason)
+                return True
+        return False
+
+    def kill_worker(self, wid, reason="killed") -> int:
+        """Test/bench hook: immediately drain ``wid`` and re-route its
+        work. Returns the number of requests re-routed."""
+        with self._lock:
+            if not self._mark_unhealthy(wid, reason):
+                return 0
+            return self._failover_locked()
+
+    def _harvest(self, w: _Worker) -> list:
+        """Host-side drain of a dead worker: in-flight rows become
+        recompute-resume requests exactly like r7 preemption (emitted
+        tokens snapshotted, trace marked), scheduler backlog and the
+        unadmitted pending list ride along untouched. The engine's
+        device arrays/allocator are NOT touched — the worker is dead,
+        its pages are unreachable, and correctness only needs the host
+        tokens."""
+        eng = w.engine
+        out = []
+        for slot, row in enumerate(eng._rows):
+            if row is None:
+                continue
+            req = row["req"]
+            req._resume_toks = list(row["toks"])
+            _tmark(req, "preempted")
+            eng._rows[slot] = None
+            out.append(req)
+        out.extend(eng.drain_pending())
+        out.extend(w.pending)
+        w.pending = []
+        # resumed requests must come back before never-started ones of
+        # equal priority — the fleet-wide _sched_seq already encodes
+        # that; sort keeps the re-route deterministic regardless of
+        # slot order
+        out.sort(key=lambda r: (-int(getattr(r, "priority", 0) or 0),
+                                r._sched_seq))
+        return out
+
+    def _failover_locked(self) -> int:
+        """Drain every worker flagged unhealthy; re-route its requests.
+        Lock held by caller."""
+        moved = 0
+        for w in self.workers:
+            if w.healthy or w.fail_reason == "drained":
+                continue
+            reqs = self._harvest(w)
+            self.directory.drop_worker(w.wid)
+            self._c_failovers.inc()
+            w.fail_reason = "drained"
+            for req in reqs:
+                target = self._route(req.ids.reshape(-1))
+                target.pending.append(req)
+                self._c_rerouted.inc()
+                moved += 1
+            log_kv(_log, "failover", level=logging.ERROR,
+                   worker=w.wid, rerouted=len(reqs))
+            log_event("fleet_failover", worker=w.wid,
+                      rerouted=len(reqs))
+        return moved
+
+    # -- driving ------------------------------------------------------------
+    def step(self) -> int:
+        """One synchronous fleet step: failover anything flagged
+        unhealthy, then admit + one decode chunk per healthy worker (a
+        raising step fails the WORKER, not the fleet — its requests
+        re-route on the spot). Returns live rows across the fleet."""
+        with self._lock:
+            self._failover_locked()
+        alive = 0
+        for w in self.workers:
+            if not w.healthy:
+                continue
+            eng = w.engine
+            try:
+                with self._lock:
+                    batch, w.pending = w.pending, []
+                # run admission even with nothing newly routed: freed
+                # slots re-admit the engine's own scheduler backlog
+                eng.admit(batch)
+                if batch:               # contiguous-mode engines may
+                    with self._lock:    # leave a tail unconsumed
+                        w.pending = batch + w.pending
+                if not eng.idle():
+                    eng.decode_once()
+            except Exception as e:  # noqa: BLE001 — worker fault =>
+                with self._lock:    # failover, not fleet crash
+                    self._mark_unhealthy(
+                        w.wid, f"step_raised:{type(e).__name__}")
+                    self._failover_locked()
+                continue
+            alive += w.occupancy
+        return alive
+
+    def pending_work(self) -> int:
+        """Requests anywhere in flight: routed, scheduled, or running."""
+        return sum(w.load for w in self.workers if w.healthy) \
+            + sum(len(w.pending) for w in self.workers if not w.healthy)
+
+    def run_until_drained(self, max_steps=10_000) -> int:
+        """Step until no healthy worker has work. Returns steps taken."""
+        steps = 0
+        while self.pending_work():
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"fleet not drained after {max_steps} steps "
+                    f"({self.pending_work()} requests in flight)")
+            self.step()
+            steps += 1
+        return steps
+
+    # -- watchdogs ----------------------------------------------------------
+    def check_watchdogs(self, now=None) -> list:
+        """Deterministic stall poll across workers (tests drive
+        ``now=`` by hand). Fired stalls flag workers via ``on_stall``;
+        the NEXT :meth:`step` runs the failover."""
+        fired = []
+        for w in self.workers:
+            if not w.healthy:
+                continue
+            info = w.watchdog.check(now=now)
+            if info is not None:
+                fired.append((w.wid, info))
+        return fired
+
+    def start_watchdogs(self):
+        """Opt-in background polling (daemon threads; the synchronous
+        test path uses :meth:`check_watchdogs` instead)."""
+        for w in self.workers:
+            w.watchdog.start()
+        return self
+
+    # -- observability ------------------------------------------------------
+    def aggregator(self):
+        """Fresh :class:`MetricsAggregator` over every worker registry
+        (dead workers included — their final counters are part of the
+        fleet story) plus this fleet's own router registry."""
+        from .fleet_metrics import MetricsAggregator
+        agg = MetricsAggregator()
+        for w in self.workers:
+            agg.add(w.wid, w.registry)
+        agg.add("router", self.metrics)
+        return agg
+
+    def serve_metrics(self, host="127.0.0.1", port=0):
+        """Start the stdlib scrape endpoint (GET /metrics → labeled
+        Prometheus text, /metrics.json → merged JSON snapshot). Returns
+        the server; ``.port`` holds the bound port when ``port=0``."""
+        from .fleet_metrics import MetricsHTTPServer
+        if self._http is None:
+            self._http = MetricsHTTPServer(
+                self.aggregator(), host=host, port=port).start()
+        return self._http
+
+    def stats(self) -> dict:
+        return {
+            "policy": self.policy,
+            "submitted": int(self._c_submitted.value),
+            "affinity_hits": int(self._c_affinity_hits.value),
+            "failovers": int(self._c_failovers.value),
+            "rerouted": int(self._c_rerouted.value),
+            "healthy_workers": sum(1 for w in self.workers if w.healthy),
+            "directory": self.directory.stats(),
+            "workers": {w.wid: w.engine.stats() for w in self.workers},
+        }
+
+    def close(self):
+        for w in self.workers:
+            w.watchdog.stop()
+        if self._http is not None:
+            self._http.close()
+            self._http = None

@@ -1,0 +1,153 @@
+"""Cross-worker metrics aggregation + scrape endpoint (ISSUE 4).
+
+:class:`MetricsAggregator` merges the per-worker
+:class:`~paddle_tpu.observability.MetricsRegistry` snapshots into one
+fleet-level snapshot (the fixed log-spaced histogram edges were chosen
+mergeable for exactly this — see
+:func:`~paddle_tpu.observability.merge_snapshots`) and renders ONE
+Prometheus exposition body where every sample carries a
+``worker="w3"`` label. Exposition stays spec-valid: all lines of a
+metric are grouped under a single ``# TYPE`` header, with one labeled
+sample set per worker.
+
+:class:`MetricsHTTPServer` is the stdlib scrape endpoint (no client
+library, matching the dependency-free registry): ``GET /metrics`` →
+labeled text exposition, ``GET /metrics.json`` → the merged JSON
+snapshot. Bind ``port=0`` in tests and read ``.port``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..observability.metrics import (Counter, Gauge, Histogram,
+                                     MetricsRegistry, merge_snapshots)
+
+__all__ = ["MetricsAggregator", "MetricsHTTPServer"]
+
+
+class MetricsAggregator:
+    """Ordered ``label -> MetricsRegistry`` view with merged snapshot
+    and per-worker-labeled Prometheus exposition."""
+
+    def __init__(self, registries: dict[str, MetricsRegistry]
+                 | None = None):
+        self._regs: dict[str, MetricsRegistry] = {}
+        for label, reg in (registries or {}).items():
+            self.add(label, reg)
+
+    def add(self, label: str, registry: MetricsRegistry) -> None:
+        if label in self._regs:
+            raise ValueError(f"duplicate worker label {label!r}")
+        self._regs[label] = registry
+
+    def labels(self) -> list[str]:
+        return list(self._regs)
+
+    def snapshot(self) -> dict:
+        """``{"workers": {label: snap}, "fleet": merged}`` — per-worker
+        registries verbatim plus the union-equivalent merge (counters
+        summed, histograms bucket-merged with recomputed quantiles)."""
+        per = {label: reg.snapshot() for label, reg in self._regs.items()}
+        return {"workers": per, "fleet": merge_snapshots(per.values())}
+
+    def prometheus_text(self) -> str:
+        """One scrape body over every registry. Metric names are the
+        sorted UNION across workers; a name registered with different
+        metric types on different workers raises (one TYPE header per
+        name is a format invariant, not a style choice)."""
+        fmt = MetricsRegistry._fmt_le
+        owners: dict[str, list[tuple[str, object]]] = {}
+        for label, reg in self._regs.items():
+            for name in reg.names():
+                owners.setdefault(name, []).append((label,
+                                                    reg.get(name)))
+        lines = []
+        for name in sorted(owners):
+            metrics = owners[name]
+            kinds = {type(m) for _, m in metrics}
+            if len(kinds) > 1:
+                raise TypeError(
+                    f"metric {name!r} has conflicting types across "
+                    f"workers: {sorted(k.__name__ for k in kinds)}")
+            kind = kinds.pop()
+            help_ = next((m.help for _, m in metrics if m.help), "")
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            if kind is Counter:
+                lines.append(f"# TYPE {name} counter")
+            elif kind is Gauge:
+                lines.append(f"# TYPE {name} gauge")
+            else:
+                lines.append(f"# TYPE {name} histogram")
+            for label, m in metrics:
+                if kind is Counter or kind is Gauge:
+                    lines.append(f'{name}{{worker="{label}"}} '
+                                 f"{format(m.value, 'g')}")
+                    continue
+                for le, c in m.cumulative():
+                    lines.append(
+                        f'{name}_bucket{{worker="{label}",'
+                        f'le="{fmt(le)}"}} {c}')
+                lines.append(f'{name}_sum{{worker="{label}"}} '
+                             f"{format(m.sum, 'g')}")
+                lines.append(f'{name}_count{{worker="{label}"}} '
+                             f"{m.count}")
+        return "\n".join(lines) + "\n"
+
+
+class _ScrapeHandler(BaseHTTPRequestHandler):
+    server_version = "paddle_tpu_fleet/1.0"
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        agg = self.server.aggregator      # type: ignore[attr-defined]
+        if self.path in ("/metrics", "/"):
+            body = agg.prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif self.path == "/metrics.json":
+            body = json.dumps(agg.snapshot()).encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrapes are high-rate; stay quiet
+        pass
+
+
+class MetricsHTTPServer:
+    """Stdlib scrape endpoint over a :class:`MetricsAggregator`."""
+
+    def __init__(self, aggregator: MetricsAggregator,
+                 host="127.0.0.1", port=0):
+        self._srv = ThreadingHTTPServer((host, port), _ScrapeHandler)
+        self._srv.daemon_threads = True
+        self._srv.aggregator = aggregator   # handler reads it per GET
+        self.host = self._srv.server_address[0]
+        self.port = self._srv.server_address[1]
+        self._thread = None
+
+    def start(self) -> "MetricsHTTPServer":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._srv.serve_forever, daemon=True)
+            self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

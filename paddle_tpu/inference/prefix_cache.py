@@ -67,9 +67,11 @@ class _Node:
 class PrefixCache:
     """Radix tree of cached KV pages, keyed by token ids."""
 
-    def __init__(self, alloc: BlockAllocator, block_size: int):
+    def __init__(self, alloc: BlockAllocator, block_size: int,
+                 listener=None):
         self._alloc = alloc
         self._bs = int(block_size)
+        self._listener = listener       # on_insert/on_evict(tokens) hooks
         self._root = _Node((), None, None)
         self._clock = 0                 # LRU tick (touch on match/insert)
         self._n_nodes = 0
@@ -191,6 +193,11 @@ class PrefixCache:
                 self._n_nodes += 1
                 adopted += 1
             self._tick(child)
+        if self._listener is not None:
+            try:                        # routing hint only — a listener
+                self._listener.on_insert(tokens)   # fault must not break
+            except Exception:           # noqa: BLE001 — publish
+                pass
         return adopted
 
     # -- reclaim ------------------------------------------------------------
@@ -213,6 +220,16 @@ class PrefixCache:
                     victim = node
             if victim is None:
                 break
+            if self._listener is not None:
+                chain, n = [], victim   # root..victim token path
+                while n is not None and n is not self._root:
+                    chain.append(n.key)
+                    n = n.parent
+                toks = [t for key in reversed(chain) for t in key]
+                try:
+                    self._listener.on_evict(toks)
+                except Exception:       # noqa: BLE001
+                    pass
             del victim.parent.children[victim.key]
             self._alloc.decref(victim.page)     # rc 1 -> page freed
             self._n_nodes -= 1

@@ -71,7 +71,8 @@ class DecodeEngine:
 
     def __init__(self, model, capacity=4, s_max=256, chunk=8, pad_id=0,
                  paged=True, block_size=16, n_blocks=None,
-                 prefix_cache=True, registry=None):
+                 prefix_cache=True, registry=None, worker_id=None,
+                 prefix_listener=None):
         from ..distributed.fleet.mp_layers import current_mesh
         from ..models.llama import _pp_degree
         if _pp_degree(current_mesh()) > 1:
@@ -87,6 +88,11 @@ class DecodeEngine:
         self.paged = bool(paged)
         self.block_size = int(block_size)
         self._prefix_on = bool(prefix_cache) and self.paged
+        # stable identity inside a ServingFleet ("w0", "w1", ...) —
+        # threaded into stats()/log lines so per-worker output is
+        # distinguishable; None for a standalone engine.
+        self.worker_id = worker_id
+        self._prefix_listener = prefix_listener
         self._sched = None
         if self.paged:
             from .scheduler import RequestScheduler
@@ -351,7 +357,8 @@ class DecodeEngine:
                                   self._hd), self._cache_dtype)
             self._vp = jnp.zeros_like(self._kp)
             self._alloc = BlockAllocator(self.n_blocks)
-            self._cache = PrefixCache(self._alloc, self.block_size) \
+            self._cache = PrefixCache(self._alloc, self.block_size,
+                                      listener=self._prefix_listener) \
                 if self._prefix_on else None
             self._tables = _np.zeros((B, self._max_blocks), _np.int32)
             self._lens = _np.zeros((B,), _np.int32)
@@ -392,7 +399,8 @@ class DecodeEngine:
         ``metrics.snapshot()`` is the full registry (histograms with
         TTFT/TPOT/queue-wait buckets included); this keeps the r6/r7
         dict shape."""
-        s = {"admitted": int(self._c_admitted.value),
+        s = {"worker_id": self.worker_id,
+             "admitted": int(self._c_admitted.value),
              "retired": int(self._c_retired.value),
              "failed": int(self._c_failed.value),
              "preempted": int(self._c_preempted.value),
@@ -441,6 +449,7 @@ class DecodeEngine:
         if tf is not None and req.max_new > 1:
             self._h_tpot.observe((t_ret - tf) / (req.max_new - 1))
         log_kv(_log, "retired", level=logging.DEBUG,
+               worker=self.worker_id,
                req=tr.request_id, new_tokens=req.max_new,
                ttft_s=round(tr.ttft, 6) if tr.ttft is not None else None,
                preemptions=tr.preemptions)
@@ -529,6 +538,7 @@ class DecodeEngine:
         tr = getattr(req, "trace", None)
         _tmark(req, "failed")
         log_kv(_log, "request_failed", level=logging.WARNING,
+               worker=self.worker_id,
                req=tr.request_id if tr is not None else None,
                error=type(err).__name__, detail=str(err))
 
@@ -593,6 +603,7 @@ class DecodeEngine:
             self._sched.add(req)
         tr = getattr(req, "trace", None)
         log_kv(_log, "preempted", level=logging.DEBUG,
+               worker=self.worker_id,
                req=tr.request_id if tr is not None else None,
                slot=slot, resident_tokens=valid,
                emitted=len(req._resume_toks))
@@ -705,6 +716,7 @@ class DecodeEngine:
             self._observe_first_token(req)
             tr = getattr(req, "trace", None)
             log_kv(_log, "admitted", level=logging.DEBUG,
+                   worker=self.worker_id,
                    req=tr.request_id if tr is not None else None,
                    slot=slot, tokens=int(ns), cached_tokens=hit_tokens,
                    pages=len(all_pages), resumed=bool(resume))
@@ -1062,12 +1074,17 @@ class BatchingServer:
 
     def __init__(self, predictor: GenerationPredictor, max_batch=8,
                  max_wait_ms=10.0, max_new_tokens=32, continuous=False,
-                 engine_kwargs=None):
+                 engine_kwargs=None, worker_id=None):
         """``continuous=True`` (VERDICT r4 #5): requests join/leave a
         carried-KV :class:`DecodeEngine` at chunk boundaries instead of
         riding whole batch-at-a-time generate calls — arrivals admit
-        into freed slots mid-generation and finished rows retire early."""
+        into freed slots mid-generation and finished rows retire early.
+
+        ``worker_id`` is a stable fleet-assigned identity ("w0", ...)
+        threaded into this server's (and its engine's) ``stats()`` so
+        snapshots from different workers stay distinguishable."""
         self.predictor = predictor
+        self.worker_id = worker_id
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1000.0
         self.max_new_tokens = max_new_tokens
@@ -1090,9 +1107,11 @@ class BatchingServer:
                     "back to masked batch-at-a-time", RuntimeWarning,
                     stacklevel=2)
             else:
+                kw = dict(engine_kwargs or {})
+                kw.setdefault("worker_id", worker_id)
                 self.engine = DecodeEngine(
                     predictor.model, capacity=max_batch,
-                    pad_id=predictor.pad_id, **(engine_kwargs or {}))
+                    pad_id=predictor.pad_id, **kw)
         # share the engine's registry so server + engine metrics land in
         # one snapshot; batch-at-a-time mode gets its own
         self.metrics = self.engine.metrics if self.engine is not None \
@@ -1127,7 +1146,8 @@ class BatchingServer:
         """Server observability: a thin view over the shared metrics
         registry plus live queue depths. ``metrics.snapshot()`` has the
         full registry (engine histograms included in continuous mode)."""
-        s = {"submitted": int(self._c_submitted.value),
+        s = {"worker_id": self.worker_id,
+             "submitted": int(self._c_submitted.value),
              "queue_depth": self._q.qsize(),
              "pending": len(self._pending)}
         if self.engine is not None:

@@ -19,9 +19,10 @@ lifecycle next to op-dispatch spans (PAPER §L0–L4 host+device merge).
 """
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
-                      DEFAULT_LATENCY_BUCKETS, get_registry, now)
+                      DEFAULT_LATENCY_BUCKETS, get_registry,
+                      merge_snapshots, now)
 from .tracing import (RequestTrace, LIFECYCLE_STATES, TERMINAL_STATES)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "DEFAULT_LATENCY_BUCKETS", "get_registry", "now",
-           "RequestTrace", "LIFECYCLE_STATES", "TERMINAL_STATES"]
+           "DEFAULT_LATENCY_BUCKETS", "get_registry", "merge_snapshots",
+           "now", "RequestTrace", "LIFECYCLE_STATES", "TERMINAL_STATES"]

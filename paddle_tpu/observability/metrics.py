@@ -25,7 +25,8 @@ import time
 from bisect import bisect_left
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "get_registry", "now", "DEFAULT_LATENCY_BUCKETS"]
+           "get_registry", "merge_snapshots", "now",
+           "DEFAULT_LATENCY_BUCKETS"]
 
 #: monotonic high-resolution clock used by every telemetry call site —
 #: hot-path code imports this instead of calling time.perf_counter
@@ -278,8 +279,19 @@ class MetricsRegistry:
                 out["histograms"][name] = h
         return out
 
-    def prometheus_text(self) -> str:
-        """Standard text exposition (one scrape body)."""
+    def prometheus_text(self, labels: dict | None = None) -> str:
+        """Standard text exposition (one scrape body).
+
+        ``labels`` (e.g. ``{"worker": "w3"}``) are attached to every
+        sample line — the fleet aggregator uses this to distinguish
+        per-worker registries in one scrape body. Keys are emitted in
+        sorted order; histogram buckets keep ``le`` as the last label.
+        With no labels the output is byte-identical to the unlabeled
+        form."""
+        pairs = ""
+        if labels:
+            pairs = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+        plain = f"{{{pairs}}}" if pairs else ""
         lines = []
         for name in self.names():
             m = self._metrics[name]
@@ -287,22 +299,100 @@ class MetricsRegistry:
                 if m.help:
                     lines.append(f"# HELP {name} {m.help}")
                 lines.append(f"# TYPE {name} counter")
-                lines.append(f"{name} {format(m.value, 'g')}")
+                lines.append(f"{name}{plain} {format(m.value, 'g')}")
             elif isinstance(m, Gauge):
                 if m.help:
                     lines.append(f"# HELP {name} {m.help}")
                 lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name} {format(m.value, 'g')}")
+                lines.append(f"{name}{plain} {format(m.value, 'g')}")
             elif isinstance(m, Histogram):
                 if m.help:
                     lines.append(f"# HELP {name} {m.help}")
                 lines.append(f"# TYPE {name} histogram")
                 for le, c in m.cumulative():
-                    lines.append(
-                        f'{name}_bucket{{le="{self._fmt_le(le)}"}} {c}')
-                lines.append(f"{name}_sum {format(m.sum, 'g')}")
-                lines.append(f"{name}_count {m.count}")
+                    bkt = (f'{pairs},le="{self._fmt_le(le)}"' if pairs
+                           else f'le="{self._fmt_le(le)}"')
+                    lines.append(f"{name}_bucket{{{bkt}}} {c}")
+                lines.append(f"{name}_sum{plain} {format(m.sum, 'g')}")
+                lines.append(f"{name}_count{plain} {m.count}")
         return "\n".join(lines) + "\n"
+
+
+def _parse_le(key: str) -> float:
+    return float("inf") if key == "+Inf" else float(key)
+
+
+def _merged_quantile(q: float, buckets: dict, total: int, mx) -> float:
+    """Same rule as :meth:`Histogram.quantile`, applied to a merged
+    cumulative-bucket dict (rank = q * total, first inclusive upper
+    edge whose cumulative count reaches it; +Inf resolves to the
+    observed max)."""
+    if total == 0:
+        return 0.0
+    rank = q * total
+    for key in sorted(buckets, key=_parse_le):
+        if buckets[key] >= rank:
+            le = _parse_le(key)
+            if le == float("inf"):
+                return mx if mx is not None else 0.0
+            return le
+    return mx if mx is not None else 0.0
+
+
+def merge_snapshots(snaps) -> dict:
+    """Merge :meth:`MetricsRegistry.snapshot` dicts from several
+    registries (fleet workers) into one fleet-level snapshot.
+
+    Semantics — associative and commutative, and for histograms equal
+    to having observed the UNION of the samples into one histogram
+    with the same edges (the fixed log-spaced buckets exist for this):
+
+    - counters: summed;
+    - gauges: summed, NaN values skipped (a dead worker's fn-gauge
+      collects as NaN; ratio-style gauges should be recomputed from
+      merged counters by the consumer instead);
+    - histograms: cumulative bucket counts summed per edge (edges must
+      match across snapshots or ``ValueError`` is raised), sum/count
+      summed, min/max narrowed, p50/p99 recomputed from the merged
+      buckets with the same quantile rule as :class:`Histogram`.
+    """
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    for snap in snaps:
+        for name, v in snap.get("counters", {}).items():
+            out["counters"][name] = out["counters"].get(name, 0.0) + v
+        for name, v in snap.get("gauges", {}).items():
+            if v != v:          # NaN — unreadable fn-gauge; skip
+                out["gauges"].setdefault(name, 0.0)
+                continue
+            out["gauges"][name] = out["gauges"].get(name, 0.0) + v
+        for name, h in snap.get("histograms", {}).items():
+            acc = out["histograms"].get(name)
+            if acc is None:
+                out["histograms"][name] = {
+                    "count": h["count"], "sum": h["sum"],
+                    "min": h["min"], "max": h["max"],
+                    "buckets": dict(h["buckets"])}
+                continue
+            if set(acc["buckets"]) != set(h["buckets"]):
+                raise ValueError(
+                    f"merge_snapshots: histogram {name!r} bucket edges "
+                    f"differ across snapshots")
+            for key, c in h["buckets"].items():
+                acc["buckets"][key] += c
+            acc["count"] += h["count"]
+            acc["sum"] += h["sum"]
+            for k, pick in (("min", min), ("max", max)):
+                a, b = acc[k], h[k]
+                acc[k] = b if a is None else (a if b is None
+                                              else pick(a, b))
+    for name, h in out["histograms"].items():
+        h["p50"] = _merged_quantile(0.5, h["buckets"], h["count"],
+                                    h["max"])
+        h["p99"] = _merged_quantile(0.99, h["buckets"], h["count"],
+                                    h["max"])
+        # keep the per-registry snapshot key order (count..p99, buckets)
+        h["buckets"] = h.pop("buckets")
+    return out
 
 
 _DEFAULT: list[MetricsRegistry | None] = [None]

@@ -44,6 +44,10 @@ def test_infra_skip_metric_follows_preset(monkeypatch, capsys):
     bench._emit_infra_skip("tunnel down")
     out = json.loads(capsys.readouterr().out.strip())
     assert out["metric"] == "prefix_cached_ttft_ms"
+    monkeypatch.setenv("BENCH_PRESET", "fleet")
+    bench._emit_infra_skip("tunnel down")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "fleet_affinity_ttft_ms"
 
 
 @pytest.mark.slow
@@ -74,6 +78,43 @@ def test_prefix_preset_cpu_smoke(tmp_path):
     snap = json.load(open(snap_path))
     assert snap["counters"]["engine_prefix_hit_tokens_total"] > 0
     assert snap["histograms"]["engine_ttft_seconds"]["count"] > 0
+
+
+@pytest.mark.slow
+def test_fleet_preset_cpu_smoke(tmp_path):
+    """End-to-end CPU run of BENCH_PRESET=fleet (ISSUE 4 satellite):
+    one JSON line, prefix-affinity routing strictly beats round-robin
+    on the shared-system-prompt workload (vs_baseline = rr/affinity
+    cached TTFT > 1, and more prefix tokens served from cache), and the
+    aggregated per-worker + merged registry snapshot is dumped."""
+    env = dict(os.environ, BENCH_PRESET="fleet", BENCH_ALLOW_CPU="1",
+               BENCH_NO_WALL="1", BENCH_SKIP_PROBE="1",
+               BENCH_METRICS_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, bench.__file__], env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1                         # one-JSON-line contract
+    out = json.loads(lines[0])
+    assert out["metric"] == "fleet_affinity_ttft_ms"
+    assert out["value"] > 0
+    assert out["vs_baseline"] > 1.0    # affinity beats round-robin
+    assert out["extra"]["affinity_prefix_hit_tokens"] > \
+        out["extra"]["rr_prefix_hit_tokens"]
+    assert out["extra"]["affinity_hits"] > 0
+    snap_path = out["extra"]["metrics_snapshot"]
+    assert snap_path == str(tmp_path / "bench_metrics_fleet.json")
+    snap = json.load(open(snap_path))
+    assert set(snap["workers"]) == {"w0", "w1", "router"}
+    merged = snap["fleet"]
+    assert merged["counters"]["engine_prefix_hit_tokens_total"] > 0
+    assert merged["counters"]["fleet_submitted_total"] == \
+        snap["workers"]["router"]["counters"]["fleet_submitted_total"]
+    assert merged["histograms"]["engine_ttft_seconds"]["count"] == sum(
+        snap["workers"][w]["histograms"]["engine_ttft_seconds"]["count"]
+        for w in ("w0", "w1"))
 
 
 def test_env_flag_tolerant(monkeypatch):

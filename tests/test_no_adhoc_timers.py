@@ -27,6 +27,18 @@ def test_inference_package_has_no_raw_perf_counter():
         + "\n".join(offenders))
 
 
+def test_lint_covers_fleet_modules():
+    """ISSUE 4 grew the package by fleet.py/fleet_metrics.py; the glob
+    above must actually be scanning them (a rename or package move
+    would silently shrink the lint's coverage)."""
+    scanned = {py.name for py in INFERENCE.glob("*.py")}
+    for required in ("serving.py", "fleet.py", "fleet_metrics.py",
+                     "prefix_cache.py", "scheduler.py"):
+        assert required in scanned, (
+            f"{required} missing from the timer-lint scan set "
+            f"{sorted(scanned)}")
+
+
 def test_shared_clock_is_perf_counter():
     """The alias must BE the high-resolution monotonic clock (the lint
     bans the spelling, not the clock)."""

@@ -560,3 +560,135 @@ class TestStructuredLogging:
             assert snap["counters"]["engine_retired_total"] == 1
         finally:
             srv.close()
+
+
+class TestMergeSnapshots:
+    """ISSUE 4: snapshot merging must behave like observing the UNION
+    of samples into one histogram — checked property-style (random
+    sample sets, associativity, commutativity) over the fixed
+    log-spaced edges that make the merge well-defined."""
+
+    @staticmethod
+    def _registry_with(samples, counter=0.0, gauge=0.0):
+        from paddle_tpu.observability import MetricsRegistry
+        r = MetricsRegistry()
+        r.counter("reqs_total").inc(counter)
+        r.gauge("occupancy").set(gauge)
+        h = r.histogram("lat_seconds")
+        for v in samples:
+            h.observe(v)
+        return r
+
+    @staticmethod
+    def _sample_sets(seed, k=3):
+        rng = np.random.RandomState(seed)
+        out = []
+        for _ in range(k):
+            n = int(rng.randint(0, 40))
+            # span the full bucket range incl. sub-min and overflow
+            out.append(list(10 ** rng.uniform(-4.5, 2.5, size=n)))
+        return out
+
+    def _assert_hist_equal(self, a, b):
+        assert a["count"] == b["count"]
+        assert a["buckets"] == b["buckets"]
+        assert a["sum"] == pytest.approx(b["sum"])
+        for k in ("min", "max"):
+            if a[k] is None:
+                assert b[k] is None
+            else:
+                assert a[k] == pytest.approx(b[k])
+        # snapshot bucket keys are 'g'-formatted (6 sig figs), so a
+        # merged quantile can differ from the live histogram's exact
+        # edge only by that serialization rounding
+        assert a["p50"] == pytest.approx(b["p50"], rel=1e-5)
+        assert a["p99"] == pytest.approx(b["p99"], rel=1e-5)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_merge_equals_union_observation(self, seed):
+        from paddle_tpu.observability import merge_snapshots
+        sets = self._sample_sets(seed)
+        snaps = [self._registry_with(s, counter=i + 1, gauge=i).snapshot()
+                 for i, s in enumerate(sets)]
+        merged = merge_snapshots(snaps)
+        union = self._registry_with(
+            [v for s in sets for v in s],
+            counter=sum(range(1, len(sets) + 1)),
+            gauge=sum(range(len(sets)))).snapshot()
+        assert merged["counters"] == pytest.approx(union["counters"])
+        assert merged["gauges"] == pytest.approx(union["gauges"])
+        self._assert_hist_equal(merged["histograms"]["lat_seconds"],
+                                union["histograms"]["lat_seconds"])
+
+    @pytest.mark.parametrize("seed", [7, 8])
+    def test_merge_is_commutative(self, seed):
+        from paddle_tpu.observability import merge_snapshots
+        snaps = [self._registry_with(s, counter=i).snapshot()
+                 for i, s in enumerate(self._sample_sets(seed))]
+        fwd = merge_snapshots(snaps)
+        rev = merge_snapshots(list(reversed(snaps)))
+        assert fwd["counters"] == pytest.approx(rev["counters"])
+        self._assert_hist_equal(fwd["histograms"]["lat_seconds"],
+                                rev["histograms"]["lat_seconds"])
+
+    @pytest.mark.parametrize("seed", [11, 12])
+    def test_merge_is_associative(self, seed):
+        from paddle_tpu.observability import merge_snapshots
+        a, b, c = [self._registry_with(s).snapshot()
+                   for s in self._sample_sets(seed, k=3)]
+        left = merge_snapshots([merge_snapshots([a, b]), c])
+        right = merge_snapshots([a, merge_snapshots([b, c])])
+        assert left["counters"] == pytest.approx(right["counters"])
+        self._assert_hist_equal(left["histograms"]["lat_seconds"],
+                                right["histograms"]["lat_seconds"])
+
+    def test_empty_and_single_inputs(self):
+        from paddle_tpu.observability import merge_snapshots
+        assert merge_snapshots([]) == {"counters": {}, "gauges": {},
+                                       "histograms": {}}
+        snap = self._registry_with([0.01], counter=2).snapshot()
+        one = merge_snapshots([snap])
+        assert one["counters"] == snap["counters"]
+        self._assert_hist_equal(one["histograms"]["lat_seconds"],
+                                snap["histograms"]["lat_seconds"])
+
+    def test_nan_gauges_are_skipped(self):
+        from paddle_tpu.observability import MetricsRegistry, merge_snapshots
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.gauge("g", fn=lambda: (_ for _ in ()).throw(RuntimeError()))
+        r2.gauge("g").set(3.0)
+        m = merge_snapshots([r1.snapshot(), r2.snapshot()])
+        assert m["gauges"]["g"] == 3.0
+
+    def test_mismatched_bucket_edges_raise(self):
+        from paddle_tpu.observability import MetricsRegistry, merge_snapshots
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.histogram("h", buckets=(1.0, 2.0))
+        r2.histogram("h", buckets=(1.0, 4.0))
+        with pytest.raises(ValueError, match="bucket edges"):
+            merge_snapshots([r1.snapshot(), r2.snapshot()])
+
+
+class TestPrometheusLabels:
+    def test_no_labels_is_byte_identical(self):
+        r = MetricsRegistry()
+        r.counter("c_total", "help").inc(2)
+        r.histogram("h_seconds").observe(0.01)
+        base = r.prometheus_text()
+        assert r.prometheus_text(labels=None) == base
+        assert r.prometheus_text(labels={}) == base
+
+    def test_labels_on_every_sample_sorted_le_last(self):
+        r = MetricsRegistry()
+        r.counter("c_total").inc(2)
+        r.gauge("g").set(1.5)
+        r.histogram("h_seconds").observe(0.01)
+        text = r.prometheus_text(labels={"worker": "w3", "host": "a"})
+        assert 'c_total{host="a",worker="w3"} 2' in text
+        assert 'g{host="a",worker="w3"} 1.5' in text
+        assert 'h_seconds_bucket{host="a",worker="w3",le="+Inf"} 1' \
+            in text
+        assert 'h_seconds_sum{host="a",worker="w3"}' in text
+        assert 'h_seconds_count{host="a",worker="w3"} 1' in text
+        # HELP/TYPE headers stay unlabeled
+        assert "# TYPE c_total counter" in text
