@@ -44,6 +44,7 @@ _PRESET_METRICS = {
     "engine": "engine_decode_tokens_per_sec",
     "prefix": "prefix_cached_ttft_ms",
     "fleet": "fleet_affinity_ttft_ms",
+    "slo": "slo_shipper_overhead_pct",
     "smoke": "smoke_wall_seconds",
 }
 
@@ -700,6 +701,116 @@ def bench_fleet():
     }))
 
 
+def bench_slo():
+    """Telemetry tax on the serving hot path (ISSUE 5): the same warm
+    2-worker fleet workload runs with the SLO engine + TelemetryShipper
+    OFF and ON, interleaved; the metric is the ON step-wall overhead in
+    percent (min-of-runs per config, so scheduler noise cancels) and
+    vs_baseline is t_off/t_on (>= 0.95 means the observability layer
+    costs under the 5% budget the slow smoke asserts). The ON config is
+    the production cadence: shipper ``tick()`` every fleet step
+    (flushing a merged snapshot + retired trace summaries to a JSONL
+    sink on interval), SLO ``check`` at scrape cadence (every 8
+    steps)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.fleet import ServingFleet
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import JsonlFileSink, SLORule
+    on_tpu = jax.default_backend() not in ("cpu",)
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
+                          intermediate_size=14336, num_hidden_layers=2,
+                          num_attention_heads=32, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16")
+        s_max, chunk, bs = 512, 8, 16
+        p_len, new, n_req = 96, 16, 8
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=344, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2)
+        s_max, chunk, bs = 128, 4, 16
+        p_len, new, n_req = 24, 48, 16
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    fleet = ServingFleet(model, n_workers=2, policy="round_robin",
+                         engine_kwargs=dict(capacity=2, s_max=s_max,
+                                            chunk=chunk, block_size=bs))
+    prompts = [rng.integers(1, cfg.vocab_size, p_len).astype(np.int32)
+               for _ in range(n_req)]
+
+    def run_once(slo_on):
+        """One full workload; returns summed step() wall seconds."""
+        for p in prompts:
+            fleet.submit(p, max_new_tokens=new)
+        wall, steps = 0.0, 0
+        while fleet.pending_work():
+            t0 = time.perf_counter()
+            fleet.step()
+            if slo_on and steps % 8 == 0:
+                fleet.check_slo()
+            wall += time.perf_counter() - t0
+            steps += 1
+        return wall
+
+    # warm both workers' compiled programs (prefill buckets + chunk)
+    run_once(slo_on=False)
+    run_once(slo_on=False)
+
+    out_dir = os.environ.get("BENCH_METRICS_DIR", "log")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        sink_path = os.path.join(out_dir, "bench_slo_telemetry.jsonl")
+    except OSError:
+        sink_path = os.devnull
+    slo_engine = None
+    shipper = None
+    t_off, t_on = float("inf"), float("inf")
+    repeats = 5
+    for _ in range(repeats):            # interleaved: off, on, off, on…
+        fleet.slo, fleet.shipper = None, None
+        t_off = min(t_off, run_once(slo_on=False))
+        if slo_engine is None:
+            slo_engine = fleet.enable_slo(rules=[
+                SLORule("ttft_p99", "engine_ttft_seconds", "p99",
+                        threshold=30.0, window_s=30.0, for_s=5.0),
+                SLORule("error_rate", "engine_failed_total", "ratio",
+                        threshold=0.01, window_s=30.0,
+                        total=("engine_retired_total",
+                               "engine_failed_total")),
+            ])
+            # 0.25s keeps >= 1 flush per ON run (the first tick after
+            # an OFF run always flushes) without the pathological
+            # every-step cadence that would dominate a sub-second run
+            shipper = fleet.enable_shipper(
+                [JsonlFileSink(sink_path)], interval_s=0.25)
+        else:
+            fleet.slo, fleet.shipper = slo_engine, shipper
+        t_on = min(t_on, run_once(slo_on=True))
+    overhead_pct = (t_on - t_off) / t_off * 100.0
+    agg_snap = fleet.aggregator().snapshot()
+    snap_path = _dump_metrics_snapshot(None, "slo", snapshot=agg_snap)
+    ship_stats = shipper.stats()
+    fleet.close()
+    print(json.dumps({
+        "metric": "slo_shipper_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": "%",
+        "vs_baseline": round(t_off / max(t_on, 1e-9), 4),
+        "extra": {"step_wall_off_s": round(t_off, 4),
+                  "step_wall_on_s": round(t_on, 4),
+                  "requests_per_run": n_req, "new_tokens": new,
+                  "repeats": repeats,
+                  "shipper": ship_stats,
+                  "slo_states": slo_engine.states(),
+                  "telemetry_jsonl": sink_path,
+                  "metrics_snapshot": snap_path,
+                  "backend": jax.default_backend()},
+    }))
+
+
 def bench_smoke():
     """Sub-minute pipeline probe: ONE tiny compiled train step
     (fwd+bwd+AdamW) plus ONE compiled flash-attention fwd+bwd. The
@@ -785,6 +896,8 @@ def main():
         return bench_prefix()
     if preset == "fleet":
         return bench_fleet()
+    if preset == "slo":
+        return bench_slo()
     if preset == "smoke":
         return bench_smoke()
     if on_tpu:

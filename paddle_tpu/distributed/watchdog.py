@@ -22,8 +22,8 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 
+from ..observability.metrics import now as _now
 from ..utils.log import get_logger, log_event, log_kv
 
 __all__ = ["CommWatchdog", "EngineStallWatchdog", "comm_guard",
@@ -37,7 +37,7 @@ class _Inflight:
 
     def __init__(self, name, detail):
         self.name = name
-        self.start = time.monotonic()
+        self.start = _now()
         self.thread = threading.current_thread().name
         self.detail = detail
         self.flagged = False   # report each stalled op once
@@ -82,7 +82,7 @@ class CommWatchdog:
 
     def _watch(self):
         while not self._stop.wait(self.poll_s):
-            now = time.monotonic()
+            now = _now()
             with self._lock:
                 stalled = [t for t in self._inflight.values()
                            if now - t.start > self.timeout_s
@@ -169,7 +169,7 @@ class EngineStallWatchdog:
         """One deterministic poll. Returns the stall info dict when THIS
         call fires (first detection of the current episode), else
         None."""
-        now = time.monotonic() if now is None else now
+        now = _now() if now is None else now
         m = self.registry.get(self.counter)
         if m is None:
             return None                # engine not constructed yet

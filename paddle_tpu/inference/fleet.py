@@ -44,11 +44,13 @@ watchdog poll threads are opt-in via :meth:`start_watchdogs`.
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
+from collections import deque
 
 from ..distributed.watchdog import EngineStallWatchdog
-from ..observability import MetricsRegistry
+from ..observability import MetricsRegistry, merge_snapshots
 from ..utils.log import get_logger, log_event, log_kv
 from .serving import DecodeEngine, _Request, _tmark
 
@@ -246,6 +248,18 @@ class ServingFleet:
         #                                 worker's heap
         self._lock = threading.Lock()
         self._http = None
+        # ISSUE 5: trace retention for cross-worker Chrome export +
+        # shipper payloads. Bounded so a long-lived fleet never grows.
+        self._traces: deque = deque(maxlen=1024)  # every trace seen
+        self._open_traces: list = []              # not yet terminal
+        self._retired_unshipped: list = []        # summaries to ship
+        self._base_load_penalty = self.load_penalty
+        self.slo = None
+        self.shipper = None
+        self.metrics.gauge(
+            "fleet_load_penalty",
+            "current router load penalty (SLO alerts raise it)",
+            fn=lambda: self.load_penalty)
 
     # -- routing ------------------------------------------------------------
     def _healthy(self) -> list[_Worker]:
@@ -253,13 +267,22 @@ class ServingFleet:
 
     def _route(self, ids) -> _Worker:
         """Pick the worker for a prompt. MUST be called with the lock
-        held. Raises when no healthy worker remains."""
+        held. Raises when no healthy worker remains. The routing
+        decision (reason + scored candidates) is kept on
+        ``self._last_route`` so callers can stamp it onto the request
+        trace (ISSUE 5 router span)."""
         healthy = self._healthy()
         if not healthy:
             raise RuntimeError("ServingFleet has no healthy workers")
         if self.policy == "round_robin" or len(healthy) == 1:
             w = healthy[self._rr % len(healthy)]
             self._rr += 1
+            self._last_route = {
+                "reason": ("single_healthy" if len(healthy) == 1
+                           and self.policy != "round_robin"
+                           else "round_robin"),
+                "candidates": [{"worker": x.wid, "load": x.load}
+                               for x in healthy]}
             return w
         scored = []
         for w in healthy:
@@ -271,7 +294,24 @@ class ServingFleet:
         w, cached = scored[0][3], scored[0][4]
         if cached > 0:
             self._c_affinity_hits.inc()
+        self._last_route = {
+            "reason": "affinity_hit" if cached > 0 else "least_loaded",
+            "candidates": [{"worker": s[2], "score": -s[0],
+                            "load": s[1], "cached_tokens": s[4]}
+                           for s in scored]}
         return w
+
+    def _stamp_route(self, req, w: _Worker) -> None:
+        """Router span onto the request's trace: chosen worker, why,
+        and every candidate's score (lock held — reads _last_route)."""
+        tr = getattr(req, "trace", None)
+        if tr is None:
+            return
+        info = getattr(self, "_last_route", None) or {}
+        tr.set_attr("worker_id", w.wid)
+        tr.set_attr("route_reason", info.get("reason", self.policy))
+        tr.set_attr("route_candidates", info.get("candidates", []))
+        tr.mark("routed", worker=w.wid)
 
     def submit(self, input_ids, max_new_tokens=32,
                priority=0) -> _Request:
@@ -285,8 +325,11 @@ class ServingFleet:
             req._sched_seq = self._seq
             self._seq += 1
             w = self._route(ids)
+            self._stamp_route(req, w)
             w.pending.append(req)
             self._c_submitted.inc()
+            self._traces.append(req.trace)
+            self._open_traces.append(req.trace)
         log_kv(_log, "routed", level=logging.DEBUG, worker=w.wid,
                req=req.trace.request_id, tokens=int(ids.size),
                policy=self.policy)
@@ -351,12 +394,20 @@ class ServingFleet:
         for w in self.workers:
             if w.healthy or w.fail_reason == "drained":
                 continue
+            reason = w.fail_reason or "failover"
             reqs = self._harvest(w)
             self.directory.drop_worker(w.wid)
             self._c_failovers.inc()
             w.fail_reason = "drained"
             for req in reqs:
                 target = self._route(req.ids.reshape(-1))
+                tr = getattr(req, "trace", None)
+                if tr is not None:
+                    # ONE trace tells the whole story: the harvested
+                    # trace carries a hop linking the dead worker's
+                    # segment to the re-routed one (ISSUE 5)
+                    tr.add_hop(w.wid, target.wid, reason=reason)
+                    self._stamp_route(req, target)
                 target.pending.append(req)
                 self._c_rerouted.inc()
                 moved += 1
@@ -397,6 +448,12 @@ class ServingFleet:
                     self._failover_locked()
                 continue
             alive += w.occupancy
+        if self.shipper is not None:
+            # periodic off-host flush rides the step loop; tick() is
+            # O(1) between intervals and contains every sink fault, so
+            # the serving path is unaffected (bit-identical outputs —
+            # tested)
+            self.shipper.tick()
         return alive
 
     def pending_work(self) -> int:
@@ -441,13 +498,165 @@ class ServingFleet:
     def aggregator(self):
         """Fresh :class:`MetricsAggregator` over every worker registry
         (dead workers included — their final counters are part of the
-        fleet story) plus this fleet's own router registry."""
+        fleet story) plus this fleet's own router registry and, when
+        enabled, the shipper's self-observation registry."""
         from .fleet_metrics import MetricsAggregator
         agg = MetricsAggregator()
         for w in self.workers:
             agg.add(w.wid, w.registry)
         agg.add("router", self.metrics)
+        if self.shipper is not None:
+            agg.add("shipper", self.shipper.registry)
         return agg
+
+    def merged_snapshot(self) -> dict:
+        """Union-equivalent merge of every worker registry snapshot
+        (the SLO engine's observation unit)."""
+        return merge_snapshots(w.registry.snapshot()
+                               for w in self.workers)
+
+    def _sweep_traces(self) -> list[dict]:
+        """Move freshly-terminal traces to the unshipped summary list;
+        returns the summaries accumulated so far (without clearing)."""
+        with self._lock:
+            still = []
+            for tr in self._open_traces:
+                if tr.terminal is not None:
+                    self._retired_unshipped.append(tr.summary())
+                else:
+                    still.append(tr)
+            self._open_traces = still
+            return list(self._retired_unshipped)
+
+    # -- SLO engine (ISSUE 5) ------------------------------------------------
+    def enable_slo(self, rules=None, on_alert=None,
+                   load_penalty_boost=4.0):
+        """Attach a :class:`~paddle_tpu.observability.SLOEngine`.
+
+        ``rules`` defaults to a serving triple: TTFT p99 < 0.5 s,
+        error rate < 1 %, queue-wait p50 < 1 s (30 s windows). The
+        built-in alert hook closes the control loop: while ANY alert
+        fires, the affinity router's ``load_penalty`` is multiplied by
+        ``load_penalty_boost`` (spread load away from hot workers —
+        cached-prefix affinity only wins when it clearly beats the
+        imbalance); it is restored when the last alert resolves.
+        ``on_alert`` is called after the built-in hook with the same
+        transition dict. Drive evaluation with :meth:`check_slo`."""
+        from ..observability import SLOEngine, SLORule
+        if rules is None:
+            rules = [
+                SLORule("ttft_p99", "engine_ttft_seconds", "p99",
+                        threshold=0.5, window_s=30.0, for_s=5.0,
+                        clear_for_s=10.0),
+                SLORule("error_rate", "engine_failed_total", "ratio",
+                        threshold=0.01, window_s=30.0, for_s=5.0,
+                        clear_for_s=10.0,
+                        total=("engine_retired_total",
+                               "engine_failed_total")),
+                SLORule("queue_wait_p50", "engine_queue_wait_seconds",
+                        "p50", threshold=1.0, window_s=30.0, for_s=5.0,
+                        clear_for_s=10.0),
+            ]
+        boost = float(load_penalty_boost)
+
+        def _hook(info):
+            if self.slo is not None and self.slo.firing():
+                self.load_penalty = self._base_load_penalty * boost
+            else:
+                self.load_penalty = self._base_load_penalty
+            log_kv(_log, "slo_alert", level=logging.WARNING,
+                   rule=info["rule"], state=info["state"],
+                   measured=info["measured"],
+                   burn_rate=info["burn_rate"],
+                   load_penalty=self.load_penalty)
+            log_event("fleet_slo_alert", **{
+                k: info[k] for k in ("rule", "state", "measured")})
+            if on_alert is not None:
+                on_alert(info)
+
+        self.slo = SLOEngine(rules, on_alert=_hook,
+                             registry=self.metrics)
+        return self.slo
+
+    def check_slo(self, now=None) -> list[dict]:
+        """Observe the merged worker snapshot, then advance the alert
+        state machines. ``now=`` makes replay deterministic (tests
+        inject the clock, same discipline as ``check_watchdogs``)."""
+        if self.slo is None:
+            return []
+        self.slo.observe(self.merged_snapshot(), now_=now)
+        return self.slo.check(now_=now)
+
+    # -- off-host telemetry (ISSUE 5) ---------------------------------------
+    def enable_shipper(self, sinks, interval_s=5.0, **kw):
+        """Attach a :class:`~paddle_tpu.observability.TelemetryShipper`
+        flushing the merged fleet snapshot + freshly-retired trace
+        summaries to ``sinks`` every ``interval_s`` (driven by
+        :meth:`step` via ``tick()`` — no extra thread unless you call
+        ``shipper.start()`` yourself)."""
+        from ..observability import TelemetryShipper
+        self.shipper = TelemetryShipper(
+            collect=self._collect_telemetry, sinks=sinks,
+            interval_s=interval_s, **kw)
+        return self.shipper
+
+    def _collect_telemetry(self) -> dict:
+        self._sweep_traces()
+        with self._lock:
+            traces, self._retired_unshipped = \
+                self._retired_unshipped, []
+        payload = {"kind": "fleet_telemetry",
+                   "snapshot": self.merged_snapshot(),
+                   "traces": traces}
+        if self.slo is not None:
+            payload["slo"] = self.slo.states()
+        return payload
+
+    # -- cross-worker Chrome timeline (ISSUE 5) ------------------------------
+    def worker_pids(self) -> dict:
+        """Stable Chrome-lane assignment: pid 0 = router/host, pid i+1
+        = worker i."""
+        pids = {None: 0, "router": 0}
+        for i, w in enumerate(self.workers):
+            pids[w.wid] = i + 1
+        return pids
+
+    def export_chrome_timeline(self, path, profiler=None) -> str:
+        """One ``chrome://tracing`` JSON with a LANE (pid) PER WORKER:
+        every retained request trace renders its lifecycle instants +
+        worker-residency spans in the owning worker's lane (failover
+        hops jump lanes mid-trace), and a recording
+        :class:`~paddle_tpu.profiler.Profiler`'s spans merge in —
+        engine spans carry ``worker=`` attribution, so prefill/decode
+        timing lands in the same lanes (both clocks are
+        ``perf_counter``-based, so timestamps align)."""
+        pids = self.worker_pids()
+        pid_for = lambda w: pids.get(w, 0)          # noqa: E731
+        events = [{"name": "process_name", "ph": "M", "pid": 0,
+                   "args": {"name": "router"}}]
+        for w in self.workers:
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": pids[w.wid],
+                           "args": {"name": f"worker {w.wid}"}})
+        with self._lock:
+            traces = list(self._traces)
+        for tr in traces:
+            events.extend(tr.to_events(pid_for=pid_for))
+        if profiler is not None:
+            for s in profiler._spans:
+                base = {"name": s.name, "pid": pid_for(s.worker),
+                        "tid": s.tid, "cat": s.kind}
+                if s.kind == "op":
+                    events.append({**base, "ph": "i", "s": "t",
+                                   "ts": s.start_ns / 1e3})
+                else:
+                    events.append({**base, "ph": "X",
+                                   "ts": s.start_ns / 1e3,
+                                   "dur": (s.end_ns - s.start_ns) / 1e3})
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
 
     def serve_metrics(self, host="127.0.0.1", port=0):
         """Start the stdlib scrape endpoint (GET /metrics → labeled
@@ -474,6 +683,8 @@ class ServingFleet:
     def close(self):
         for w in self.workers:
             w.watchdog.stop()
+        if self.shipper is not None:
+            self.shipper.stop(final_flush=False)
         if self._http is not None:
             self._http.close()
             self._http = None

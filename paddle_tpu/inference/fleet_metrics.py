@@ -23,7 +23,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..observability.metrics import (Counter, Gauge, Histogram,
-                                     MetricsRegistry, merge_snapshots)
+                                     MetricsRegistry, merge_snapshots,
+                                     escape_help, escape_label)
 
 __all__ = ["MetricsAggregator", "MetricsHTTPServer"]
 
@@ -75,7 +76,7 @@ class MetricsAggregator:
             kind = kinds.pop()
             help_ = next((m.help for _, m in metrics if m.help), "")
             if help_:
-                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# HELP {name} {escape_help(help_)}")
             if kind is Counter:
                 lines.append(f"# TYPE {name} counter")
             elif kind is Gauge:
@@ -83,17 +84,18 @@ class MetricsAggregator:
             else:
                 lines.append(f"# TYPE {name} histogram")
             for label, m in metrics:
+                lbl = escape_label(str(label))
                 if kind is Counter or kind is Gauge:
-                    lines.append(f'{name}{{worker="{label}"}} '
+                    lines.append(f'{name}{{worker="{lbl}"}} '
                                  f"{format(m.value, 'g')}")
                     continue
                 for le, c in m.cumulative():
                     lines.append(
-                        f'{name}_bucket{{worker="{label}",'
+                        f'{name}_bucket{{worker="{lbl}",'
                         f'le="{fmt(le)}"}} {c}')
-                lines.append(f'{name}_sum{{worker="{label}"}} '
+                lines.append(f'{name}_sum{{worker="{lbl}"}} '
                              f"{format(m.sum, 'g')}")
-                lines.append(f'{name}_count{{worker="{label}"}} '
+                lines.append(f'{name}_count{{worker="{lbl}"}} '
                              f"{m.count}")
         return "\n".join(lines) + "\n"
 

@@ -32,11 +32,12 @@ __all__ = ["GenerationPredictor", "BatchingServer", "DecodeEngine"]
 _log = get_logger("paddle_tpu.inference.engine")
 
 
-def _tmark(req, state):
+def _tmark(req, state, worker=None):
     """Mark a lifecycle transition on the request's trace (requests
-    without one — foreign test doubles — are silently skipped)."""
+    without one — foreign test doubles — are silently skipped).
+    ``worker`` attributes the event to a fleet worker lane (ISSUE 5)."""
     tr = getattr(req, "trace", None)
-    return None if tr is None else tr.mark(state)
+    return None if tr is None else tr.mark(state, worker=worker)
 
 
 class DecodeEngine:
@@ -424,7 +425,7 @@ class DecodeEngine:
         tr = getattr(req, "trace", None)
         if tr is None:
             return
-        t_adm = tr.mark("admitted")
+        t_adm = tr.mark("admitted", worker=self.worker_id)
         tq = tr.last("queued")
         self._h_queue_wait.observe(
             t_adm - (tq if tq is not None else tr.arrival))
@@ -435,7 +436,7 @@ class DecodeEngine:
         tr = getattr(req, "trace", None)
         if tr is None:
             return
-        tf = tr.mark_once("first_token")
+        tf = tr.mark_once("first_token", worker=self.worker_id)
         if tf is not None:
             self._h_ttft.observe(tf - tr.arrival)
 
@@ -444,7 +445,7 @@ class DecodeEngine:
         tr = getattr(req, "trace", None)
         if tr is None:
             return
-        t_ret = tr.mark("retired")
+        t_ret = tr.mark("retired", worker=self.worker_id)
         tf = tr.first("first_token")
         if tf is not None and req.max_new > 1:
             self._h_tpot.observe((t_ret - tf) / (req.max_new - 1))
@@ -503,7 +504,7 @@ class DecodeEngine:
                 ids[0, self._g - n:self._g] = prompt
                 pad = self._g - n
                 st, embed, fnorm, lm = self._weights()
-                with RecordEvent("engine.prefill", "engine"):
+                with RecordEvent("engine.prefill", "engine", worker=self.worker_id):
                     first, ks, vs = self._prefill(
                         st, embed, fnorm, lm, self._scales,
                         jnp.asarray(ids), jnp.asarray([pad], jnp.int32),
@@ -536,7 +537,7 @@ class DecodeEngine:
         req.event.set()
         self._c_failed.inc()
         tr = getattr(req, "trace", None)
-        _tmark(req, "failed")
+        _tmark(req, "failed", worker=self.worker_id)
         log_kv(_log, "request_failed", level=logging.WARNING,
                worker=self.worker_id,
                req=tr.request_id if tr is not None else None,
@@ -587,7 +588,7 @@ class DecodeEngine:
         bs = self.block_size
         row = self._rows[slot]
         req = row["req"]
-        with RecordEvent("engine.preempt", "engine"):
+        with RecordEvent("engine.preempt", "engine", worker=self.worker_id):
             valid = int(self._lens[slot])
             if self._cache is not None and valid > 0:
                 seq = self._cached_seq(row)[:valid]
@@ -595,7 +596,7 @@ class DecodeEngine:
             self._release_row_pages(row)
             req._resume_toks = list(row["toks"])
             self._c_preempted.inc()
-            _tmark(req, "preempted")
+            _tmark(req, "preempted", worker=self.worker_id)
             self._tables[slot] = 0
             self._lens[slot] = 0
             self._tok[slot] = 0
@@ -636,7 +637,7 @@ class DecodeEngine:
     def _evict_cached(self, n):
         """Cache eviction under a timeline span (the unified trace
         shows WHEN pool pressure forced reclamation)."""
-        with RecordEvent("engine.evict", "engine"):
+        with RecordEvent("engine.evict", "engine", worker=self.worker_id):
             freed = self._cache.evict(n)
         if freed:
             log_kv(_log, "cache_evicted", level=logging.DEBUG,
@@ -732,7 +733,7 @@ class DecodeEngine:
         Prefix hit: COW-copy the partially-shared page if any, then the
         position-offset tail prefill over a bucketed window. Returns
         the argmax token at the last real position."""
-        with RecordEvent("engine.prefill", "engine"):
+        with RecordEvent("engine.prefill", "engine", worker=self.worker_id):
             return self._prefill_row_inner(slot, seq, m, pages)
 
     def _prefill_row_inner(self, slot, seq, m, pages):
@@ -809,7 +810,7 @@ class DecodeEngine:
         t0 = _now()                # decode-only window: admit()'s
         #                            prefill/compile must not read as a
         #                            phantom throughput collapse
-        with RecordEvent("engine.decode_chunk", "engine"):
+        with RecordEvent("engine.decode_chunk", "engine", worker=self.worker_id):
             toks, self._ck, self._cv = self._decode_for(steps)(
                 st, embed, fnorm, lm, self._scales,
                 jnp.asarray(self._tok), self._ck, self._cv, self._g,
@@ -833,7 +834,7 @@ class DecodeEngine:
             row["toks"].extend(int(t) for t in toks[:, slot])
             self._tok[slot] = int(toks[-1, slot])
             req = row["req"]
-            _tmark(req, "decode_chunk")
+            _tmark(req, "decode_chunk", worker=self.worker_id)
             if len(row["toks"]) >= req.max_new:
                 req.result = _np.concatenate(
                     [row["prompt"],
@@ -927,7 +928,7 @@ class DecodeEngine:
             return 0
         st, embed, fnorm, lm = self._weights()
         t0 = _now()
-        with RecordEvent("engine.decode_chunk", "engine"):
+        with RecordEvent("engine.decode_chunk", "engine", worker=self.worker_id):
             toks, self._kp, self._vp = self._decode(
                 st, embed, fnorm, lm, self._scales,
                 jnp.asarray(self._tok), self._kp, self._vp,
@@ -952,7 +953,7 @@ class DecodeEngine:
             row["toks"].extend(int(t) for t in toks[:, slot])
             self._tok[slot] = int(toks[-1, slot])
             req = row["req"]
-            _tmark(req, "decode_chunk")
+            _tmark(req, "decode_chunk", worker=self.worker_id)
             if len(row["toks"]) >= req.max_new:
                 req.result = _np.concatenate(
                     [row["prompt"],

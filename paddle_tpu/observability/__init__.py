@@ -1,6 +1,8 @@
-"""paddle_tpu.observability — serving telemetry (ISSUE 3 tentpole).
+"""paddle_tpu.observability — serving telemetry (ISSUE 3 + ISSUE 5
+tentpoles).
 
-Dependency-free metrics + tracing for the inference stack:
+Dependency-free metrics + tracing + SLO + export for the inference
+stack:
 
 - :mod:`.metrics` — thread-safe :class:`Counter` / :class:`Gauge` /
   :class:`Histogram` (fixed log-spaced latency buckets) behind a
@@ -9,7 +11,16 @@ Dependency-free metrics + tracing for the inference stack:
   :func:`get_registry` is the process-wide instance.
 - :mod:`.tracing` — :class:`RequestTrace`, the per-request lifecycle
   record every latency metric (TTFT / TPOT / queue wait / preemption
-  cost) is derived from.
+  cost) is derived from; carries a ``trace_id`` + failover hops across
+  fleet workers and exports Chrome-trace events.
+- :mod:`.slo` — declarative :class:`SLORule` objectives evaluated over
+  sliding windows of registry snapshots by :class:`SLOEngine`
+  (pending→firing→resolved with hysteresis, burn rate, deterministic
+  ``check(now=)``).
+- :mod:`.export` — :class:`TelemetryShipper`: bounded-queue periodic
+  shipping of snapshots + trace summaries to pluggable sinks
+  (:class:`JsonlFileSink`, :class:`HTTPPostSink`) with exponential
+  backoff; never blocks or crashes the serving path.
 
 The engine-step timeline rides the existing profiler: serving code
 wraps admissions, prefills, decode chunks and evictions in
@@ -20,9 +31,14 @@ lifecycle next to op-dispatch spans (PAPER §L0–L4 host+device merge).
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       DEFAULT_LATENCY_BUCKETS, get_registry,
-                      merge_snapshots, now)
+                      merge_snapshots, now, escape_help, escape_label)
 from .tracing import (RequestTrace, LIFECYCLE_STATES, TERMINAL_STATES)
+from .slo import SLORule, SLOEngine, AlertState
+from .export import TelemetryShipper, JsonlFileSink, HTTPPostSink
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_LATENCY_BUCKETS", "get_registry", "merge_snapshots",
-           "now", "RequestTrace", "LIFECYCLE_STATES", "TERMINAL_STATES"]
+           "now", "escape_help", "escape_label",
+           "RequestTrace", "LIFECYCLE_STATES", "TERMINAL_STATES",
+           "SLORule", "SLOEngine", "AlertState",
+           "TelemetryShipper", "JsonlFileSink", "HTTPPostSink"]

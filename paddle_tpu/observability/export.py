@@ -1,0 +1,252 @@
+"""Resilient off-host telemetry export (ISSUE 5 tentpole; reference
+shape: the OpenTelemetry BatchSpanProcessor / Prometheus remote-write
+contract — a bounded in-memory queue between the hot path and the
+network, periodic flush, exponential backoff with jitter on sink
+failure, and drop-oldest when the queue is full).
+
+The invariant that matters: the serving path NEVER blocks and NEVER
+sees a sink exception. ``enqueue`` is an O(1) deque append; the flush
+either runs inline from ``tick()`` (fleet step loop) or on a daemon
+thread; any sink failure is contained, counted, and backed off. The
+shipper observes ITSELF in its own registry (enqueued / shipped /
+dropped / retries / sink errors, queue depth, current backoff), so a
+mis-behaving sink is visible in the same scrape as everything else.
+
+Determinism: backoff jitter comes from a seeded ``random.Random`` and
+``tick``/``flush`` take ``now=`` overrides, so failure scenarios
+replay exactly in tests."""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import urllib.request
+from collections import deque
+
+from .metrics import MetricsRegistry, now
+
+__all__ = ["TelemetryShipper", "JsonlFileSink", "HTTPPostSink"]
+
+
+class JsonlFileSink:
+    """Append each payload as one JSON line to a local file (the
+    "off-host" part is whatever tails the file)."""
+
+    def __init__(self, path):
+        self.path = str(path)
+
+    def emit(self, payload: dict) -> None:
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(payload, default=str) + "\n")
+
+    def __repr__(self):
+        return f"JsonlFileSink({self.path!r})"
+
+
+class HTTPPostSink:
+    """POST each payload as JSON to a collector endpoint (stdlib
+    urllib — no client stack dependency). Non-2xx raises, which the
+    shipper turns into backoff + retry."""
+
+    def __init__(self, url: str, timeout_s: float = 2.0):
+        self.url = url
+        self.timeout_s = timeout_s
+
+    def emit(self, payload: dict) -> None:
+        data = json.dumps(payload, default=str).encode("utf-8")
+        req = urllib.request.Request(
+            self.url, data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            if not 200 <= r.status < 300:
+                raise OSError(f"HTTPPostSink: {self.url} -> {r.status}")
+
+    def __repr__(self):
+        return f"HTTPPostSink({self.url!r})"
+
+
+class _SinkState:
+    """Per-sink bounded queue + backoff bookkeeping (each sink fails
+    independently: a dead HTTP collector must not stall the local
+    JSONL file)."""
+
+    __slots__ = ("sink", "queue", "failures", "next_ok_t", "backoff_s")
+
+    def __init__(self, sink, queue_max: int):
+        self.sink = sink
+        self.queue: deque = deque(maxlen=queue_max)
+        self.failures = 0
+        self.next_ok_t = 0.0        # earliest time a retry may run
+        self.backoff_s = 0.0
+
+
+class TelemetryShipper:
+    """Bounded-queue periodic shipper of telemetry payloads to
+    pluggable sinks.
+
+    - ``collect``: optional zero-arg callable returning the payload to
+      ship each interval (e.g. the fleet's merged snapshot + retired
+      trace summaries); ``enqueue`` pushes extra payloads directly.
+    - each sink has its OWN bounded queue (``queue_max``, drop-oldest)
+      and its own exponential backoff (``backoff_base_s`` doubling to
+      ``backoff_max_s``, multiplied by ``1 + jitter*u`` with a seeded
+      RNG).
+    - drive it either with ``tick(now=)`` from an existing loop (the
+      fleet calls this in ``step``) or with ``start()``/``stop()`` for
+      a daemon flush thread.
+    """
+
+    def __init__(self, collect=None, sinks=(), interval_s: float = 5.0,
+                 queue_max: int = 128, backoff_base_s: float = 0.5,
+                 backoff_max_s: float = 60.0, jitter: float = 0.1,
+                 seed: int = 0, registry: MetricsRegistry | None = None):
+        self.collect = collect
+        self.interval_s = interval_s
+        self.queue_max = queue_max
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.jitter = jitter
+        self._rng = random.Random(seed)
+        self._sinks = [_SinkState(s, queue_max) for s in sinks]
+        self._lock = threading.Lock()
+        self._last_flush_t = None
+        self._thread = None
+        self._stop = threading.Event()
+        self.registry = (MetricsRegistry() if registry is None
+                         else registry)
+        r = self.registry
+        self._enqueued = r.counter(
+            "shipper_enqueued_total", "payloads accepted into queues")
+        self._shipped = r.counter(
+            "shipper_shipped_total", "payloads delivered to a sink")
+        self._dropped = r.counter(
+            "shipper_dropped_total",
+            "payloads lost to full queues (drop-oldest)")
+        self._retries = r.counter(
+            "shipper_retries_total",
+            "payload delivery attempts after a sink failure")
+        self._errors = r.counter(
+            "shipper_sink_errors_total", "sink emit() exceptions")
+        r.gauge("shipper_queue_depth", "queued payloads across sinks",
+                fn=self._depth)
+        r.gauge("shipper_backoff_seconds",
+                "max current per-sink backoff", fn=self._max_backoff)
+
+    # -- self-observation ---------------------------------------------------
+    def _depth(self) -> int:
+        with self._lock:
+            return sum(len(s.queue) for s in self._sinks)
+
+    def _max_backoff(self) -> float:
+        with self._lock:
+            return max((s.backoff_s for s in self._sinks), default=0.0)
+
+    def stats(self) -> dict:
+        return {"enqueued": self._enqueued.value,
+                "shipped": self._shipped.value,
+                "dropped": self._dropped.value,
+                "retries": self._retries.value,
+                "sink_errors": self._errors.value,
+                "queue_depth": self._depth()}
+
+    # -- hot-path side ------------------------------------------------------
+    def enqueue(self, payload: dict) -> None:
+        """O(1), never blocks, never raises: full queues drop their
+        OLDEST entry (freshest telemetry wins)."""
+        with self._lock:
+            for s in self._sinks:
+                if len(s.queue) == s.queue.maxlen:
+                    self._dropped.inc()
+                s.queue.append(payload)
+            if self._sinks:
+                self._enqueued.inc()
+
+    # -- flush side ---------------------------------------------------------
+    def tick(self, now_: float | None = None) -> int:
+        """Flush if ``interval_s`` elapsed since the last flush;
+        returns payloads delivered. Safe to call every fleet step."""
+        t = now() if now_ is None else now_
+        if (self._last_flush_t is not None
+                and t - self._last_flush_t < self.interval_s):
+            return 0
+        return self.flush(t)
+
+    def flush(self, now_: float | None = None) -> int:
+        """Collect (if configured), then drain every sink's queue,
+        honoring per-sink backoff windows. All exceptions are
+        contained."""
+        t = now() if now_ is None else now_
+        self._last_flush_t = t
+        if self.collect is not None:
+            try:
+                payload = self.collect()
+            except Exception:   # noqa: BLE001 — hot path stays alive
+                payload = None
+            if payload is not None:
+                self.enqueue(payload)
+        delivered = 0
+        for s in self._sinks:
+            if t < s.next_ok_t:
+                continue                # still backing off
+            while True:
+                with self._lock:
+                    if not s.queue:
+                        break
+                    payload = s.queue[0]
+                    retry = s.failures > 0
+                try:
+                    s.sink.emit(payload)
+                except Exception:   # noqa: BLE001 — contained
+                    self._errors.inc()
+                    if retry:
+                        self._retries.inc()
+                    s.failures += 1
+                    base = min(
+                        self.backoff_base_s * 2 ** (s.failures - 1),
+                        self.backoff_max_s)
+                    s.backoff_s = base * (
+                        1.0 + self.jitter * self._rng.random())
+                    s.next_ok_t = t + s.backoff_s
+                    break           # keep payload queued for retry
+                else:
+                    if retry:
+                        self._retries.inc()
+                    s.failures = 0
+                    s.backoff_s = 0.0
+                    s.next_ok_t = 0.0
+                    self._shipped.inc()
+                    delivered += 1
+                    with self._lock:
+                        if s.queue and s.queue[0] is payload:
+                            s.queue.popleft()
+        return delivered
+
+    # -- optional daemon thread ---------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.flush()
+                except Exception:   # noqa: BLE001 — daemon never dies
+                    pass
+
+        self._thread = threading.Thread(
+            target=_loop, name="telemetry-shipper", daemon=True)
+        self._thread.start()
+
+    def stop(self, final_flush: bool = True) -> None:
+        if self._thread is None:
+            if final_flush:
+                self.flush()
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+        if final_flush:
+            self.flush()

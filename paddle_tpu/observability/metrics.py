@@ -26,15 +26,30 @@ from bisect import bisect_left
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "get_registry", "merge_snapshots", "now",
-           "DEFAULT_LATENCY_BUCKETS"]
+           "DEFAULT_LATENCY_BUCKETS", "escape_help", "escape_label"]
 
 #: monotonic high-resolution clock used by every telemetry call site —
-#: hot-path code imports this instead of calling time.perf_counter
-#: directly (tests/test_no_adhoc_timers.py enforces it for inference/).
+#: hot-path code imports this alias instead of calling the stdlib
+#: timer directly (tests/test_no_adhoc_timers.py enforces it for
+#: inference/, observability/ and the stall watchdog).
 now = time.perf_counter
 
 # 0.1 ms .. ~104.8 s in powers of two: 21 edges + implicit +Inf.
 DEFAULT_LATENCY_BUCKETS = tuple(1e-4 * 2 ** i for i in range(21))
+
+
+def escape_help(s: str) -> str:
+    """Prometheus text-format HELP escaping: backslash and newline only
+    (double quotes are legal in HELP text). Identity on clean strings,
+    so unlabeled exposition stays byte-identical."""
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label(s: str) -> str:
+    """Prometheus text-format label-VALUE escaping: backslash, double
+    quote, newline."""
+    return (s.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 class Counter:
@@ -290,24 +305,26 @@ class MetricsRegistry:
         form."""
         pairs = ""
         if labels:
-            pairs = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+            pairs = ",".join(
+                f'{k}="{escape_label(str(labels[k]))}"'
+                for k in sorted(labels))
         plain = f"{{{pairs}}}" if pairs else ""
         lines = []
         for name in self.names():
             m = self._metrics[name]
             if isinstance(m, Counter):
                 if m.help:
-                    lines.append(f"# HELP {name} {m.help}")
+                    lines.append(f"# HELP {name} {escape_help(m.help)}")
                 lines.append(f"# TYPE {name} counter")
                 lines.append(f"{name}{plain} {format(m.value, 'g')}")
             elif isinstance(m, Gauge):
                 if m.help:
-                    lines.append(f"# HELP {name} {m.help}")
+                    lines.append(f"# HELP {name} {escape_help(m.help)}")
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name}{plain} {format(m.value, 'g')}")
             elif isinstance(m, Histogram):
                 if m.help:
-                    lines.append(f"# HELP {name} {m.help}")
+                    lines.append(f"# HELP {name} {escape_help(m.help)}")
                 lines.append(f"# TYPE {name} histogram")
                 for le, c in m.cumulative():
                     bkt = (f'{pairs},le="{self._fmt_le(le)}"' if pairs

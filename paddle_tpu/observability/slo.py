@@ -1,0 +1,330 @@
+"""Streaming SLO evaluation over registry snapshots (ISSUE 5 tentpole;
+reference shape: Prometheus alerting rules — declarative objective,
+``for:`` hold before firing, hysteresis on clear — evaluated here over
+a sliding in-process window of :meth:`MetricsRegistry.snapshot` dicts
+instead of a remote TSDB).
+
+Why snapshots and not live metrics: counters and histogram buckets are
+CUMULATIVE, so a windowed statistic is a delta between the snapshot
+just outside the window and the newest one — p99-over-the-last-30s is
+the quantile of the bucket-count DELTAS, an error rate is
+Δfailed/Δadmitted. That makes evaluation pure: feed the same snapshots
+and the same ``check(now=)`` timestamps and the state machine replays
+deterministically (same discipline as the stall watchdog).
+
+Burn rate follows the SRE-workbook convention: how fast the error
+budget is being spent. For a quantile objective ``p99 < 0.5s`` the
+budget is the tolerated tail mass (1 - 0.99); the measured bad
+fraction over the window divided by that budget is the burn. A burn of
+1.0 means exactly on budget; 10 means burning ten times too fast.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from .metrics import _parse_le, now
+
+__all__ = ["SLORule", "SLOEngine", "AlertState"]
+
+_QUANTILE_STATS = {"p50": 0.5, "p90": 0.9, "p99": 0.99}
+
+
+@dataclass(frozen=True)
+class SLORule:
+    """One declarative objective.
+
+    ``stat`` selects the windowed statistic of ``metric``:
+
+    - ``"p50"``/``"p90"``/``"p99"``: windowed quantile of a histogram
+      (bucket-count deltas over the window);
+    - ``"rate"``: Δcounter / Δt (per second);
+    - ``"ratio"``: Δcounter / Δ(sum of ``total`` counters) — e.g.
+      error rate = failed / (retired + failed);
+    - ``"value"``: the newest gauge value (no window math).
+
+    The objective HOLDS while ``stat(metric) op threshold`` is true;
+    ``for_s`` is the breach hold before pending becomes firing and
+    ``clear_for_s`` the hysteresis before firing resolves."""
+
+    name: str
+    metric: str
+    stat: str
+    threshold: float
+    op: str = "<"
+    window_s: float = 60.0
+    for_s: float = 0.0
+    clear_for_s: float = 0.0
+    total: tuple = ()
+
+    def __post_init__(self):
+        if self.stat not in _QUANTILE_STATS and self.stat not in (
+                "rate", "ratio", "value"):
+            raise ValueError(f"SLORule {self.name}: unknown stat "
+                             f"{self.stat!r}")
+        if self.op not in ("<", "<=", ">", ">="):
+            raise ValueError(f"SLORule {self.name}: unknown op "
+                             f"{self.op!r}")
+        if self.stat == "ratio" and not self.total:
+            raise ValueError(f"SLORule {self.name}: ratio needs "
+                             f"total= counters")
+
+    def holds(self, measured: float) -> bool:
+        if self.op == "<":
+            return measured < self.threshold
+        if self.op == "<=":
+            return measured <= self.threshold
+        if self.op == ">":
+            return measured > self.threshold
+        return measured >= self.threshold
+
+
+@dataclass
+class AlertState:
+    """Per-rule alert lifecycle: ok -> pending -> firing -> ok."""
+
+    rule: SLORule
+    state: str = "ok"
+    breach_since: float | None = None
+    clear_since: float | None = None
+    measured: float | None = None
+    burn_rate: float | None = None
+    fired_count: int = 0
+    history: list = field(default_factory=list)
+
+
+def _hist_delta(first: dict | None, last: dict | None):
+    """Windowed histogram view: (delta cumulative buckets, delta count,
+    observed max). ``first`` may be None (no pre-window baseline: the
+    whole cumulative history is inside the window)."""
+    if last is None:
+        return None
+    buckets = {k: float(c) for k, c in last["buckets"].items()}
+    count = last["count"]
+    if first is not None:
+        for k, c in first["buckets"].items():
+            buckets[k] = buckets.get(k, 0.0) - c
+        count -= first["count"]
+    return buckets, count, last.get("max")
+
+
+def _delta_quantile(q: float, buckets: dict, total: float, mx):
+    """Same rank rule as Histogram.quantile over delta buckets."""
+    if total <= 0:
+        return None
+    rank = q * total
+    for key in sorted(buckets, key=_parse_le):
+        if buckets[key] >= rank:
+            le = _parse_le(key)
+            if le == float("inf"):
+                return mx if mx is not None else 0.0
+            return le
+    return mx if mx is not None else 0.0
+
+
+def _bad_fraction(buckets: dict, total: float, threshold: float):
+    """Fraction of windowed observations ABOVE ``threshold`` (first
+    edge >= threshold bounds the below-count from the cumulative
+    deltas)."""
+    if total <= 0:
+        return None
+    below = 0.0
+    for key in sorted(buckets, key=_parse_le):
+        if _parse_le(key) >= threshold:
+            below = buckets[key]
+            break
+    else:
+        below = total
+    return max(0.0, 1.0 - below / total)
+
+
+class SLOEngine:
+    """Sliding-window evaluator + alert state machine over a stream of
+    registry snapshots.
+
+    Feed it with :meth:`observe` (typically the fleet's merged
+    snapshot once per step or scrape) and advance the state machines
+    with :meth:`check`. Both take ``now=`` overrides so tests replay a
+    scenario deterministically. ``on_alert`` is called with a dict on
+    every firing and resolved transition — exceptions are contained
+    (observability must never take down serving)."""
+
+    def __init__(self, rules, on_alert=None, registry=None):
+        self.rules = list(rules)
+        names = [r.name for r in self.rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO rule names: {names}")
+        self.on_alert = on_alert
+        self._alerts = {r.name: AlertState(r) for r in self.rules}
+        self._window: deque = deque()       # (t, snapshot)
+        self._max_window = max((r.window_s for r in self.rules),
+                               default=60.0)
+        self.transitions: list[dict] = []
+        self._fired = self._resolved = None
+        self._firing_gauge = None
+        if registry is not None:
+            self._fired = registry.counter(
+                "slo_alerts_fired_total", "SLO alerts that reached firing")
+            self._resolved = registry.counter(
+                "slo_alerts_resolved_total", "SLO alerts that resolved")
+            self._firing_gauge = registry.gauge(
+                "slo_alerts_firing", "currently firing SLO alerts",
+                fn=lambda: len(self.firing()))
+
+    # -- window -------------------------------------------------------------
+    def observe(self, snapshot: dict, now_: float | None = None) -> None:
+        t = now() if now_ is None else now_
+        self._window.append((t, snapshot))
+        self._prune(t)
+
+    def _prune(self, t: float) -> None:
+        # keep everything inside the widest window PLUS one older
+        # snapshot as the delta baseline
+        cutoff = t - self._max_window
+        while (len(self._window) >= 2
+               and self._window[1][0] <= cutoff):
+            self._window.popleft()
+
+    def _bounds(self, window_s: float, t: float):
+        """(first, last) snapshots bracketing the window ending at
+        ``t``: last = newest, first = newest snapshot at or before the
+        window start (None if history starts inside the window)."""
+        if not self._window:
+            return None, None
+        cutoff = t - window_s
+        first = None
+        for ts, snap in self._window:
+            if ts <= cutoff:
+                first = snap
+            else:
+                break
+        return first, self._window[-1][1]
+
+    # -- evaluation ---------------------------------------------------------
+    def evaluate(self, rule: SLORule, now_: float | None = None):
+        """(measured, burn_rate) for one rule over its window; both
+        None when the window holds no data (no-data = objective met)."""
+        t = now() if now_ is None else now_
+        first, last = self._bounds(rule.window_s, t)
+        if last is None:
+            return None, None
+        if rule.stat in _QUANTILE_STATS:
+            q = _QUANTILE_STATS[rule.stat]
+            h0 = (first or {}).get("histograms", {}).get(rule.metric)
+            h1 = last.get("histograms", {}).get(rule.metric)
+            if h1 is None:
+                return None, None
+            buckets, total, mx = _hist_delta(h0, h1)
+            measured = _delta_quantile(q, buckets, total, mx)
+            if measured is None:
+                return None, None
+            budget = max(1.0 - q, 1e-12)
+            bad = _bad_fraction(buckets, total, rule.threshold)
+            burn = None if bad is None else bad / budget
+            return measured, burn
+        if rule.stat == "value":
+            v = last.get("gauges", {}).get(rule.metric)
+            if v is None or v != v:
+                return None, None
+            burn = (v / rule.threshold) if rule.threshold > 0 else None
+            return v, burn
+
+        def counter_delta(name):
+            v1 = last.get("counters", {}).get(name)
+            if v1 is None:
+                return None
+            v0 = (first or {}).get("counters", {}).get(name, 0.0)
+            return v1 - v0
+
+        d = counter_delta(rule.metric)
+        if d is None:
+            return None, None
+        if rule.stat == "rate":
+            if first is None and len(self._window) < 2:
+                return None, None
+            dt = rule.window_s
+            measured = d / dt if dt > 0 else None
+            if measured is None:
+                return None, None
+            burn = (measured / rule.threshold
+                    if rule.threshold > 0 else None)
+            return measured, burn
+        # ratio
+        denom = 0.0
+        for name in rule.total:
+            dd = counter_delta(name)
+            if dd is not None:
+                denom += dd
+        if denom <= 0:
+            return None, None
+        measured = d / denom
+        budget = rule.threshold if rule.threshold > 0 else 1e-12
+        return measured, measured / budget
+
+    # -- state machine ------------------------------------------------------
+    def check(self, now_: float | None = None) -> list[dict]:
+        """Advance every rule's alert state; returns the transitions
+        that happened this check (firing / resolved dicts, also
+        appended to :attr:`transitions` and sent to ``on_alert``)."""
+        t = now() if now_ is None else now_
+        events = []
+        for rule in self.rules:
+            st = self._alerts[rule.name]
+            measured, burn = self.evaluate(rule, t)
+            st.measured, st.burn_rate = measured, burn
+            breach = (measured is not None
+                      and not rule.holds(measured))
+            if st.state == "ok":
+                if breach:
+                    st.state = "pending"
+                    st.breach_since = t
+            if st.state == "pending":
+                if not breach:
+                    st.state = "ok"
+                    st.breach_since = None
+                elif t - st.breach_since >= rule.for_s:
+                    st.state = "firing"
+                    st.clear_since = None
+                    st.fired_count += 1
+                    events.append(self._emit(st, "firing", t))
+            elif st.state == "firing":
+                if breach:
+                    st.clear_since = None
+                else:
+                    if st.clear_since is None:
+                        st.clear_since = t
+                    if t - st.clear_since >= rule.clear_for_s:
+                        st.state = "ok"
+                        st.breach_since = st.clear_since = None
+                        events.append(self._emit(st, "resolved", t))
+        return events
+
+    def _emit(self, st: AlertState, kind: str, t: float) -> dict:
+        info = {"rule": st.rule.name, "state": kind, "t": t,
+                "metric": st.rule.metric, "stat": st.rule.stat,
+                "op": st.rule.op, "threshold": st.rule.threshold,
+                "measured": st.measured, "burn_rate": st.burn_rate}
+        st.history.append(info)
+        self.transitions.append(info)
+        if kind == "firing" and self._fired is not None:
+            self._fired.inc()
+        if kind == "resolved" and self._resolved is not None:
+            self._resolved.inc()
+        if self.on_alert is not None:
+            try:
+                self.on_alert(info)
+            except Exception:   # noqa: BLE001 — never crash serving
+                pass
+        return info
+
+    # -- views --------------------------------------------------------------
+    def alert(self, name: str) -> AlertState:
+        return self._alerts[name]
+
+    def firing(self) -> list[str]:
+        return [n for n, st in self._alerts.items()
+                if st.state == "firing"]
+
+    def states(self) -> dict:
+        return {n: st.state for n, st in self._alerts.items()}

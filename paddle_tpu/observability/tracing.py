@@ -18,6 +18,7 @@ cold admission."""
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 
 from .metrics import now
@@ -40,30 +41,84 @@ def _next_id() -> int:
 
 
 class RequestTrace:
-    """Timestamped lifecycle record for one generation request."""
+    """Timestamped lifecycle record for one generation request.
 
-    __slots__ = ("request_id", "events")
+    Fleet propagation (ISSUE 5): a trace additionally carries a
+    process-unique ``trace_id``, free-form ``attrs`` (routing decision,
+    worker assignment), and ``hops`` — failover records linking the
+    segments a request spent on different workers into ONE story. The
+    ``events`` list stays a plain ``(state, t)`` tuple record (r8
+    consumers iterate it); per-event worker attribution lives in a
+    parallel sparse map keyed by event index."""
 
-    def __init__(self, request_id=None, t=None):
-        self.request_id = (_next_id() if request_id is None
-                           else request_id)
+    __slots__ = ("request_id", "trace_id", "events", "attrs", "hops",
+                 "_event_workers")
+
+    def __init__(self, request_id=None, t=None, trace_id=None):
+        nid = _next_id()
+        self.request_id = nid if request_id is None else request_id
+        self.trace_id = (f"{os.getpid():x}-{nid:08x}"
+                         if trace_id is None else trace_id)
         self.events: list[tuple[str, float]] = [
             ("arrival", now() if t is None else t)]
+        self.attrs: dict = {}
+        self.hops: list[dict] = []
+        self._event_workers: dict[int, str] = {}
 
-    def mark(self, state: str, t: float | None = None) -> float:
+    def mark(self, state: str, t: float | None = None,
+             worker: str | None = None) -> float:
         """Append a transition; returns its timestamp. ``t`` overrides
-        the clock (tests only)."""
+        the clock (tests only); ``worker`` attributes the event to a
+        fleet worker lane."""
         t = now() if t is None else t
+        if worker is not None:
+            self._event_workers[len(self.events)] = worker
         self.events.append((state, t))
         return t
 
-    def mark_once(self, state: str, t: float | None = None):
+    def mark_once(self, state: str, t: float | None = None,
+                  worker: str | None = None):
         """Mark only if ``state`` was never recorded; returns the new
         timestamp, or None when the state already exists (a resumed
         request does not get a second ``first_token``)."""
         if self.first(state) is not None:
             return None
-        return self.mark(state, t)
+        return self.mark(state, t, worker=worker)
+
+    # -- fleet propagation --------------------------------------------------
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def add_hop(self, frm: str, to: str, reason: str = "failover",
+                t: float | None = None, **extra) -> dict:
+        """Record a cross-worker hop (failover re-route). The hop keeps
+        the trace ONE story: Chrome export splits the per-worker
+        residency span at each hop's timestamp."""
+        hop = {"t": now() if t is None else t, "from": frm, "to": to,
+               "reason": reason}
+        hop.update(extra)
+        self.hops.append(hop)
+        self.attrs["worker_id"] = to
+        return hop
+
+    def worker_of(self, index: int) -> str | None:
+        """Worker attributed to ``events[index]`` (None if unattributed)."""
+        return self._event_workers.get(index)
+
+    @property
+    def workers(self) -> list[str]:
+        """Distinct workers that touched this request, in first-touch
+        order (event attribution first, then hop endpoints)."""
+        seen: list[str] = []
+        for i in range(len(self.events)):
+            w = self._event_workers.get(i)
+            if w is not None and w not in seen:
+                seen.append(w)
+        for hop in self.hops:
+            for w in (hop["from"], hop["to"]):
+                if w is not None and w not in seen:
+                    seen.append(w)
+        return seen
 
     # -- lookups ------------------------------------------------------------
     def times(self, state: str) -> list[float]:
@@ -163,7 +218,9 @@ class RequestTrace:
         return all(b >= a for a, b in zip(order, order[1:]))
 
     def summary(self) -> dict:
-        """JSON-able digest (stall-watchdog dumps, debug logging)."""
+        """JSON-able digest (stall-watchdog dumps, debug logging,
+        shipper export). r8 keys are unchanged; ISSUE 5 appends
+        ``trace_id``/``worker_id``/``hops``/``attrs``."""
         term = self.terminal
         return {
             "request_id": self.request_id,
@@ -174,7 +231,77 @@ class RequestTrace:
             "preemptions": self.preemptions,
             "decode_chunks": self.decode_chunks,
             "events": [(s, round(t, 6)) for s, t in self.events],
+            "trace_id": self.trace_id,
+            "worker_id": self.attrs.get("worker_id"),
+            "hops": [dict(h) for h in self.hops],
+            "attrs": dict(self.attrs),
         }
+
+    # -- Chrome trace export ------------------------------------------------
+    def _segments(self):
+        """Contiguous worker-residency stretches: ``(worker, t0, t1)``.
+        An event without explicit attribution stays on the previous
+        worker; hops force a split even when no event was marked on the
+        destination yet."""
+        marks = []          # (t, tiebreak, worker) in time order —
+        for i, (_, t) in enumerate(self.events):   # hops sort after
+            w = self._event_workers.get(i)         # same-instant marks
+            if w is not None:
+                marks.append((t, 0, w))
+        for hop in self.hops:
+            marks.append((hop["t"], 1, hop["to"]))
+        marks.sort(key=lambda m: m[:2])
+        cuts, cur = [], None
+        for t, _, w in marks:
+            if w != cur:
+                cuts.append((t, w))
+                cur = w
+        end = self.events[-1][1]
+        segs = []
+        for j, (t0, w) in enumerate(cuts):
+            t1 = cuts[j + 1][0] if j + 1 < len(cuts) else end
+            if t1 >= t0:
+                segs.append((w, t0, t1))
+        return segs
+
+    def to_events(self, pid_for=None, tid=None) -> list[dict]:
+        """Chrome-trace (``chrome://tracing`` JSON array) events for
+        this request: one ``ph:"i"`` instant per lifecycle mark, one
+        ``ph:"X"`` span per worker-residency segment, and one instant
+        per failover hop. ``pid_for(worker)`` maps a worker id to a
+        Chrome pid lane (default: every event on pid 0); ``tid``
+        defaults to the request id so concurrent requests get separate
+        rows inside a worker lane. Timestamps are microseconds on the
+        shared monotonic clock — directly mergeable with profiler
+        spans."""
+        if pid_for is None:
+            pid_for = lambda w: 0           # noqa: E731
+        row = self.request_id if tid is None else tid
+        rid = f"req{self.request_id}"
+        out = []
+        cur_pid = pid_for(None)
+        for i, (state, t) in enumerate(self.events):
+            w = self._event_workers.get(i)
+            if w is not None:
+                cur_pid = pid_for(w)
+            out.append({"name": f"{rid}.{state}", "ph": "i", "s": "t",
+                        "ts": t * 1e6, "pid": cur_pid, "tid": row,
+                        "cat": "request",
+                        "args": {"trace_id": self.trace_id}})
+        for w, t0, t1 in self._segments():
+            out.append({"name": f"{rid}@{w}", "ph": "X",
+                        "ts": t0 * 1e6, "dur": max(t1 - t0, 0.0) * 1e6,
+                        "pid": pid_for(w), "tid": row, "cat": "request",
+                        "args": {"trace_id": self.trace_id,
+                                 "worker": w}})
+        for hop in self.hops:
+            out.append({"name": f"{rid}.hop", "ph": "i", "s": "p",
+                        "ts": hop["t"] * 1e6, "pid": pid_for(hop["to"]),
+                        "tid": row, "cat": "request",
+                        "args": {k: v for k, v in hop.items()
+                                 if k != "t"} | {
+                                     "trace_id": self.trace_id}})
+        return out
 
     def __repr__(self):
         return (f"RequestTrace(id={self.request_id}, "

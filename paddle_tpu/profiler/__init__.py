@@ -53,6 +53,7 @@ class _Span:
     end_ns: int
     tid: int
     kind: str = "user"
+    worker: str | None = None   # fleet worker lane (ISSUE 5 export)
 
 
 class _SpanBuffer:
@@ -81,9 +82,11 @@ class RecordEvent:
     """reference python/paddle/profiler/utils.py RecordEvent — host span;
     usable as context manager or begin()/end() pair."""
 
-    def __init__(self, name: str, event_type: str = "user"):
+    def __init__(self, name: str, event_type: str = "user",
+                 worker: str | None = None):
         self.name = name
         self.event_type = event_type
+        self.worker = worker        # fleet worker attribution (ISSUE 5)
         self._start = None
 
     def begin(self):
@@ -94,7 +97,8 @@ class RecordEvent:
             self._start = None
             return
         _BUFFER.add(_Span(self.name, self._start, time.perf_counter_ns(),
-                          threading.get_ident(), self.event_type))
+                          threading.get_ident(), self.event_type,
+                          self.worker))
         self._start = None
 
     def __enter__(self):

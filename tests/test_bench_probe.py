@@ -48,6 +48,10 @@ def test_infra_skip_metric_follows_preset(monkeypatch, capsys):
     bench._emit_infra_skip("tunnel down")
     out = json.loads(capsys.readouterr().out.strip())
     assert out["metric"] == "fleet_affinity_ttft_ms"
+    monkeypatch.setenv("BENCH_PRESET", "slo")
+    bench._emit_infra_skip("tunnel down")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "slo_shipper_overhead_pct"
 
 
 @pytest.mark.slow
@@ -115,6 +119,44 @@ def test_fleet_preset_cpu_smoke(tmp_path):
     assert merged["histograms"]["engine_ttft_seconds"]["count"] == sum(
         snap["workers"][w]["histograms"]["engine_ttft_seconds"]["count"]
         for w in ("w0", "w1"))
+
+
+@pytest.mark.slow
+def test_slo_preset_cpu_smoke(tmp_path):
+    """End-to-end CPU run of BENCH_PRESET=slo (ISSUE 5 satellite): one
+    JSON line, the SLO engine + shipper cost under 5% of step wall (the
+    acceptance budget), the shipper actually delivered telemetry to the
+    JSONL sink, and the aggregated snapshot carries the shipper's
+    self-observation counters."""
+    env = dict(os.environ, BENCH_PRESET="slo", BENCH_ALLOW_CPU="1",
+               BENCH_NO_WALL="1", BENCH_SKIP_PROBE="1",
+               BENCH_METRICS_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, bench.__file__], env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1                         # one-JSON-line contract
+    out = json.loads(lines[0])
+    assert out["metric"] == "slo_shipper_overhead_pct"
+    assert out["value"] < 5.0          # telemetry tax under the 5% budget
+    assert out["vs_baseline"] > 0.95
+    ship = out["extra"]["shipper"]
+    assert ship["shipped"] > 0
+    assert ship["sink_errors"] == 0
+    assert out["extra"]["slo_states"] == {"ttft_p99": "ok",
+                                          "error_rate": "ok"}
+    with open(out["extra"]["telemetry_jsonl"]) as fh:
+        payloads = [json.loads(ln) for ln in fh if ln.strip()]
+    assert payloads and all(p["kind"] == "fleet_telemetry"
+                            for p in payloads)
+    snap_path = out["extra"]["metrics_snapshot"]
+    assert snap_path == str(tmp_path / "bench_metrics_slo.json")
+    snap = json.load(open(snap_path))
+    assert "shipper" in snap["workers"]
+    assert snap["workers"]["shipper"]["counters"][
+        "shipper_shipped_total"] > 0
 
 
 def test_env_flag_tolerant(monkeypatch):

@@ -1,30 +1,61 @@
-"""Timer-discipline lint (ISSUE 3 satellite): serving code must stamp
-time through ``paddle_tpu.observability.now`` — the one clock the
-metrics registry, request traces, and engine spans share — never via
-ad-hoc ``time.perf_counter()`` pairs. A raw call sneaking back into the
-inference package would let a hand-rolled latency number disagree with
-the trace-derived histograms, which is exactly the drift the
-observability layer exists to end."""
+"""Timer-discipline lint (ISSUE 3 satellite, extended by ISSUE 5):
+serving code must stamp time through ``paddle_tpu.observability.now``
+— the one clock the metrics registry, request traces, and engine spans
+share — never via ad-hoc ``time.perf_counter()`` pairs. A raw call
+sneaking back into the inference package would let a hand-rolled
+latency number disagree with the trace-derived histograms, which is
+exactly the drift the observability layer exists to end.
+
+ISSUE 5 widens the net to the observability package itself and the
+stall watchdog: those modules DEFINE and CONSUME the shared clock, so
+they are additionally banned from ``time.monotonic`` (the watchdog's
+old clock) — everything goes through ``observability.now``. The single
+exemption is the alias-definition line in ``observability/metrics.py``
+(``now = time.perf_counter``), which is the one place the raw spelling
+is the point."""
 
 import pathlib
 
-INFERENCE = (pathlib.Path(__file__).resolve().parent.parent
-             / "paddle_tpu" / "inference")
+_ROOT = pathlib.Path(__file__).resolve().parent.parent / "paddle_tpu"
+INFERENCE = _ROOT / "inference"
+OBSERVABILITY = _ROOT / "observability"
+WATCHDOG = _ROOT / "distributed" / "watchdog.py"
 
 BANNED = "time.perf_counter"
+_ALIAS_DEF = "now = time.perf_counter"
+
+
+def _offenders(paths, banned, allow_alias_def=False):
+    out = []
+    for py in paths:
+        for lineno, line in enumerate(py.read_text().splitlines(), 1):
+            if allow_alias_def and line.strip() == _ALIAS_DEF:
+                continue            # the alias definition itself
+            for token in banned:
+                if token in line:
+                    out.append(f"{py.name}:{lineno}: {line.strip()}")
+    return out
 
 
 def test_inference_package_has_no_raw_perf_counter():
-    offenders = []
-    for py in sorted(INFERENCE.glob("*.py")):
-        text = py.read_text()
-        for lineno, line in enumerate(text.splitlines(), 1):
-            if BANNED in line:
-                offenders.append(f"{py.name}:{lineno}: {line.strip()}")
+    offenders = _offenders(sorted(INFERENCE.glob("*.py")), (BANNED,))
     assert not offenders, (
         "raw time.perf_counter() in paddle_tpu/inference/ — use "
         "`from ..observability import now` instead:\n"
         + "\n".join(offenders))
+
+
+def test_observability_and_watchdog_use_shared_clock():
+    """ISSUE 5: the telemetry substrate itself must not fork the clock
+    — observability/ and the stall watchdog are banned from BOTH raw
+    spellings (perf_counter AND the watchdog's old monotonic), modulo
+    the alias-definition line in metrics.py."""
+    paths = sorted(OBSERVABILITY.glob("*.py")) + [WATCHDOG]
+    offenders = _offenders(paths, (BANNED, "time.monotonic"),
+                           allow_alias_def=True)
+    assert not offenders, (
+        "raw timer call in observability/ or distributed/watchdog.py "
+        "— use `observability.now`:\n" + "\n".join(offenders))
 
 
 def test_lint_covers_fleet_modules():
@@ -37,6 +68,17 @@ def test_lint_covers_fleet_modules():
         assert required in scanned, (
             f"{required} missing from the timer-lint scan set "
             f"{sorted(scanned)}")
+
+
+def test_lint_covers_observability_modules():
+    """ISSUE 5 grew observability/ by slo.py/export.py; the widened
+    scan set must include them and the watchdog."""
+    scanned = {py.name for py in OBSERVABILITY.glob("*.py")}
+    for required in ("metrics.py", "tracing.py", "slo.py", "export.py"):
+        assert required in scanned, (
+            f"{required} missing from the observability lint scan set "
+            f"{sorted(scanned)}")
+    assert WATCHDOG.exists(), "distributed/watchdog.py moved"
 
 
 def test_shared_clock_is_perf_counter():
