@@ -45,6 +45,7 @@ _PRESET_METRICS = {
     "prefix": "prefix_cached_ttft_ms",
     "fleet": "fleet_affinity_ttft_ms",
     "slo": "slo_shipper_overhead_pct",
+    "overload": "overload_p99_ttft_ms",
     "smoke": "smoke_wall_seconds",
 }
 
@@ -811,6 +812,158 @@ def bench_slo():
     }))
 
 
+def bench_overload():
+    """Multi-tenant overload harness (ISSUE 6): a bursty, heavy-tailed,
+    tenant-skewed synthetic flood (seeded :class:`TrafficGenerator`)
+    drives a 2-worker fleet far past capacity for a fixed virtual-time
+    window — once WITHOUT QoS (FCFS baseline) and twice WITH the QoS
+    stack armed (token bucket on the flooding tenant, weighted fair
+    sharing, SLO-driven shedding above a backlog target). Every policy
+    decision runs on a VIRTUAL clock, so per-tenant admitted/throttled/
+    shed/served accounting must replay bit-identically — the repeated
+    QoS run checks exactly that and ``extra.qos.deterministic`` records
+    the outcome. The metric is fleet p99 TTFT (ms) under overload with
+    QoS on; vs_baseline is Jain's fairness index over per-tenant served
+    tokens, QoS-on / QoS-off (> 1 means fair sharing equalized service
+    the FCFS baseline skewed toward the flooding tenant)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.fleet import ServingFleet
+    from paddle_tpu.inference.qos import QoSPolicy, TenantPolicy
+    from paddle_tpu.inference.traffic import (TenantProfile,
+                                              TrafficGenerator,
+                                              jain_index)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import SLORule
+    on_tpu = jax.default_backend() not in ("cpu",)
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
+                          intermediate_size=14336, num_hidden_layers=2,
+                          num_attention_heads=32, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16")
+        s_max, chunk, bs = 512, 8, 16
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=344, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2)
+        s_max, chunk, bs = 64, 4, 16
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    gen = TrafficGenerator(
+        [TenantProfile("t_heavy", share=8.0),
+         TenantProfile("t_light", share=2.0)],
+        rate=4.0, seed=0, process="bursty", prompt_dist="heavy_tail",
+        prompt_min=4, prompt_max=24, max_new=8)
+    arrivals = gen.arrivals(12.0)
+    dt, n_steps = 0.25, 72      # virtual window: 18 s, past the flood
+
+    def tally(reqs):
+        """Per-tenant outcome counts from the traces (works with or
+        without QoS — the shed path stamps ``shed_reason``)."""
+        out = {}
+        for r in reqs:
+            d = out.setdefault(str(r.tenant), dict(
+                submitted=0, retired=0, shed=0, rejected=0, pending=0,
+                served_tokens=0))
+            d["submitted"] += 1
+            term = r.trace.terminal
+            if term == "retired":
+                d["retired"] += 1
+                d["served_tokens"] += r.max_new
+            elif term == "failed":
+                key = ("shed" if "shed_reason" in r.trace.attrs
+                       else "rejected")
+                d[key] += 1
+            else:
+                d["pending"] += 1
+        return out
+
+    def run_once(use_qos):
+        vt = [0.0]
+        qos = None
+        if use_qos:
+            qos = QoSPolicy([
+                # the flooding tenant: rate-limited, shed first
+                TenantPolicy("t_heavy", rate=100.0, burst=280.0,
+                             weight=1.0, tier=0, shed_floor=1),
+                # the interactive tenant: unthrottled, shed-protected
+                TenantPolicy("t_light", weight=1.0, tier=1,
+                             shed_floor=1),
+            ], clock=lambda: vt[0])
+        fleet = ServingFleet(model, n_workers=2, policy="round_robin",
+                             engine_kwargs=dict(capacity=2, s_max=s_max,
+                                                chunk=chunk,
+                                                block_size=bs),
+                             qos=qos)
+        if use_qos:
+            fleet.enable_slo(rules=[
+                SLORule("backlog", "engine_backlog", "value",
+                        threshold=12.0, window_s=60.0, for_s=0.5,
+                        clear_for_s=1.0)],
+                shed=True, shed_target_backlog=8)
+        reqs, idx = [], 0
+        for _ in range(n_steps):
+            while idx < len(arrivals) and arrivals[idx].t <= vt[0]:
+                sr = arrivals[idx]
+                ids = gen.prompt_ids(sr, cfg.vocab_size, index=idx)
+                reqs.append(fleet.submit(ids, max_new_tokens=sr.max_new,
+                                         tenant=sr.tenant))
+                idx += 1
+            fleet.step()
+            if use_qos:
+                fleet.check_slo(now=vt[0])
+            vt[0] += dt
+        per_tenant = tally(reqs)
+        # the deterministic signature: everything the virtual clock
+        # controls (admission, throttling, shedding, service), nothing
+        # the wall clock touches (TTFT histograms)
+        sig = {"tally": per_tenant,
+               "qos": fleet.qos.stats() if use_qos else None,
+               "shed": int(fleet._c_shed.value) if use_qos else 0,
+               "arrivals_submitted": idx}
+        snap = fleet.aggregator().snapshot()
+        fleet.close()
+        return sig, snap
+
+    sig_off, _ = run_once(use_qos=False)
+    sig_on, snap_on = run_once(use_qos=True)
+    sig_on2, _ = run_once(use_qos=True)
+
+    def jain_of(sig):
+        return jain_index(sig["tally"][t]["served_tokens"]
+                          for t in sorted(sig["tally"]))
+
+    jain_off = jain_of(sig_off)
+    jain_on = jain_of(sig_on)
+    ttft = snap_on["fleet"]["histograms"].get("engine_ttft_seconds", {})
+    p99_ms = (ttft.get("p99") or 0.0) * 1e3
+    shed_on = sig_on["shed"]
+    submitted = sig_on["arrivals_submitted"]
+    snap_path = _dump_metrics_snapshot(None, "overload",
+                                       snapshot=snap_on)
+    print(json.dumps({
+        "metric": "overload_p99_ttft_ms",
+        "value": round(p99_ms, 2),
+        "unit": "ms",
+        "vs_baseline": round(jain_on / max(jain_off, 1e-9), 4),
+        "extra": {"arrivals": len(arrivals),
+                  "submitted": submitted,
+                  "virtual_window_s": round(n_steps * dt, 2),
+                  "jain_fairness_on": round(jain_on, 4),
+                  "jain_fairness_off": round(jain_off, 4),
+                  "shed_rate": round(shed_on / max(submitted, 1), 4),
+                  "qos": {"deterministic": sig_on == sig_on2,
+                          "shed_total": shed_on,
+                          "per_tenant": sig_on["qos"]},
+                  "tally_on": sig_on["tally"],
+                  "tally_off": sig_off["tally"],
+                  "metrics_snapshot": snap_path,
+                  "backend": jax.default_backend()},
+    }))
+
+
 def bench_smoke():
     """Sub-minute pipeline probe: ONE tiny compiled train step
     (fwd+bwd+AdamW) plus ONE compiled flash-attention fwd+bwd. The
@@ -898,6 +1051,8 @@ def main():
         return bench_fleet()
     if preset == "slo":
         return bench_slo()
+    if preset == "overload":
+        return bench_overload()
     if preset == "smoke":
         return bench_smoke()
     if on_tpu:

@@ -198,7 +198,7 @@ class ServingFleet:
 
     def __init__(self, model, n_workers=2, policy="affinity",
                  load_penalty=None, engine_kwargs=None,
-                 stall_s=30.0, registry=None):
+                 stall_s=30.0, registry=None, qos=None):
         if n_workers < 1:
             raise ValueError(f"n_workers={n_workers}")
         if policy not in ("affinity", "round_robin"):
@@ -206,6 +206,16 @@ class ServingFleet:
         self.policy = policy
         kw = dict(engine_kwargs or {})
         kw.setdefault("paged", True)
+        kw.pop("qos", None)     # the fleet owns the shared QoS policy
+        # ISSUE 6: one QoSPolicy shared by the router (token-bucket
+        # admission at submit, shed planning) and every worker engine
+        # (fair-share scheduling weights). The fleet's gate is the only
+        # admission check — engine gates stay empty because requests
+        # enter workers via routed pending lists, not engine.submit().
+        self.qos = qos
+        self._qos_gate = qos.gate() if qos is not None else None
+        self._shed = False
+        self._shed_target = 0
         block_size = int(kw.get("block_size", 16))
         self.load_penalty = (float(load_penalty)
                              if load_penalty is not None
@@ -223,6 +233,12 @@ class ServingFleet:
         self._c_rerouted = self.metrics.counter(
             "fleet_rerouted_total",
             "requests re-routed off a failed worker")
+        self._c_shed = self.metrics.counter(
+            "fleet_shed_total",
+            "pending requests shed while an SLO alert fired")
+        self._c_qos_rejected = self.metrics.counter(
+            "fleet_qos_rejected_total",
+            "requests rejected by tenant admission")
         self.metrics.gauge(
             "fleet_healthy_workers", "workers currently routable",
             fn=lambda: sum(1 for w in self.workers if w.healthy))
@@ -232,7 +248,8 @@ class ServingFleet:
             reg = MetricsRegistry()
             eng = DecodeEngine(
                 model, registry=reg, worker_id=wid,
-                prefix_listener=self.directory.listener(wid), **kw)
+                prefix_listener=self.directory.listener(wid),
+                qos=qos, **kw)
             wd = EngineStallWatchdog(
                 reg, stall_s=stall_s,
                 on_stall=lambda info, w=wid: self._mark_unhealthy(
@@ -314,22 +331,50 @@ class ServingFleet:
         tr.mark("routed", worker=w.wid)
 
     def submit(self, input_ids, max_new_tokens=32,
-               priority=0) -> _Request:
+               priority=0, tenant=None) -> _Request:
         """Route one request and return its future (``req.wait()``
         resolves once some worker retires it — drive :meth:`step` or
-        :meth:`run_until_drained` to make progress)."""
+        :meth:`run_until_drained` to make progress).
+
+        With a ``qos=`` policy (ISSUE 6), ``tenant`` selects the
+        request's token bucket / fair-share queue / shed tier. An
+        over-rate request is held behind its bucket (released and
+        routed by a later :meth:`step`) or, for ``on_limit="reject"``
+        tenants, failed immediately with the rejection reason on the
+        trace — ``req.wait()`` raises either way."""
         import numpy as _np
         ids = _np.asarray(input_ids).reshape(-1)
-        req = _Request(input_ids, max_new_tokens, priority=priority)
+        req = _Request(input_ids, max_new_tokens, priority=priority,
+                       tenant=tenant)
         with self._lock:
             req._sched_seq = self._seq
             self._seq += 1
-            w = self._route(ids)
-            self._stamp_route(req, w)
-            w.pending.append(req)
             self._c_submitted.inc()
             self._traces.append(req.trace)
             self._open_traces.append(req.trace)
+            if self._qos_gate is not None:
+                verdict, reason = self._qos_gate.decide(req)
+                if verdict == "reject":
+                    self._c_qos_rejected.inc()
+                    req.trace.set_attr("reject_reason", reason)
+                    req.error = PermissionError(
+                        f"QoS rejected ({reason}) for tenant "
+                        f"{tenant!r}")
+                    req.event.set()
+                    _tmark(req, "failed")
+                    log_kv(_log, "qos_rejected", level=logging.WARNING,
+                           req=req.trace.request_id, tenant=tenant,
+                           reason=reason)
+                    return req
+                if verdict == "throttle":
+                    # gate wait opens the queued->admitted stint
+                    _tmark(req, "queued")
+                    log_kv(_log, "qos_throttled", level=logging.DEBUG,
+                           req=req.trace.request_id, tenant=tenant)
+                    return req
+            w = self._route(ids)
+            self._stamp_route(req, w)
+            w.pending.append(req)
         log_kv(_log, "routed", level=logging.DEBUG, worker=w.wid,
                req=req.trace.request_id, tokens=int(ids.size),
                policy=self.policy)
@@ -417,6 +462,70 @@ class ServingFleet:
                       rerouted=len(reqs))
         return moved
 
+    # -- SLO-driven load shedding (ISSUE 6) ---------------------------------
+    def _shed_locked(self) -> int:
+        """Shed pending work down to the configured target while a
+        burn-rate alert fires. Candidates are everything not yet
+        decoding (gate-held, routed, and scheduler-queued requests);
+        the QoS planner picks victims lowest-tier-first, newest-first,
+        never cutting a tenant below its ``shed_floor`` of retained
+        pending+running requests. Victims fail LOUDLY — error set,
+        ``shed_reason`` on the trace, per-tenant ``qos_shed_total``
+        increment. Lock held by caller."""
+        from .qos import tenant_of
+        cand = []
+        running: dict = {}
+        if self._qos_gate is not None:
+            cand.extend(self._qos_gate.held())
+        for w in self.workers:
+            if not w.healthy:
+                continue
+            cand.extend(w.pending)
+            sch = w.engine._sched
+            if sch is not None:
+                cand.extend(sch.requests())
+            for row in w.engine._rows:
+                if row is not None:
+                    t = tenant_of(row["req"])
+                    running[t] = running.get(t, 0) + 1
+        victims = self.qos.shed_plan(cand, running,
+                                     target=self._shed_target)
+        if not victims:
+            return 0
+        firing = sorted(n for n, s in self.slo.states().items()
+                        if s == "firing")
+        reason = "slo_burn_rate:" + ",".join(firing)
+        if self._qos_gate is not None:
+            self._qos_gate.remove(victims)
+        vids = {id(r) for r in victims}
+        for w in self.workers:
+            if not w.healthy:
+                continue
+            w.pending = [r for r in w.pending if id(r) not in vids]
+            sch = w.engine._sched
+            if sch is not None:
+                sch.remove(victims)
+        for req in victims:
+            self._shed_request(req, reason)
+        log_kv(_log, "shed", level=logging.WARNING,
+               count=len(victims), reason=reason,
+               remaining=self.pending_work())
+        log_event("fleet_shed", count=len(victims), reason=reason)
+        return len(victims)
+
+    def _shed_request(self, req, reason: str) -> None:
+        from .qos import RequestShedError, tenant_of
+        tenant = tenant_of(req)
+        tr = getattr(req, "trace", None)
+        if tr is not None:
+            tr.set_attr("shed_reason", reason)
+        req.error = RequestShedError(
+            f"shed under SLO pressure ({reason}, tenant={tenant!r})")
+        req.event.set()
+        _tmark(req, "failed")
+        self.qos.note_shed(tenant)
+        self._c_shed.inc()
+
     # -- driving ------------------------------------------------------------
     def step(self) -> int:
         """One synchronous fleet step: failover anything flagged
@@ -424,7 +533,17 @@ class ServingFleet:
         raising step fails the WORKER, not the fleet — its requests
         re-route on the spot). Returns live rows across the fleet."""
         with self._lock:
+            if self._qos_gate is not None:
+                # buckets refilled since submit: route the released
+                # requests in arrival order before this step's admission
+                for req in self._qos_gate.release():
+                    w = self._route(req.ids.reshape(-1))
+                    self._stamp_route(req, w)
+                    w.pending.append(req)
             self._failover_locked()
+            if (self._shed and self.slo is not None
+                    and self.slo.firing()):
+                self._shed_locked()
         alive = 0
         for w in self.workers:
             if not w.healthy:
@@ -457,9 +576,15 @@ class ServingFleet:
         return alive
 
     def pending_work(self) -> int:
-        """Requests anywhere in flight: routed, scheduled, or running."""
+        """Requests anywhere in flight: routed, scheduled, running, or
+        held behind a tenant's token bucket (those drain only as the
+        bucket's clock advances)."""
+        gated = self._qos_gate.depth() if self._qos_gate is not None \
+            else 0
         return sum(w.load for w in self.workers if w.healthy) \
-            + sum(len(w.pending) for w in self.workers if not w.healthy)
+            + sum(len(w.pending) for w in self.workers
+                  if not w.healthy) \
+            + gated
 
     def run_until_drained(self, max_steps=10_000) -> int:
         """Step until no healthy worker has work. Returns steps taken."""
@@ -499,7 +624,9 @@ class ServingFleet:
         """Fresh :class:`MetricsAggregator` over every worker registry
         (dead workers included — their final counters are part of the
         fleet story) plus this fleet's own router registry and, when
-        enabled, the shipper's self-observation registry."""
+        enabled, the shipper's self-observation registry. With QoS,
+        per-tenant registries ride along as ``tenant="..."``-labeled
+        sample sets (ISSUE 6)."""
         from .fleet_metrics import MetricsAggregator
         agg = MetricsAggregator()
         for w in self.workers:
@@ -507,6 +634,9 @@ class ServingFleet:
         agg.add("router", self.metrics)
         if self.shipper is not None:
             agg.add("shipper", self.shipper.registry)
+        if self.qos is not None:
+            for tenant, reg in sorted(self.qos.registries().items()):
+                agg.add_labels({"tenant": tenant}, reg)
         return agg
 
     def merged_snapshot(self) -> dict:
@@ -530,7 +660,8 @@ class ServingFleet:
 
     # -- SLO engine (ISSUE 5) ------------------------------------------------
     def enable_slo(self, rules=None, on_alert=None,
-                   load_penalty_boost=4.0):
+                   load_penalty_boost=4.0, shed=False,
+                   shed_target_backlog=None):
         """Attach a :class:`~paddle_tpu.observability.SLOEngine`.
 
         ``rules`` defaults to a serving triple: TTFT p99 < 0.5 s,
@@ -541,8 +672,23 @@ class ServingFleet:
         cached-prefix affinity only wins when it clearly beats the
         imbalance); it is restored when the last alert resolves.
         ``on_alert`` is called after the built-in hook with the same
-        transition dict. Drive evaluation with :meth:`check_slo`."""
+        transition dict. Drive evaluation with :meth:`check_slo`.
+
+        ``shed=True`` (ISSUE 6; requires a fleet constructed with
+        ``qos=``) arms load shedding: while any alert fires, each
+        :meth:`step` sheds pending work above ``shed_target_backlog``
+        (default: total fleet slot capacity) — lowest tier first,
+        never below a tenant's ``shed_floor``."""
         from ..observability import SLOEngine, SLORule
+        if shed and self.qos is None:
+            raise ValueError(
+                "shed=True requires a fleet constructed with qos= "
+                "(the shed planner needs tenant tiers and floors)")
+        self._shed = bool(shed)
+        self._shed_target = (int(shed_target_backlog)
+                             if shed_target_backlog is not None
+                             else sum(w.engine.capacity
+                                      for w in self.workers))
         if rules is None:
             rules = [
                 SLORule("ttft_p99", "engine_ttft_seconds", "p99",
@@ -669,7 +815,7 @@ class ServingFleet:
         return self._http
 
     def stats(self) -> dict:
-        return {
+        s = {
             "policy": self.policy,
             "submitted": int(self._c_submitted.value),
             "affinity_hits": int(self._c_affinity_hits.value),
@@ -679,6 +825,11 @@ class ServingFleet:
             "directory": self.directory.stats(),
             "workers": {w.wid: w.engine.stats() for w in self.workers},
         }
+        if self.qos is not None:
+            s["shed"] = int(self._c_shed.value)
+            s["qos_rejected"] = int(self._c_qos_rejected.value)
+            s["qos"] = self.qos.stats()
+        return s
 
     def close(self):
         for w in self.workers:

@@ -30,40 +30,68 @@ __all__ = ["MetricsAggregator", "MetricsHTTPServer"]
 
 
 class MetricsAggregator:
-    """Ordered ``label -> MetricsRegistry`` view with merged snapshot
-    and per-worker-labeled Prometheus exposition."""
+    """Ordered ``labels -> MetricsRegistry`` view with merged snapshot
+    and labeled Prometheus exposition. :meth:`add` keeps the r9
+    ``worker="..."`` contract (and its byte-identical output);
+    :meth:`add_labels` (ISSUE 6) admits arbitrary label sets — the
+    fleet uses it for per-tenant QoS registries (``tenant="t3"``)
+    living beside the worker samples in one scrape body."""
 
     def __init__(self, registries: dict[str, MetricsRegistry]
                  | None = None):
-        self._regs: dict[str, MetricsRegistry] = {}
+        # key -> (labels dict, registry); worker adds key by bare label
+        self._regs: dict[str, tuple[dict, MetricsRegistry]] = {}
         for label, reg in (registries or {}).items():
             self.add(label, reg)
 
     def add(self, label: str, registry: MetricsRegistry) -> None:
         if label in self._regs:
             raise ValueError(f"duplicate worker label {label!r}")
-        self._regs[label] = registry
+        self._regs[label] = ({"worker": str(label)}, registry)
+
+    def add_labels(self, labels: dict, registry: MetricsRegistry) -> None:
+        """Register a sample set under an arbitrary label dict (e.g.
+        ``{"tenant": "t3"}``). The snapshot key is the canonical
+        ``k=v`` join, so a tenant entry can never collide with a worker
+        label silently."""
+        labels = {str(k): str(v) for k, v in labels.items()}
+        if not labels:
+            raise ValueError("add_labels needs at least one label")
+        key = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+        if key in self._regs:
+            raise ValueError(f"duplicate aggregator entry {key!r}")
+        self._regs[key] = (labels, registry)
 
     def labels(self) -> list[str]:
         return list(self._regs)
 
     def snapshot(self) -> dict:
-        """``{"workers": {label: snap}, "fleet": merged}`` — per-worker
+        """``{"workers": {key: snap}, "fleet": merged}`` — per-entry
         registries verbatim plus the union-equivalent merge (counters
-        summed, histograms bucket-merged with recomputed quantiles)."""
-        per = {label: reg.snapshot() for label, reg in self._regs.items()}
-        return {"workers": per, "fleet": merge_snapshots(per.values())}
+        summed, histograms bucket-merged with recomputed quantiles).
+        Tenant entries appear under their ``tenant=...`` key and are
+        EXCLUDED from the fleet merge: per-tenant counters partition
+        the same events the worker registries already count, and
+        double-merging would double the fleet totals."""
+        per = {key: reg.snapshot()
+               for key, (_, reg) in self._regs.items()}
+        merged = merge_snapshots(
+            snap for key, snap in per.items()
+            if "worker" in self._regs[key][0])
+        return {"workers": per, "fleet": merged}
 
     def prometheus_text(self) -> str:
         """One scrape body over every registry. Metric names are the
-        sorted UNION across workers; a name registered with different
-        metric types on different workers raises (one TYPE header per
-        name is a format invariant, not a style choice)."""
+        sorted UNION across entries; a name registered with different
+        metric types on different entries raises (one TYPE header per
+        name is a format invariant, not a style choice). Label pairs
+        render sorted with ``le`` last, matching
+        ``MetricsRegistry.prometheus_text(labels=)``."""
         fmt = MetricsRegistry._fmt_le
-        owners: dict[str, list[tuple[str, object]]] = {}
-        for label, reg in self._regs.items():
+        owners: dict[str, list[tuple[dict, object]]] = {}
+        for _, (labels, reg) in self._regs.items():
             for name in reg.names():
-                owners.setdefault(name, []).append((label,
+                owners.setdefault(name, []).append((labels,
                                                     reg.get(name)))
         lines = []
         for name in sorted(owners):
@@ -83,19 +111,21 @@ class MetricsAggregator:
                 lines.append(f"# TYPE {name} gauge")
             else:
                 lines.append(f"# TYPE {name} histogram")
-            for label, m in metrics:
-                lbl = escape_label(str(label))
+            for labels, m in metrics:
+                pairs = ",".join(
+                    f'{k}="{escape_label(labels[k])}"'
+                    for k in sorted(labels))
                 if kind is Counter or kind is Gauge:
-                    lines.append(f'{name}{{worker="{lbl}"}} '
+                    lines.append(f'{name}{{{pairs}}} '
                                  f"{format(m.value, 'g')}")
                     continue
                 for le, c in m.cumulative():
                     lines.append(
-                        f'{name}_bucket{{worker="{lbl}",'
+                        f'{name}_bucket{{{pairs},'
                         f'le="{fmt(le)}"}} {c}')
-                lines.append(f'{name}_sum{{worker="{lbl}"}} '
+                lines.append(f'{name}_sum{{{pairs}}} '
                              f"{format(m.sum, 'g')}")
-                lines.append(f'{name}_count{{worker="{lbl}"}} '
+                lines.append(f'{name}_count{{{pairs}}} '
                              f"{m.count}")
         return "\n".join(lines) + "\n"
 

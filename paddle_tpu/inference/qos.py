@@ -1,0 +1,499 @@
+"""Multi-tenant QoS for the serving stack (ISSUE 6 tentpole; reference
+shape: production LLM gateways — per-tenant token buckets at admission,
+start-time fair queueing across tenant sub-queues, SLO-driven load
+shedding with per-tenant service floors).
+
+Three cooperating policies, all host-side and deterministic:
+
+1. **Token-bucket admission** (:class:`TenantPolicy` +
+   :class:`TokenBucket` + :class:`AdmissionGate`). Each tenant has a
+   refill ``rate`` (tokens/second) and ``burst`` capacity; a request
+   costs ``prompt_tokens + max_new_tokens``. Over-rate requests are
+   either queued behind the bucket (``on_limit="queue"``, released in
+   FIFO order as the bucket refills) or rejected with a reason
+   (``on_limit="reject"``). All time flows through an injected clock
+   (default :data:`paddle_tpu.observability.now`), so tests and the
+   overload bench replay identically on a virtual clock.
+
+2. **Weighted fair-share scheduling** (:class:`FairShareScheduler`).
+   Start-time fair queueing over per-tenant sub-queues: each tenant
+   carries a virtual time advanced by ``charged_tokens / weight``, and
+   the scheduler always serves the backlogged tenant with the smallest
+   virtual time. Within a tenant the r7 contract (priority desc, FCFS
+   asc, requeue keeps the original arrival seq) is preserved exactly; a
+   tenant re-entering from idle is caught up to the current virtual
+   frontier so idle time is not bankable. With weights ``w_a : w_b``,
+   served tokens converge to that ratio and no backlogged tenant is
+   ever starved (property-tested).
+
+3. **Shed planning** (:meth:`QoSPolicy.shed_plan`). While an SLO
+   burn-rate alert fires, the fleet sheds pending work above a target
+   backlog — lowest ``tier`` first, newest arrivals first within a
+   tier — but never below a per-tenant ``shed_floor`` of retained
+   (pending + running) requests, so every tenant keeps minimum service.
+   Shed requests fail LOUDLY: :class:`RequestShedError` on the result,
+   ``shed_reason`` on the trace, and a ``qos_shed_total`` counter
+   increment in the tenant's registry — never a silent drop.
+
+The per-tenant :class:`~paddle_tpu.observability.MetricsRegistry`
+objects plug into the fleet's ``MetricsAggregator`` as
+``tenant="..."``-labeled sample sets next to the existing ``worker=``
+labels.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+import threading
+from collections import deque
+from dataclasses import dataclass
+
+from ..observability import MetricsRegistry, now as _now
+
+__all__ = [
+    "DEFAULT_TENANT", "TenantPolicy", "TokenBucket", "AdmissionGate",
+    "QoSPolicy", "FairShareScheduler", "RequestShedError", "tenant_of",
+    "request_cost",
+]
+
+DEFAULT_TENANT = "default"
+
+
+class RequestShedError(RuntimeError):
+    """Raised to the waiter of a request shed under SLO pressure."""
+
+
+def tenant_of(req) -> str:
+    """Tenant key for a request (requests without one share a default
+    bucket/queue, so single-tenant deployments need no configuration)."""
+    t = getattr(req, "tenant", None)
+    return DEFAULT_TENANT if t is None else str(t)
+
+
+def request_cost(req) -> int:
+    """Bucket cost of a request: prompt tokens plus the output budget.
+    Counting max_new (not realized output) keeps admission independent
+    of decode progress — the decision must not depend on the future."""
+    return int(req.ids.size) + int(req.max_new)
+
+
+@dataclass(frozen=True)
+class TenantPolicy:
+    """Per-tenant QoS contract.
+
+    rate/burst bound admission (tokens/second and bucket capacity; both
+    default unlimited), ``weight`` sets the fair-share ratio (0 rejects
+    everything), ``tier`` orders shedding (lowest shed first), and
+    ``shed_floor`` is the minimum pending+running requests the tenant
+    keeps while shedding."""
+
+    tenant: str = DEFAULT_TENANT
+    rate: float = math.inf
+    burst: float = math.inf
+    weight: float = 1.0
+    tier: int = 0
+    on_limit: str = "queue"
+    shed_floor: int = 1
+
+    def __post_init__(self):
+        if self.on_limit not in ("queue", "reject"):
+            raise ValueError(f"on_limit must be 'queue' or 'reject', "
+                             f"got {self.on_limit!r}")
+        if not self.rate > 0:
+            raise ValueError(f"rate must be positive, got {self.rate}")
+        if not self.burst > 0:
+            raise ValueError(f"burst must be positive, got {self.burst}")
+        if self.weight < 0:
+            raise ValueError(f"weight must be >= 0, got {self.weight}")
+        if self.shed_floor < 0:
+            raise ValueError(f"shed_floor must be >= 0, "
+                             f"got {self.shed_floor}")
+
+
+class TokenBucket:
+    """Deterministic token bucket. Starts full; ``refill`` integrates
+    ``rate`` over the injected clock and caps at ``burst``. Never reads
+    wall time on its own — every public method takes ``t`` (or pulls it
+    from the clock injected at construction)."""
+
+    def __init__(self, rate: float, burst: float, clock=None, t=None):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = _now if clock is None else clock
+        self.tokens = self.burst
+        self._t = float(self._clock() if t is None else t)
+
+    def _refill(self, t: float) -> None:
+        if t > self._t:
+            self.tokens = min(self.burst,
+                              self.tokens + (t - self._t) * self.rate)
+            self._t = t
+
+    def available(self, t=None) -> float:
+        self._refill(float(self._clock() if t is None else t))
+        return self.tokens
+
+    def try_take(self, cost: float, t=None) -> bool:
+        self._refill(float(self._clock() if t is None else t))
+        if self.tokens >= cost:
+            self.tokens -= cost
+            return True
+        return False
+
+
+class QoSPolicy:
+    """Shared policy state: tenant contracts, buckets, per-tenant
+    metrics registries, and the shed planner. Admission gates
+    (:meth:`gate`) are created per submit surface (one for a standalone
+    engine, one for the fleet router) but share this object's buckets
+    and counters, so accounting is tenant-global."""
+
+    def __init__(self, policies=(), default: TenantPolicy = None,
+                 clock=None):
+        self._clock = _now if clock is None else clock
+        self.default = default if default is not None else TenantPolicy()
+        self._policies: dict = {}
+        if isinstance(policies, dict):
+            policies = policies.values()
+        for pol in policies:
+            if not isinstance(pol, TenantPolicy):
+                raise TypeError(f"expected TenantPolicy, got {pol!r}")
+            if pol.tenant in self._policies:
+                raise ValueError(f"duplicate policy for tenant "
+                                 f"{pol.tenant!r}")
+            self._policies[pol.tenant] = pol
+        self._tenants: dict = {}          # tenant -> state dict
+        self._gates: list = []            # every AdmissionGate created
+        self._lock = threading.Lock()
+
+    # -- tenant lookup ----------------------------------------------------
+    def policy(self, tenant: str) -> TenantPolicy:
+        return self._policies.get(tenant, self.default)
+
+    def weight(self, tenant: str) -> float:
+        return float(self.policy(tenant).weight)
+
+    def tier(self, tenant: str) -> int:
+        return int(self.policy(tenant).tier)
+
+    def _state(self, tenant: str) -> dict:
+        st = self._tenants.get(tenant)
+        if st is None:
+            with self._lock:
+                st = self._tenants.get(tenant)
+                if st is not None:
+                    return st
+                pol = self.policy(tenant)
+                reg = MetricsRegistry()
+                bucket = TokenBucket(pol.rate, pol.burst,
+                                     clock=self._clock)
+                st = {
+                    "policy": pol,
+                    "bucket": bucket,
+                    "registry": reg,
+                    "admitted": reg.counter(
+                        "qos_admitted_total",
+                        "requests admitted past the token bucket"),
+                    "throttled": reg.counter(
+                        "qos_throttled_total",
+                        "requests queued behind the token bucket"),
+                    "rejected": reg.counter(
+                        "qos_rejected_total",
+                        "requests rejected at admission"),
+                    "shed": reg.counter(
+                        "qos_shed_total",
+                        "requests shed under SLO pressure"),
+                    "served": reg.counter(
+                        "qos_served_tokens_total",
+                        "output tokens delivered to the tenant"),
+                }
+                reg.gauge("qos_bucket_tokens",
+                          "tokens available in the admission bucket",
+                          fn=lambda b=bucket: float(b.available())
+                          if math.isfinite(b.burst) else -1.0)
+                reg.gauge("qos_gate_depth",
+                          "requests held behind the bucket",
+                          fn=lambda t=tenant: float(self.gate_depth(t)))
+                self._tenants[tenant] = st
+        return st
+
+    def bucket(self, tenant: str) -> TokenBucket:
+        return self._state(tenant)["bucket"]
+
+    def registry(self, tenant: str) -> MetricsRegistry:
+        return self._state(tenant)["registry"]
+
+    def registries(self) -> dict:
+        """tenant -> MetricsRegistry for every tenant seen so far."""
+        return {t: st["registry"] for t, st in self._tenants.items()}
+
+    # -- gates ------------------------------------------------------------
+    def gate(self) -> "AdmissionGate":
+        g = AdmissionGate(self)
+        self._gates.append(g)
+        return g
+
+    def gate_depth(self, tenant: str = None) -> int:
+        return sum(g.depth(tenant) for g in self._gates)
+
+    # -- accounting -------------------------------------------------------
+    def note_served(self, tenant: str, tokens: int) -> None:
+        if tokens > 0:
+            self._state(tenant)["served"].inc(int(tokens))
+
+    def note_shed(self, tenant: str) -> None:
+        self._state(tenant)["shed"].inc()
+
+    def stats(self) -> dict:
+        out = {}
+        for t, st in sorted(self._tenants.items()):
+            out[t] = {
+                "admitted": st["admitted"].value,
+                "throttled": st["throttled"].value,
+                "rejected": st["rejected"].value,
+                "shed": st["shed"].value,
+                "served_tokens": st["served"].value,
+                "gate_depth": self.gate_depth(t),
+            }
+        return out
+
+    # -- shed planning ----------------------------------------------------
+    def shed_plan(self, pending, running_counts=None, target=0) -> list:
+        """Pick victims among ``pending`` so that at most ``target``
+        pending requests remain. Order: lowest tier first, newest
+        arrival (highest ``_sched_seq``) first within a tier — oldest
+        work is closest to its deadline and has consumed the most
+        queueing already, so new arrivals absorb the pressure. A tenant
+        is never cut below ``shed_floor`` retained requests, counting
+        both its surviving pending and its currently-running rows
+        (``running_counts``: tenant -> live row count)."""
+        pending = list(pending)
+        excess = len(pending) - max(int(target), 0)
+        if excess <= 0:
+            return []
+        remaining: dict = dict()
+        for r in pending:
+            t = tenant_of(r)
+            remaining[t] = remaining.get(t, 0) + 1
+        for t, n in (running_counts or {}).items():
+            remaining[t] = remaining.get(t, 0) + int(n)
+
+        def _key(r):
+            seq = getattr(r, "_sched_seq", None)
+            return (self.tier(tenant_of(r)),
+                    -(seq if seq is not None else -1))
+
+        victims = []
+        for r in sorted(pending, key=_key):
+            if len(victims) >= excess:
+                break
+            t = tenant_of(r)
+            if remaining[t] - 1 < self.policy(t).shed_floor:
+                continue
+            victims.append(r)
+            remaining[t] -= 1
+        return victims
+
+
+class AdmissionGate:
+    """Token-bucket admission check for one submit surface. Holds
+    throttled requests in per-tenant FIFO queues until the shared
+    bucket can fund them; a new request never jumps a throttled
+    sibling of the same tenant."""
+
+    def __init__(self, qos: QoSPolicy):
+        self._qos = qos
+        self._held: dict = {}             # tenant -> deque of requests
+
+    def decide(self, req, t=None):
+        """(verdict, reason): ``("admit", None)``, ``("throttle",
+        None)`` — the request is now held here — or ``("reject",
+        reason)`` with reason ``"zero_weight"`` or ``"rate_limited"``."""
+        tenant = tenant_of(req)
+        st = self._qos._state(tenant)
+        pol = st["policy"]
+        if pol.weight <= 0:
+            st["rejected"].inc()
+            return "reject", "zero_weight"
+        q = self._held.get(tenant)
+        behind = bool(q)                   # FIFO: never jump the queue
+        if not behind and st["bucket"].try_take(request_cost(req), t):
+            st["admitted"].inc()
+            return "admit", None
+        if pol.on_limit == "reject":
+            st["rejected"].inc()
+            return "reject", "rate_limited"
+        if q is None:
+            q = self._held[tenant] = deque()
+        q.append(req)
+        st["throttled"].inc()
+        return "throttle", None
+
+    def release(self, t=None) -> list:
+        """Requests whose bucket can now fund them, FIFO per tenant,
+        ordered across tenants by arrival (``_sched_seq``)."""
+        out = []
+        for tenant in sorted(self._held):
+            q = self._held[tenant]
+            st = self._qos._state(tenant)
+            while q and st["bucket"].try_take(request_cost(q[0]), t):
+                out.append(q.popleft())
+                st["admitted"].inc()
+        out.sort(key=lambda r: (getattr(r, "_sched_seq", None) is None,
+                                getattr(r, "_sched_seq", 0) or 0))
+        return out
+
+    def held(self) -> list:
+        return [r for q in self._held.values() for r in q]
+
+    def depth(self, tenant: str = None) -> int:
+        if tenant is not None:
+            return len(self._held.get(tenant, ()))
+        return sum(len(q) for q in self._held.values())
+
+    def remove(self, victims) -> int:
+        """Drop shed victims still waiting behind the bucket."""
+        vids = {id(v) for v in victims}
+        dropped = 0
+        for tenant, q in list(self._held.items()):
+            kept = deque(r for r in q if id(r) not in vids)
+            dropped += len(q) - len(kept)
+            if kept:
+                self._held[tenant] = kept
+            else:
+                del self._held[tenant]
+        return dropped
+
+
+class FairShareScheduler:
+    """Start-time fair queueing over per-tenant sub-queues, API- and
+    contract-compatible with :class:`RequestScheduler` (add marks the
+    trace ``queued``; add stamps ``_sched_seq`` once; peek/pop/drain;
+    head-of-line blocking within a tenant is preserved).
+
+    Selection: the backlogged tenant with the smallest virtual time
+    (ties broken by tenant name) serves its (priority desc, FCFS asc)
+    head. :meth:`charge` advances a tenant's virtual time by
+    ``tokens / weight`` — the engine charges admission (uncached suffix
+    prefill), per-chunk decode tokens, and preemption work (the
+    PREEMPTING tenant pays for the tokens it evicts). A tenant whose
+    queue was empty re-enters at the current frontier
+    (``max(own vtime, vtime of the last served tenant)``), so idle
+    periods cannot be hoarded into a later monopoly."""
+
+    def __init__(self, qos: QoSPolicy):
+        self._qos = qos
+        self._queues: dict = {}           # tenant -> heap of entries
+        self._vtime: dict = {}            # tenant -> virtual time
+        self._vnow = 0.0                  # frontier: vtime last served
+        self._arrivals = 0
+        self._last_pick = None            # (tenant, entry) cache
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def __bool__(self) -> bool:
+        return any(self._queues.values())
+
+    def add(self, req) -> None:
+        if getattr(req, "_sched_seq", None) is None:
+            req._sched_seq = self._arrivals
+            self._arrivals += 1
+        prio = int(getattr(req, "priority", 0) or 0)
+        trace = getattr(req, "trace", None)
+        if trace is not None:
+            trace.mark("queued")
+        tenant = tenant_of(req)
+        q = self._queues.setdefault(tenant, [])
+        if not q:
+            # SFQ catch-up: re-enter at the frontier, don't bank idle time
+            self._vtime[tenant] = max(self._vtime.get(tenant, 0.0),
+                                      self._vnow)
+        heapq.heappush(q, (-prio, req._sched_seq, req))
+        # NOTE: the peek cache survives add() on purpose — the engine
+        # re-adds preempted victims between peek and pop, and pop must
+        # still remove exactly the peeked (claimant) request.
+
+    def _pick_tenant(self):
+        best = None
+        best_key = None
+        for tenant, q in self._queues.items():
+            if not q:
+                continue
+            key = (self._vtime.get(tenant, 0.0), tenant)
+            if best is None or key < best_key:
+                best, best_key = tenant, key
+        return best
+
+    def peek(self):
+        """Fair pick's head request (None when empty). The selection is
+        cached so an immediately following :meth:`pop` removes exactly
+        the peeked request even if :meth:`charge`/:meth:`add` ran in
+        between (the engine charges preemption work between peek and
+        pop)."""
+        tenant = self._pick_tenant()
+        if tenant is None:
+            self._last_pick = None
+            return None
+        entry = self._queues[tenant][0]
+        self._last_pick = (tenant, entry)
+        return entry[2]
+
+    def pop(self):
+        if self._last_pick is not None:
+            tenant, entry = self._last_pick
+            self._last_pick = None
+            q = self._queues.get(tenant)
+            if q:
+                idx = next((i for i, e in enumerate(q) if e is entry),
+                           None)
+                if idx is not None:
+                    if idx == 0:
+                        heapq.heappop(q)
+                    else:
+                        q[idx] = q[-1]
+                        q.pop()
+                        heapq.heapify(q)
+                    self._vnow = max(self._vnow,
+                                     self._vtime.get(tenant, 0.0))
+                    return entry[2]
+        tenant = self._pick_tenant()
+        if tenant is None:
+            raise IndexError("pop from an empty FairShareScheduler")
+        self._vnow = max(self._vnow, self._vtime.get(tenant, 0.0))
+        return heapq.heappop(self._queues[tenant])[2]
+
+    def drain(self) -> list:
+        out = []
+        while self:
+            out.append(self.pop())
+        return out
+
+    def charge(self, tenant: str, tokens) -> None:
+        if tokens <= 0:
+            return
+        w = max(self._qos.weight(tenant), 1e-9)
+        self._vtime[tenant] = (self._vtime.get(tenant, 0.0)
+                               + float(tokens) / w)
+
+    def requests(self) -> list:
+        """Every pending request, deterministic (tenant, heap) order —
+        non-destructive, for shed planning."""
+        out = []
+        for tenant in sorted(self._queues):
+            out.extend(e[2] for e in sorted(self._queues[tenant]))
+        return out
+
+    def remove(self, victims) -> int:
+        """Drop shed victims from the sub-queues (heap rebuild)."""
+        vids = {id(v) for v in victims}
+        dropped = 0
+        for tenant, q in list(self._queues.items()):
+            kept = [e for e in q if id(e[2]) not in vids]
+            dropped += len(q) - len(kept)
+            if len(kept) != len(q):
+                heapq.heapify(kept)
+                self._queues[tenant] = kept
+        self._last_pick = None
+        return dropped

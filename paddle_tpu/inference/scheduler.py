@@ -70,3 +70,19 @@ class RequestScheduler:
         while self._heap:
             out.append(heapq.heappop(self._heap)[2])
         return out
+
+    def requests(self) -> list:
+        """Every pending request in queue order — non-destructive, for
+        QoS shed planning (ISSUE 6)."""
+        return [e[2] for e in sorted(self._heap)]
+
+    def remove(self, victims) -> int:
+        """Drop shed victims from the queue (heap rebuild). The caller
+        owns failing them loudly — the scheduler only forgets them."""
+        vids = {id(v) for v in victims}
+        kept = [e for e in self._heap if id(e[2]) not in vids]
+        dropped = len(self._heap) - len(kept)
+        if dropped:
+            heapq.heapify(kept)
+            self._heap = kept
+        return dropped

@@ -73,7 +73,7 @@ class DecodeEngine:
     def __init__(self, model, capacity=4, s_max=256, chunk=8, pad_id=0,
                  paged=True, block_size=16, n_blocks=None,
                  prefix_cache=True, registry=None, worker_id=None,
-                 prefix_listener=None):
+                 prefix_listener=None, qos=None):
         from ..distributed.fleet.mp_layers import current_mesh
         from ..models.llama import _pp_degree
         if _pp_degree(current_mesh()) > 1:
@@ -94,6 +94,14 @@ class DecodeEngine:
         # distinguishable; None for a standalone engine.
         self.worker_id = worker_id
         self._prefix_listener = prefix_listener
+        # ISSUE 6: multi-tenant QoS. A QoSPolicy swaps the pending queue
+        # for a FairShareScheduler and arms a token-bucket gate on
+        # submit(); qos=None keeps the r7 scheduler and bit-identical
+        # behavior.
+        if qos is not None and not self.paged:
+            raise ValueError("qos requires the paged engine")
+        self.qos = qos
+        self._qos_gate = qos.gate() if qos is not None else None
         self._sched = None
         if self.paged:
             from .scheduler import RequestScheduler
@@ -107,7 +115,11 @@ class DecodeEngine:
                 n_blocks = self.capacity * -(-self.s_max
                                              // self.block_size) + 1
             self.n_blocks = int(n_blocks)
-            self._sched = RequestScheduler()
+            if qos is not None:
+                from .qos import FairShareScheduler
+                self._sched = FairShareScheduler(qos)
+            else:
+                self._sched = RequestScheduler()
         self.device_steps = 0           # decode steps actually executed
         self.prefills = 0
         self.resets = 0                 # cache resets (init counts as 1)
@@ -470,6 +482,12 @@ class DecodeEngine:
         import jax.numpy as jnp
         import numpy as _np
         if self.paged:
+            if self._qos_gate is not None:
+                # requests whose token bucket refilled since they were
+                # throttled at submit() enter the queue ahead of this
+                # call's batch (they arrived first)
+                for req in self._qos_gate.release():
+                    self._sched.add(req)
             while pending:
                 self._sched.add(pending.pop(0))
             return self._admit_scheduled()
@@ -543,6 +561,47 @@ class DecodeEngine:
                req=tr.request_id if tr is not None else None,
                error=type(err).__name__, detail=str(err))
 
+    def _qos_charge(self, req, tokens):
+        """Advance the request's tenant's fair-share virtual time
+        (ISSUE 6). No-op without QoS — the plain scheduler has no
+        ``charge`` and ``qos`` is None."""
+        if self.qos is None or tokens <= 0:
+            return
+        from .qos import tenant_of
+        self._sched.charge(tenant_of(req), tokens)
+
+    def submit(self, input_ids, max_new_tokens=32, priority=0,
+               tenant=None):
+        """Validated single-request entry point (ISSUE 6): builds the
+        ``_Request`` (raising ``ValueError`` on an empty prompt or a
+        non-positive token budget), runs tenant admission when a
+        ``qos=`` policy was configured, and enqueues into the paged
+        scheduler. Returns the request handle; a rejected request has
+        ``error`` set and its ``wait()`` raises immediately. Throttled
+        requests sit behind their token bucket and enter the queue on a
+        later :meth:`admit` once the bucket refills."""
+        if not self.paged:
+            raise RuntimeError(
+                "submit() requires the paged engine; pass request "
+                "lists to admit() in contiguous mode")
+        req = _Request(input_ids, max_new_tokens, priority=priority,
+                       tenant=tenant)
+        if self._qos_gate is not None:
+            verdict, reason = self._qos_gate.decide(req)
+            if verdict == "reject":
+                tr = getattr(req, "trace", None)
+                if tr is not None:
+                    tr.set_attr("reject_reason", reason)
+                self._fail_request(req, PermissionError(
+                    f"QoS rejected ({reason}) for tenant "
+                    f"{tenant!r}"))
+                return req
+            if verdict == "throttle":
+                _tmark(req, "queued")   # gate wait counts as queue wait
+                return req
+        self._sched.add(req)
+        return req
+
     def _pick_victim(self, prio, exclude=None):
         """Slot of the running row to preempt for a priority-``prio``
         claimant: STRICTLY lower priority only (equal priorities wait
@@ -609,12 +668,16 @@ class DecodeEngine:
                slot=slot, resident_tokens=valid,
                emitted=len(req._resume_toks))
 
-    def _reclaim_allocate(self, need, prio, exclude=None):
+    def _reclaim_allocate(self, need, prio, exclude=None,
+                          claimant=None):
         """allocate() with reclamation: evict unreferenced cached pages
         first, then preempt strictly-lower-priority rows (each
         preemption parks its pages in the cache, so the follow-up evict
         actually frees them). None when the pool still can't cover
-        ``need``."""
+        ``need``. ``claimant`` is the request driving the reclamation —
+        under fair-share QoS the PREEMPTING tenant is charged the
+        victim's resident tokens, so a tenant cannot launder work
+        through evictions (ISSUE 6)."""
         pages = self._alloc.allocate(need)
         if pages is not None:
             return pages
@@ -627,7 +690,10 @@ class DecodeEngine:
             victim = self._pick_victim(prio, exclude=exclude)
             if victim is None:
                 return None
+            evicted_tokens = int(self._lens[victim])
             self._preempt_row(victim)
+            if claimant is not None:
+                self._qos_charge(claimant, evicted_tokens)
             if self._cache is not None:
                 self._evict_cached(need - self._alloc.num_free)
             pages = self._alloc.allocate(need)
@@ -672,7 +738,8 @@ class DecodeEngine:
                 if self._cache is not None else None
             f = len(m.pages) if m is not None else 0
             pages = self._reclaim_allocate(total_need - f,
-                                           self._prio(req))
+                                           self._prio(req),
+                                           claimant=req)
             if pages is None and m is not None and m.cached_len:
                 # the match's own references pin otherwise-evictable
                 # pages: retry COLD so the infeasibility test below is
@@ -680,7 +747,8 @@ class DecodeEngine:
                 self._cache.release(m)
                 m, f = None, 0
                 pages = self._reclaim_allocate(total_need,
-                                               self._prio(req))
+                                               self._prio(req),
+                                               claimant=req)
             if pages is None:
                 if m is not None:
                     self._cache.release(m)
@@ -714,6 +782,10 @@ class DecodeEngine:
             self._c_prefills.inc()
             self._c_admitted.inc()
             self._c_prefix_hit.inc(hit_tokens)
+            # fair-share: admission costs the tenant only the UNCACHED
+            # suffix it actually prefilled (prefix hits are free, same
+            # as the page-charging rule)
+            self._qos_charge(req, ns - hit_tokens)
             self._observe_first_token(req)
             tr = getattr(req, "trace", None)
             log_kv(_log, "admitted", level=logging.DEBUG,
@@ -906,7 +978,8 @@ class DecodeEngine:
             if extra <= 0:
                 continue
             pages = self._reclaim_allocate(extra, self._prio(row["req"]),
-                                           exclude=slot)
+                                           exclude=slot,
+                                           claimant=row["req"])
             if pages is None:
                 others = any(r is not None and i != slot
                              for i, r in enumerate(self._rows))
@@ -950,16 +1023,25 @@ class DecodeEngine:
         for slot, row in enumerate(self._rows):
             if row is None:
                 continue
+            emitted_before = len(row["toks"])
             row["toks"].extend(int(t) for t in toks[:, slot])
             self._tok[slot] = int(toks[-1, slot])
             req = row["req"]
             _tmark(req, "decode_chunk", worker=self.worker_id)
+            # fair-share: the tenant pays for the USEFUL tokens this
+            # chunk produced (overshoot past max_new is engine padding,
+            # not tenant work)
+            self._qos_charge(
+                req, min(self.chunk, req.max_new - emitted_before))
             if len(row["toks"]) >= req.max_new:
                 req.result = _np.concatenate(
                     [row["prompt"],
                      _np.asarray(row["toks"][:req.max_new], _np.int32)])
                 self._retire_paged(slot)  # pages free for next admit
                 req.event.set()
+                if self.qos is not None:
+                    from .qos import tenant_of
+                    self.qos.note_served(tenant_of(req), req.max_new)
             else:
                 self._lens[slot] += self.chunk
                 alive += 1
@@ -1044,12 +1126,20 @@ class GenerationPredictor:
 
 
 class _Request:
-    def __init__(self, ids, max_new, priority=0):
+    def __init__(self, ids, max_new, priority=0, tenant=None):
         self.ids = np.asarray(ids)
-        self.max_new = max_new
+        # validate at submit, not deep in prefill: an empty prompt has
+        # nothing to prefill and a non-positive budget never emits
+        if self.ids.size == 0:
+            raise ValueError("input_ids is empty — nothing to prefill")
+        if max_new is None or int(max_new) <= 0:
+            raise ValueError(
+                f"max_new_tokens must be positive, got {max_new!r}")
+        self.max_new = int(max_new)
         self.priority = int(priority)   # higher = sooner; can preempt
         #                                 strictly-lower running rows
-        self.trace = RequestTrace()     # lifecycle trace from arrival;
+        self.tenant = tenant            # QoS tenant key (None = default)
+        self.trace = RequestTrace(tenant=tenant)  # lifecycle trace;
         #                                 TTFT/queue-wait derive from it
         self.event = threading.Event()
         self.result = None
@@ -1128,17 +1218,22 @@ class BatchingServer:
             else self._loop, daemon=True)
         self._worker.start()
 
-    def submit(self, input_ids, max_new_tokens=None,
-               priority=0) -> _Request:
+    def submit(self, input_ids, max_new_tokens=None, priority=0,
+               tenant=None) -> _Request:
         """``priority`` (continuous mode): higher-priority requests
         admit first and may preempt strictly-lower running rows when
-        the KV pool runs dry."""
+        the KV pool runs dry. ``tenant`` tags the request (and its
+        trace) for multi-tenant QoS accounting. Raises ``ValueError``
+        on an empty prompt or non-positive ``max_new_tokens`` — an
+        explicit 0 is an error, not a fall-through to the default."""
         if self._closed:
             raise RuntimeError(
                 "submit() on a closed BatchingServer: the worker is "
                 "gone, the request would never be served")
-        req = _Request(input_ids, max_new_tokens or self.max_new_tokens,
-                       priority=priority)
+        if max_new_tokens is None:
+            max_new_tokens = self.max_new_tokens
+        req = _Request(input_ids, max_new_tokens, priority=priority,
+                       tenant=tenant)
         self._c_submitted.inc()
         self._q.put(req)
         return req

@@ -1,0 +1,194 @@
+"""Seeded synthetic traffic for overload benchmarking (ISSUE 6;
+reference shape: serving-bench traffic models — Poisson/MMPP arrival
+processes, bounded-Pareto prompt lengths, Zipf-ish tenant skew).
+
+Everything is VIRTUAL time driven by one ``numpy`` Generator: the same
+seed replays the same arrival list bit-for-bit, so the overload bench
+and its CPU smoke are deterministic. No wall clocks anywhere — arrival
+times are plain floats the driver compares against its own virtual
+clock.
+
+Arrival processes:
+
+- ``"poisson"``: exponential inter-arrival gaps at ``rate``.
+- ``"bursty"``: Markov-modulated Poisson — alternating ON/OFF phases
+  with exponential dwell times; ON runs at ``rate * burst_factor``,
+  OFF at a trickle. Models the bursty customer the QoS layer exists
+  to contain.
+- ``"diurnal"``: sinusoidal intensity ``rate * (1 + sin)`` thinned
+  against its peak — a compressed day/night cycle.
+- ``"constant"``: fixed ``1/rate`` gaps (useful as a control).
+
+Prompt lengths: ``"heavy_tail"`` draws a bounded Pareto (shape
+``tail_alpha``) clipped to ``[prompt_min, prompt_max]`` — most prompts
+short, a fat tail of long ones; ``"uniform"`` is the control.
+
+Tenant skew: each arrival is assigned a tenant by normalized
+``TenantProfile.share`` weights (e.g. 10:1 reproduces the ISSUE's
+skewed flood).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["TenantProfile", "SyntheticRequest", "TrafficGenerator",
+           "jain_index"]
+
+
+@dataclass(frozen=True)
+class TenantProfile:
+    """One tenant's slice of the synthetic load."""
+    tenant: str
+    share: float = 1.0
+    priority: int = 0
+
+    def __post_init__(self):
+        if not self.share > 0:
+            raise ValueError(f"share must be positive, got {self.share}")
+
+
+@dataclass(frozen=True)
+class SyntheticRequest:
+    """One synthetic arrival (times are virtual seconds from 0)."""
+    t: float
+    tenant: str
+    prompt_len: int
+    max_new: int
+    priority: int = 0
+
+
+def jain_index(values) -> float:
+    """Jain's fairness index: ``(sum v)^2 / (n * sum v^2)`` — 1.0 when
+    every tenant gets an equal (weighted) share, ``1/n`` when one
+    tenant takes everything. Pass weight-normalized service values to
+    measure fairness *relative to the configured weights*."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return 1.0
+    s2 = sum(v * v for v in vals)
+    if s2 == 0.0:
+        return 1.0
+    s = sum(vals)
+    return (s * s) / (len(vals) * s2)
+
+
+class TrafficGenerator:
+    """Deterministic arrival-stream generator.
+
+    One ``np.random.default_rng(seed)`` drives everything — arrival
+    gaps, phase dwell times, tenant assignment, prompt lengths, and
+    prompt token ids — so :meth:`arrivals` is a pure function of the
+    constructor arguments."""
+
+    def __init__(self, tenants, rate=10.0, seed=0, process="bursty",
+                 prompt_dist="heavy_tail", prompt_min=4, prompt_max=64,
+                 max_new=8, tail_alpha=1.3, burst_factor=8.0,
+                 off_factor=0.1, on_dwell_s=2.0, off_dwell_s=4.0,
+                 diurnal_period_s=60.0):
+        tenants = list(tenants)
+        if not tenants:
+            raise ValueError("need at least one TenantProfile")
+        if process not in ("poisson", "bursty", "diurnal", "constant"):
+            raise ValueError(f"unknown arrival process {process!r}")
+        if prompt_dist not in ("heavy_tail", "uniform"):
+            raise ValueError(f"unknown prompt_dist {prompt_dist!r}")
+        if not rate > 0:
+            raise ValueError(f"rate must be positive, got {rate}")
+        if not (0 < prompt_min <= prompt_max):
+            raise ValueError("need 0 < prompt_min <= prompt_max")
+        self.tenants = tenants
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self.process = process
+        self.prompt_dist = prompt_dist
+        self.prompt_min = int(prompt_min)
+        self.prompt_max = int(prompt_max)
+        self.max_new = int(max_new)
+        self.tail_alpha = float(tail_alpha)
+        self.burst_factor = float(burst_factor)
+        self.off_factor = float(off_factor)
+        self.on_dwell_s = float(on_dwell_s)
+        self.off_dwell_s = float(off_dwell_s)
+        self.diurnal_period_s = float(diurnal_period_s)
+        shares = np.asarray([p.share for p in tenants], dtype=float)
+        self._p_tenant = shares / shares.sum()
+
+    # -- arrival times ----------------------------------------------------
+    def _times(self, rng, horizon_s: float) -> list:
+        out = []
+        if self.process == "constant":
+            gap = 1.0 / self.rate
+            t = gap
+            while t < horizon_s:
+                out.append(t)
+                t += gap
+        elif self.process == "poisson":
+            t = 0.0
+            while True:
+                t += rng.exponential(1.0 / self.rate)
+                if t >= horizon_s:
+                    break
+                out.append(t)
+        elif self.process == "bursty":
+            t, phase_end, on = 0.0, 0.0, False
+            while t < horizon_s:
+                if t >= phase_end:
+                    on = not on
+                    dwell = (self.on_dwell_s if on else self.off_dwell_s)
+                    phase_end = t + rng.exponential(dwell)
+                lam = self.rate * (self.burst_factor if on
+                                   else self.off_factor)
+                t += rng.exponential(1.0 / lam)
+                if t < horizon_s:
+                    out.append(t)
+        else:                                     # diurnal, via thinning
+            lam_max = 2.0 * self.rate
+            t = 0.0
+            while True:
+                t += rng.exponential(1.0 / lam_max)
+                if t >= horizon_s:
+                    break
+                lam_t = self.rate * (
+                    1.0 + math.sin(2.0 * math.pi * t
+                                   / self.diurnal_period_s))
+                if rng.random() * lam_max < lam_t:
+                    out.append(t)
+        return out
+
+    # -- prompt lengths ---------------------------------------------------
+    def _length(self, rng) -> int:
+        if self.prompt_dist == "uniform":
+            return int(rng.integers(self.prompt_min,
+                                    self.prompt_max + 1))
+        raw = self.prompt_min * (1.0 + rng.pareto(self.tail_alpha))
+        return int(min(max(raw, self.prompt_min), self.prompt_max))
+
+    # -- public API -------------------------------------------------------
+    def arrivals(self, horizon_s: float) -> list:
+        """The full arrival list for ``[0, horizon_s)``, time-sorted."""
+        rng = np.random.default_rng(self.seed)
+        times = self._times(rng, float(horizon_s))
+        idx = rng.choice(len(self.tenants), size=len(times),
+                         p=self._p_tenant)
+        out = []
+        for t, i in zip(times, idx):
+            prof = self.tenants[int(i)]
+            out.append(SyntheticRequest(
+                t=float(t), tenant=prof.tenant,
+                prompt_len=self._length(rng), max_new=self.max_new,
+                priority=prof.priority))
+        return out
+
+    def prompt_ids(self, req: SyntheticRequest, vocab_size: int,
+                   index: int = 0) -> np.ndarray:
+        """Deterministic token ids for one arrival. Seeded by
+        ``(seed, index)`` so each request's prompt is reproducible in
+        isolation; tokens stay below ``vocab_size`` and above 1 (0 is
+        the pad id)."""
+        rng = np.random.default_rng((self.seed + 1) * 100_003 + index)
+        hi = max(int(vocab_size) - 1, 2)
+        return rng.integers(1, hi, size=req.prompt_len).astype("int32")

@@ -51,14 +51,18 @@ class RequestTrace:
     consumers iterate it); per-event worker attribution lives in a
     parallel sparse map keyed by event index."""
 
-    __slots__ = ("request_id", "trace_id", "events", "attrs", "hops",
-                 "_event_workers")
+    __slots__ = ("request_id", "trace_id", "tenant", "events", "attrs",
+                 "hops", "_event_workers")
 
-    def __init__(self, request_id=None, t=None, trace_id=None):
+    def __init__(self, request_id=None, t=None, trace_id=None,
+                 tenant=None):
         nid = _next_id()
         self.request_id = nid if request_id is None else request_id
         self.trace_id = (f"{os.getpid():x}-{nid:08x}"
                          if trace_id is None else trace_id)
+        # QoS tenant (ISSUE 6) — stamped at submit, None outside
+        # multi-tenant deployments
+        self.tenant = tenant
         self.events: list[tuple[str, float]] = [
             ("arrival", now() if t is None else t)]
         self.attrs: dict = {}
@@ -220,7 +224,8 @@ class RequestTrace:
     def summary(self) -> dict:
         """JSON-able digest (stall-watchdog dumps, debug logging,
         shipper export). r8 keys are unchanged; ISSUE 5 appends
-        ``trace_id``/``worker_id``/``hops``/``attrs``."""
+        ``trace_id``/``worker_id``/``hops``/``attrs``; ISSUE 6 appends
+        ``tenant`` after those."""
         term = self.terminal
         return {
             "request_id": self.request_id,
@@ -235,6 +240,7 @@ class RequestTrace:
             "worker_id": self.attrs.get("worker_id"),
             "hops": [dict(h) for h in self.hops],
             "attrs": dict(self.attrs),
+            "tenant": self.tenant,
         }
 
     # -- Chrome trace export ------------------------------------------------
@@ -278,6 +284,9 @@ class RequestTrace:
             pid_for = lambda w: 0           # noqa: E731
         row = self.request_id if tid is None else tid
         rid = f"req{self.request_id}"
+        # tenant rides after the unchanged r10 args keys (ISSUE 6);
+        # single-tenant exports stay byte-identical
+        targs = {} if self.tenant is None else {"tenant": self.tenant}
         out = []
         cur_pid = pid_for(None)
         for i, (state, t) in enumerate(self.events):
@@ -287,20 +296,20 @@ class RequestTrace:
             out.append({"name": f"{rid}.{state}", "ph": "i", "s": "t",
                         "ts": t * 1e6, "pid": cur_pid, "tid": row,
                         "cat": "request",
-                        "args": {"trace_id": self.trace_id}})
+                        "args": {"trace_id": self.trace_id} | targs})
         for w, t0, t1 in self._segments():
             out.append({"name": f"{rid}@{w}", "ph": "X",
                         "ts": t0 * 1e6, "dur": max(t1 - t0, 0.0) * 1e6,
                         "pid": pid_for(w), "tid": row, "cat": "request",
                         "args": {"trace_id": self.trace_id,
-                                 "worker": w}})
+                                 "worker": w} | targs})
         for hop in self.hops:
             out.append({"name": f"{rid}.hop", "ph": "i", "s": "p",
                         "ts": hop["t"] * 1e6, "pid": pid_for(hop["to"]),
                         "tid": row, "cat": "request",
                         "args": {k: v for k, v in hop.items()
                                  if k != "t"} | {
-                                     "trace_id": self.trace_id}})
+                                     "trace_id": self.trace_id} | targs})
         return out
 
     def __repr__(self):

@@ -52,6 +52,10 @@ def test_infra_skip_metric_follows_preset(monkeypatch, capsys):
     bench._emit_infra_skip("tunnel down")
     out = json.loads(capsys.readouterr().out.strip())
     assert out["metric"] == "slo_shipper_overhead_pct"
+    monkeypatch.setenv("BENCH_PRESET", "overload")
+    bench._emit_infra_skip("tunnel down")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "overload_p99_ttft_ms"
 
 
 @pytest.mark.slow
@@ -157,6 +161,58 @@ def test_slo_preset_cpu_smoke(tmp_path):
     assert "shipper" in snap["workers"]
     assert snap["workers"]["shipper"]["counters"][
         "shipper_shipped_total"] > 0
+
+
+@pytest.mark.slow
+def test_overload_preset_cpu_smoke(tmp_path):
+    """End-to-end CPU run of BENCH_PRESET=overload (ISSUE 6 satellite):
+    one JSON line; the QoS accounting (admitted/throttled/shed/served
+    on the virtual clock) replays bit-identically across the two QoS-on
+    sims (extra.qos.deterministic); every shed request is accounted
+    (tally shed == qos_shed_total sum == shed_rate * submitted); and
+    Jain's fairness index is recorded for both configs with the
+    aggregated snapshot dumped."""
+    env = dict(os.environ, BENCH_PRESET="overload",
+               BENCH_ALLOW_CPU="1", BENCH_NO_WALL="1",
+               BENCH_SKIP_PROBE="1", BENCH_METRICS_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, bench.__file__], env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1                         # one-JSON-line contract
+    out = json.loads(lines[0])
+    assert out["metric"] == "overload_p99_ttft_ms"
+    assert out["value"] > 0
+    extra = out["extra"]
+    # the virtual-clock policy replay must be bit-deterministic
+    assert extra["qos"]["deterministic"] is True
+    for key in ("jain_fairness_on", "jain_fairness_off"):
+        assert 0.0 < extra[key] <= 1.0
+    assert out["vs_baseline"] == pytest.approx(
+        extra["jain_fairness_on"] / extra["jain_fairness_off"],
+        rel=1e-3)
+    # shed accounting: tally == per-tenant counters == shed_rate
+    shed_tally = sum(t["shed"] for t in extra["tally_on"].values())
+    shed_counters = sum(int(t["shed"]) for t in
+                        extra["qos"]["per_tenant"].values())
+    assert shed_tally == shed_counters == extra["qos"]["shed_total"]
+    assert extra["shed_rate"] == pytest.approx(
+        extra["qos"]["shed_total"] / extra["submitted"], abs=1e-3)
+    # the flood engaged all three policies under the fixed seed
+    assert extra["qos"]["shed_total"] > 0
+    assert sum(int(t["throttled"]) for t in
+               extra["qos"]["per_tenant"].values()) > 0
+    snap_path = extra["metrics_snapshot"]
+    assert snap_path == str(tmp_path / "bench_metrics_overload.json")
+    snap = json.load(open(snap_path))
+    assert "tenant=t_heavy" in snap["workers"]
+    assert "tenant=t_light" in snap["workers"]
+    assert snap["workers"]["tenant=t_light"]["counters"][
+        "qos_shed_total"] == 0
+    assert snap["fleet"]["histograms"]["engine_ttft_seconds"][
+        "count"] > 0
 
 
 def test_env_flag_tolerant(monkeypatch):

@@ -59,12 +59,15 @@ def test_observability_and_watchdog_use_shared_clock():
 
 
 def test_lint_covers_fleet_modules():
-    """ISSUE 4 grew the package by fleet.py/fleet_metrics.py; the glob
-    above must actually be scanning them (a rename or package move
-    would silently shrink the lint's coverage)."""
+    """ISSUE 4 grew the package by fleet.py/fleet_metrics.py and
+    ISSUE 6 by qos.py/traffic.py; the glob above must actually be
+    scanning them (a rename or package move would silently shrink the
+    lint's coverage). QoS/traffic in particular must never grow a wall
+    clock — their determinism contract is injected clocks only."""
     scanned = {py.name for py in INFERENCE.glob("*.py")}
     for required in ("serving.py", "fleet.py", "fleet_metrics.py",
-                     "prefix_cache.py", "scheduler.py"):
+                     "prefix_cache.py", "scheduler.py", "qos.py",
+                     "traffic.py"):
         assert required in scanned, (
             f"{required} missing from the timer-lint scan set "
             f"{sorted(scanned)}")
