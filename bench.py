@@ -46,6 +46,7 @@ _PRESET_METRICS = {
     "fleet": "fleet_affinity_ttft_ms",
     "slo": "slo_shipper_overhead_pct",
     "overload": "overload_p99_ttft_ms",
+    "mixed": "mixed_p99_ttft_ms",
     "smoke": "smoke_wall_seconds",
 }
 
@@ -964,6 +965,108 @@ def bench_overload():
     }))
 
 
+def bench_mixed():
+    """Chunked-prefill mixed flood (ISSUE 7): a seeded long/short-prompt
+    flood (bounded-Pareto prompt lengths from :class:`TrafficGenerator`,
+    tick-injected as virtual arrivals) drives ONE engine config twice —
+    admission (monolithic) prefill vs chunked prefill under a per-step
+    token budget. Both runs see identical prompts and greedy decode, so
+    the outputs-identical oracle rides in ``extra``. The metric is
+    chunked p99 TTFT (ms); vs_baseline is admission_p99 / chunked_p99
+    (> 1 means chunking flattened the tail — short prompts stop paying
+    for full-window prefills and long prompts stop stalling the step)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import DecodeEngine
+    from paddle_tpu.inference.traffic import (TenantProfile,
+                                              TrafficGenerator)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    on_tpu = jax.default_backend() not in ("cpu",)
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
+                          intermediate_size=14336, num_hidden_layers=2,
+                          num_attention_heads=32, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16")
+        s_max, chunk, bs, p_max = 512, 8, 16, 384
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=344, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2)
+        s_max, chunk, bs, p_max = 128, 4, 16, 96
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    gen = TrafficGenerator(
+        [TenantProfile("t0")], rate=6.0, seed=0, process="bursty",
+        prompt_dist="heavy_tail", prompt_min=4, prompt_max=p_max,
+        max_new=8)
+    arrivals = gen.arrivals(8.0)
+    dt, max_steps = 0.25, 4000
+
+    def run_once(chunked):
+        eng = DecodeEngine(
+            model, capacity=4, s_max=s_max, chunk=chunk, block_size=bs,
+            chunked_prefill=chunked,
+            # one page-chunk per idle lane: several chunks per step so
+            # the budget shapes, not starves, the flood
+            step_budget=(4 * chunk + 4 * bs) if chunked else None)
+        # warmup outside the measurement: compile the decode program
+        # and the prefill shape this mode rides (full window vs the
+        # 16-slot chunk bucket) so TTFT measures steady-state service
+        w = eng.submit(np.arange(1, p_max + 1, dtype=np.int32),
+                       max_new_tokens=4)
+        while not (eng.idle() and not eng.backlog):
+            eng.admit([])
+            eng.decode_once()
+        w.wait(timeout=120)
+        reqs, idx = [], 0
+        for step in range(max_steps):
+            while idx < len(arrivals) and arrivals[idx].t <= step * dt:
+                sr = arrivals[idx]
+                ids = gen.prompt_ids(sr, cfg.vocab_size, index=idx)
+                reqs.append(eng.submit(ids,
+                                       max_new_tokens=sr.max_new))
+                idx += 1
+            eng.admit([])
+            eng.decode_once()
+            if idx >= len(arrivals) and eng.idle() and not eng.backlog:
+                break
+        outs = [np.asarray(r.wait(timeout=120)) for r in reqs]
+        ttfts = np.array([r.trace.ttft for r in reqs], dtype=np.float64)
+        tpots = [t for t in (r.trace.tpot(r.max_new) for r in reqs)
+                 if t is not None]
+        return eng, outs, ttfts, tpots
+
+    eng_mono, outs_mono, ttft_mono, tpot_mono = run_once(False)
+    eng_ch, outs_ch, ttft_ch, tpot_ch = run_once(True)
+    identical = (len(outs_mono) == len(outs_ch)
+                 and all(np.array_equal(a, b)
+                         for a, b in zip(outs_mono, outs_ch)))
+    p99_mono = float(np.percentile(ttft_mono, 99)) * 1e3
+    p99_ch = float(np.percentile(ttft_ch, 99)) * 1e3
+    snap_path = _dump_metrics_snapshot(eng_ch, "mixed")
+    print(json.dumps({
+        "metric": "mixed_p99_ttft_ms",
+        "value": round(p99_ch, 2),
+        "unit": "ms",
+        "vs_baseline": round(p99_mono / max(p99_ch, 1e-9), 4),
+        "extra": {"arrivals": len(arrivals),
+                  "outputs_identical": identical,
+                  "admission_p99_ttft_ms": round(p99_mono, 2),
+                  "chunked_p99_ttft_ms": round(p99_ch, 2),
+                  "admission_mean_tpot_ms": round(
+                      float(np.mean(tpot_mono)) * 1e3, 3),
+                  "chunked_mean_tpot_ms": round(
+                      float(np.mean(tpot_ch)) * 1e3, 3),
+                  "prefill_chunks": int(
+                      eng_ch.stats()["prefill_chunks"]),
+                  "chunk_prog_windows": sorted(eng_ch._prefix_progs),
+                  "metrics_snapshot": snap_path,
+                  "backend": jax.default_backend()},
+    }))
+
+
 def bench_smoke():
     """Sub-minute pipeline probe: ONE tiny compiled train step
     (fwd+bwd+AdamW) plus ONE compiled flash-attention fwd+bwd. The
@@ -1053,6 +1156,8 @@ def main():
         return bench_slo()
     if preset == "overload":
         return bench_overload()
+    if preset == "mixed":
+        return bench_mixed()
     if preset == "smoke":
         return bench_smoke()
     if on_tpu:

@@ -417,7 +417,13 @@ class ServingFleet:
             if row is None:
                 continue
             req = row["req"]
-            req._resume_toks = list(row["toks"])
+            # a row still mid-chunked-prefill (ISSUE 7) has toks == []
+            # but may carry resume tokens from an earlier preemption —
+            # those, not the empty decode list, are what survives
+            if "pf_seq" in row:
+                req._resume_toks = list(row.get("pf_resume") or [])
+            else:
+                req._resume_toks = list(row["toks"])
             _tmark(req, "preempted")
             eng._rows[slot] = None
             out.append(req)

@@ -485,6 +485,37 @@ class FairShareScheduler:
             out.extend(e[2] for e in sorted(self._queues[tenant]))
         return out
 
+    def pending_tokens(self) -> int:
+        """Queued prompt tokens not yet prefilled (prefill-backlog
+        gauge, ISSUE 7)."""
+        return sum(e[2].ids.reshape(-1).size
+                   for q in self._queues.values() for e in q)
+
+    # -- per-step token budget (ISSUE 7 chunked prefill) --------------------
+    def _prefill_key(self, req):
+        """Fair-share chunk funding: smallest tenant virtual time first
+        (then the tenant's own priority/FCFS order). The engine charges
+        each chunk as it runs, advancing vtime — so a heavy tenant's
+        long prompt pays for its prefill PER-STEP and rotates with
+        other tenants' chunks instead of buying the whole prefill with
+        one admission charge."""
+        tenant = tenant_of(req)
+        return (self._vtime.get(tenant, 0.0), tenant,
+                -int(getattr(req, "priority", 0) or 0), req._sched_seq)
+
+    def plan_prefill(self, budget, candidates) -> list:
+        """Same funding contract as
+        :meth:`RequestScheduler.plan_prefill`, under the fair-share
+        key: whole chunks in ``_prefill_key`` order until the budget
+        runs out, stopping at the first that does not fit."""
+        funded = []
+        for req, tokens in sorted(candidates,
+                                  key=lambda c: self._prefill_key(c[0])):
+            if not budget.take(tokens):
+                break
+            funded.append((req, tokens))
+        return funded
+
     def remove(self, victims) -> int:
         """Drop shed victims from the sub-queues (heap rebuild)."""
         vids = {id(v) for v in victims}

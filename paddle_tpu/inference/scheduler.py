@@ -9,13 +9,52 @@ a preempted request re-enters with its ORIGINAL arrival sequence, so
 preemption never costs a request its FCFS position. Admission charging
 (only the uncached suffix pages) and the preemption policy itself live
 in the engine — the scheduler just answers "who goes next".
+
+ISSUE 7 extends that ownership to the per-step token budget (Sarathi-
+style chunked prefill): :class:`StepBudget` meters one mixed
+prefill+decode engine step, decode lanes claim first, and
+:meth:`RequestScheduler.plan_prefill` decides WHICH admitted-but-
+unprefilled rows get a chunk out of the remainder — the same ordering
+authority the scheduler already has over admission
+(``FairShareScheduler`` overrides the order to smallest tenant
+virtual-time first, so a long prompt's chunks are charged and rotated
+per-step instead of all-at-once).
 """
 
 from __future__ import annotations
 
 import heapq
 
-__all__ = ["RequestScheduler"]
+__all__ = ["RequestScheduler", "StepBudget"]
+
+
+class StepBudget:
+    """Token budget for ONE mixed prefill+decode engine step.
+
+    ``take(tokens)`` funds whole work items only (a chunk either runs
+    in full or waits); ``force=True`` is for decode lanes — decode is
+    never throttled below its chunk, the budget just records the spend
+    so ``used`` reflects the step's real token load (the
+    ``engine_step_budget_used`` histogram reads it)."""
+
+    __slots__ = ("total", "used")
+
+    def __init__(self, total: int):
+        self.total = max(0, int(total))
+        self.used = 0
+
+    @property
+    def remaining(self) -> int:
+        return max(0, self.total - self.used)
+
+    def take(self, tokens: int, force: bool = False) -> bool:
+        tokens = int(tokens)
+        if tokens <= 0:
+            return True
+        if not force and tokens > self.remaining:
+            return False
+        self.used += tokens
+        return True
 
 
 class RequestScheduler:
@@ -75,6 +114,33 @@ class RequestScheduler:
         """Every pending request in queue order — non-destructive, for
         QoS shed planning (ISSUE 6)."""
         return [e[2] for e in sorted(self._heap)]
+
+    def pending_tokens(self) -> int:
+        """Queued prompt tokens not yet prefilled — the scheduler's
+        share of the engine's prefill-backlog gauge (ISSUE 7; the
+        engine adds in-flight chunked rows' unprefilled remainders)."""
+        return sum(e[2].ids.reshape(-1).size for e in self._heap)
+
+    # -- per-step token budget (ISSUE 7 chunked prefill) --------------------
+    def _prefill_key(self, req):
+        """Chunk-funding order: priority desc, arrival asc — the same
+        order admission itself uses."""
+        return (-int(getattr(req, "priority", 0) or 0), req._sched_seq)
+
+    def plan_prefill(self, budget: StepBudget, candidates) -> list:
+        """The budget's prefill side: order the candidate
+        ``(request, chunk_tokens)`` pairs by :meth:`_prefill_key` and
+        fund whole chunks while the budget lasts. Funding stops at the
+        first chunk that does not fit — head-of-line order is
+        preserved, a later small chunk must not overtake a starved
+        earlier one (the admission philosophy, applied per step)."""
+        funded = []
+        for req, tokens in sorted(candidates,
+                                  key=lambda c: self._prefill_key(c[0])):
+            if not budget.take(tokens):
+                break
+            funded.append((req, tokens))
+        return funded
 
     def remove(self, victims) -> int:
         """Drop shed victims from the queue (heap rebuild). The caller

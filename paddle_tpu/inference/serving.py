@@ -73,7 +73,8 @@ class DecodeEngine:
     def __init__(self, model, capacity=4, s_max=256, chunk=8, pad_id=0,
                  paged=True, block_size=16, n_blocks=None,
                  prefix_cache=True, registry=None, worker_id=None,
-                 prefix_listener=None, qos=None):
+                 prefix_listener=None, qos=None, chunked_prefill=False,
+                 prefill_chunk=None, step_budget=None):
         from ..distributed.fleet.mp_layers import current_mesh
         from ..models.llama import _pp_degree
         if _pp_degree(current_mesh()) > 1:
@@ -89,6 +90,32 @@ class DecodeEngine:
         self.paged = bool(paged)
         self.block_size = int(block_size)
         self._prefix_on = bool(prefix_cache) and self.paged
+        # ISSUE 7: Sarathi-style chunked prefill. Admission allocates
+        # pages but defers the prompt forward; decode_once() feeds
+        # page-sized chunks through the r7 bucketed position-offset
+        # prefill under a per-step token budget, so a long prompt
+        # interleaves with decode instead of monopolizing the device at
+        # admission. Greedy outputs stay bit-identical to the
+        # admission-prefill path (the chunk program IS the prefix-tail
+        # program whose bit-parity the r7 tests pin).
+        self.chunked_prefill = bool(chunked_prefill)
+        if self.chunked_prefill and not self.paged:
+            raise ValueError(
+                "chunked_prefill requires the paged engine (chunks "
+                "scatter into the block pool)")
+        # chunk size in tokens (default: one KV page). Chunk windows
+        # ride the existing bucketed prefix-prefill programs — powers
+        # of two from 16 — so chunking compiles NO shape beyond the r7
+        # bucket set.
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk \
+            else self.block_size
+        if self.prefill_chunk <= 0:
+            raise ValueError(f"prefill_chunk={prefill_chunk!r}")
+        # per-step token budget: decode lanes claim theirs first, the
+        # remainder funds prefill chunks (the scheduler owns the
+        # funding order). Default: every decode lane plus one chunk.
+        self.step_budget = int(step_budget) if step_budget \
+            else self.capacity * self.chunk + self.prefill_chunk
         # stable identity inside a ServingFleet ("w0", "w1", ...) —
         # threaded into stats()/log lines so per-worker output is
         # distinguishable; None for a standalone engine.
@@ -152,6 +179,16 @@ class DecodeEngine:
             "decode steps executed on device (stall-watchdog heartbeat)")
         self._c_prefills = r.counter(
             "engine_prefills_total", "admission prefill programs run")
+        # ISSUE 7: chunked-prefill observability beside the existing
+        # prefill counter — chunks per step and the step's token load
+        self._c_prefill_chunks = r.counter(
+            "engine_prefill_chunks_total",
+            "prefill chunks scheduled into decode steps")
+        self._h_budget = r.histogram(
+            "engine_step_budget_used",
+            "tokens funded per engine step (decode lanes + prefill "
+            "chunks)",
+            buckets=tuple(float(2 ** i) for i in range(14)))
         self._h_ttft = r.histogram(
             "engine_ttft_seconds", "arrival to first emitted token")
         self._h_tpot = r.histogram(
@@ -166,6 +203,12 @@ class DecodeEngine:
         r.gauge("engine_backlog", "scheduler backlog depth",
                 fn=lambda: self.backlog)
         if self.paged:
+            # ISSUE 7 satellite: prefill DEBT, not just decode backlog
+            # — the SLO engine and shed planner read this beside
+            # engine_backlog to see queued prompt tokens still owed
+            r.gauge("engine_prefill_backlog_tokens",
+                    "queued + admitted prompt tokens not yet prefilled",
+                    fn=lambda: self.prefill_backlog)
             # pool gauges read the allocator at COLLECTION time — one
             # source of truth, no mirrored counters to drift
             r.gauge("engine_pool_free", "free pages in the block pool",
@@ -400,6 +443,20 @@ class DecodeEngine:
         yet."""
         return len(self._sched) if self._sched is not None else 0
 
+    @property
+    def prefill_backlog(self) -> int:
+        """Prompt tokens not yet prefilled (ISSUE 7 satellite): queued
+        requests' whole prompts plus admitted chunked rows' unprefilled
+        remainders — the prefill DEBT the decode-depth ``backlog``
+        gauge cannot see."""
+        if self._sched is None:
+            return 0
+        tokens = self._sched.pending_tokens()
+        for row in self._rows:
+            if row is not None and "pf_seq" in row:
+                tokens += row["pf_seq"].size - row["pf_pos"]
+        return tokens
+
     def drain_pending(self) -> list:
         """Remove and return every scheduled-but-unadmitted request
         (server shutdown path)."""
@@ -424,6 +481,8 @@ class DecodeEngine:
         if self.paged:
             s["pool"] = self._alloc.stats()
             s["backlog"] = self.backlog
+            s["prefill_backlog"] = self.prefill_backlog
+            s["prefill_chunks"] = int(self._c_prefill_chunks.value)
             if self._cache is not None:
                 s["prefix_cache"] = self._cache.stats()
         return s
@@ -648,12 +707,26 @@ class DecodeEngine:
         row = self._rows[slot]
         req = row["req"]
         with RecordEvent("engine.preempt", "engine", worker=self.worker_id):
-            valid = int(self._lens[slot])
-            if self._cache is not None and valid > 0:
-                seq = self._cached_seq(row)[:valid]
-                self._cache.insert(seq, row["pages"][:-(-valid // bs)])
+            if "pf_seq" in row:
+                # mid-prefill victim (ISSUE 7): publish only COMPLETED
+                # pages — the partial page's tail is still unwritten.
+                # The request re-queues with its pre-preemption resume
+                # tokens (None for a fresh prompt) and re-prefills via
+                # the r7 recompute path, re-matching what was published.
+                valid = int(row["pf_pos"])
+                full = (valid // bs) * bs
+                if self._cache is not None and full > 0:
+                    self._cache.insert(row["pf_seq"][:full],
+                                       row["pages"][:full // bs])
+                req._resume_toks = row["pf_resume"]
+            else:
+                valid = int(self._lens[slot])
+                if self._cache is not None and valid > 0:
+                    seq = self._cached_seq(row)[:valid]
+                    self._cache.insert(seq,
+                                       row["pages"][:-(-valid // bs)])
+                req._resume_toks = list(row["toks"])
             self._release_row_pages(row)
-            req._resume_toks = list(row["toks"])
             self._c_preempted.inc()
             _tmark(req, "preempted", worker=self.worker_id)
             self._tables[slot] = 0
@@ -666,7 +739,7 @@ class DecodeEngine:
                worker=self.worker_id,
                req=tr.request_id if tr is not None else None,
                slot=slot, resident_tokens=valid,
-               emitted=len(req._resume_toks))
+               emitted=len(req._resume_toks or []))
 
     def _reclaim_allocate(self, need, prio, exclude=None,
                           claimant=None):
@@ -690,7 +763,9 @@ class DecodeEngine:
             victim = self._pick_victim(prio, exclude=exclude)
             if victim is None:
                 return None
-            evicted_tokens = int(self._lens[victim])
+            vrow = self._rows[victim]
+            evicted_tokens = int(vrow["pf_pos"]) if "pf_seq" in vrow \
+                else int(self._lens[victim])
             self._preempt_row(victim)
             if claimant is not None:
                 self._qos_charge(claimant, evicted_tokens)
@@ -767,6 +842,17 @@ class DecodeEngine:
             # snapshot BEFORE the prefill: release_cow inside it zeroes
             # the match's cow_len, which would undercount the hit
             hit_tokens = m.cached_len if m is not None else 0
+            if self.chunked_prefill:
+                try:
+                    self._begin_chunked_prefill(slot, req, prompt, seq,
+                                                m, pages, resume,
+                                                hit_tokens)
+                except Exception as e:  # noqa: BLE001 — fail THIS
+                    if m is not None:   # request, not the whole engine
+                        self._cache.release(m)
+                    self._alloc.free(pages)
+                    self._fail_request(req, e)
+                continue
             try:
                 first_tok = self._prefill_row(slot, seq, m, pages)
             except Exception as e:  # noqa: BLE001 — fail THIS request,
@@ -847,6 +933,120 @@ class DecodeEngine:
                 jnp.asarray(table_row))
         self._tables[slot] = table_row
         return int(first[0])
+
+    # -- chunked prefill (ISSUE 7 tentpole) ---------------------------------
+    def _begin_chunked_prefill(self, slot, req, prompt, seq, m, pages,
+                               resume, hit_tokens):
+        """Chunked admission: take the slot and the pages (and COW-copy
+        the partially-shared prefix page) NOW, but defer the prompt
+        forward — decode_once() feeds page-sized chunks through the
+        bucketed position-offset prefill under the step budget. The
+        row keeps its block table PRIVATE until the last chunk lands:
+        ``self._tables[slot]`` stays all-NULL, so the decode program's
+        writes for this lane route to the NULL page instead of
+        clobbering chunk-scattered K/V."""
+        import jax.numpy as jnp
+        import numpy as _np
+        cached = m.cached_len if m is not None else 0
+        if m is not None and m.cow_src is not None:
+            with RecordEvent("engine.prefill", "engine",
+                             worker=self.worker_id):
+                self._kp, self._vp = self._cow(
+                    self._kp, self._vp,
+                    jnp.asarray(m.cow_src, jnp.int32),
+                    jnp.asarray(pages[0], jnp.int32))
+            self._cache.release_cow(m)
+        all_pages = (m.pages if m is not None else []) + pages
+        table_row = _np.zeros((self._max_blocks,), _np.int32)
+        table_row[:len(all_pages)] = all_pages
+        req._resume_toks = None
+        self._c_admitted.inc()
+        self._c_prefix_hit.inc(hit_tokens)
+        tr = getattr(req, "trace", None)
+        log_kv(_log, "admitted", level=logging.DEBUG,
+               worker=self.worker_id,
+               req=tr.request_id if tr is not None else None,
+               slot=slot, tokens=int(seq.size), cached_tokens=hit_tokens,
+               pages=len(all_pages), resumed=bool(resume), chunked=True)
+        self._rows[slot] = {"req": req, "prompt": prompt, "toks": [],
+                            "pages": all_pages,
+                            "pf_seq": seq,          # full resident goal
+                            "pf_pos": cached,       # tokens scattered
+                            "pf_table": table_row,  # private until done
+                            "pf_resume": list(resume) if resume
+                            else None}
+
+    def _run_prefill_chunks(self, budget):
+        """Spend the step budget's remainder on prefill chunks: the
+        scheduler orders the candidates (priority/FCFS, or fair-share
+        vtime under QoS) and funds whole chunks; each funded chunk runs
+        the bucketed position-offset prefill and scatters one window of
+        K/V. The chunk that completes the prompt emits the first token
+        and installs the row into the decode batch."""
+        slots = {}
+        cands = []
+        for slot, row in enumerate(self._rows):
+            if row is None or "pf_seq" not in row:
+                continue
+            take = min(self.prefill_chunk,
+                       row["pf_seq"].size - row["pf_pos"])
+            cands.append((row["req"], take))
+            slots[id(row["req"])] = slot
+        if not cands:
+            return
+        for req, take in self._sched.plan_prefill(budget, cands):
+            slot = slots[id(req)]
+            try:
+                self._prefill_chunk_row(slot, self._rows[slot], take)
+            except Exception as e:  # noqa: BLE001 — fail THIS request,
+                self._fail_row_paged(slot, e)  # not the whole engine
+
+    def _prefill_chunk_row(self, slot, row, take):
+        """One funded chunk: ``take`` prompt tokens through the r7
+        position-offset tail program (prefix_len = tokens already
+        resident, cold first chunks run it with prefix_len=0), K/V
+        scattered at the offset. Windows bucket through
+        ``_bucket_window`` — with the default page-sized chunk every
+        window is the 16-slot bucket, one already-documented shape."""
+        import jax.numpy as jnp
+        import numpy as _np
+        req = row["req"]
+        seq, pos = row["pf_seq"], int(row["pf_pos"])
+        tail = seq[pos:pos + take]
+        sc = self._bucket_window(tail.size)
+        ids = _np.full((1, sc), self.pad_id, _np.int32)
+        ids[0, sc - tail.size:] = tail
+        pad = sc - tail.size
+        st, embed, fnorm, lm = self._weights()
+        with RecordEvent("engine.prefill_chunk", "engine",
+                         worker=self.worker_id):
+            first, self._kp, self._vp = self._prefix_prefill_for(sc)(
+                st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
+                jnp.asarray([pad], jnp.int32),
+                jnp.asarray([pos], jnp.int32), self._kp, self._vp,
+                jnp.asarray(row["pf_table"]))
+        row["pf_pos"] = pos + tail.size
+        self._c_prefill_chunks.inc()
+        _tmark(req, "prefill_chunk", worker=self.worker_id)
+        # fair-share: the tenant pays for each chunk AS IT RUNS, not
+        # the whole uncached suffix at admission — a long prompt's
+        # vtime advances per-step, rotating its chunks with other
+        # tenants' work
+        self._qos_charge(req, tail.size)
+        if row["pf_pos"] >= seq.size:
+            # last chunk: its last-real-position logits ARE the prompt
+            # logits — first-token emission, table install, decode from
+            # the next program on
+            resume = row.pop("pf_resume")
+            toks = list(resume) if resume else [int(first[0])]
+            self._tables[slot] = row.pop("pf_table")
+            self._lens[slot] = seq.size
+            self._tok[slot] = toks[-1]
+            row["toks"] = toks
+            del row["pf_seq"], row["pf_pos"]
+            self.prefills += 1
+            self._c_prefills.inc()
+            self._observe_first_token(req)
 
     def decode_once(self):
         """Run ONE bounded decode chunk, collect tokens, retire finished
@@ -954,13 +1154,42 @@ class DecodeEngine:
         import jax.numpy as jnp
         import numpy as _np
         bs = self.block_size
+        if self.chunked_prefill:
+            # ISSUE 7: one mixed step. Decode lanes claim their tokens
+            # FIRST (decode is never throttled), then the scheduler
+            # funds prefill chunks out of the remainder. A row whose
+            # last chunk lands joins THIS step's decode program — its
+            # tokens are claimed force-side so the budget histogram
+            # reflects the step's real load.
+            from .scheduler import StepBudget
+            budget = StepBudget(self.step_budget)
+            pre = set()
+            for slot, row in enumerate(self._rows):
+                if row is not None and "pf_seq" not in row:
+                    pre.add(slot)
+                    budget.take(min(self.chunk, row["req"].max_new
+                                    - len(row["toks"])), force=True)
+            self._run_prefill_chunks(budget)
+            for slot, row in enumerate(self._rows):
+                if row is not None and "pf_seq" not in row \
+                        and slot not in pre:
+                    budget.take(min(self.chunk, row["req"].max_new
+                                    - len(row["toks"])), force=True)
+            self._h_budget.observe(budget.used)
+            if not any(r is not None and "pf_seq" not in r
+                       for r in self._rows):
+                # every live row is still mid-prefill: no decode lanes
+                # this step (running the decode program would only
+                # scribble on the NULL page)
+                return sum(r is not None for r in self._rows)
         # grow each live row's page list to cover this chunk's writes.
         # Ascending extra-page need: a starved row's freed pages rescue
         # the rows processed after it, so one hungry row never drags
-        # innocents into the exhaustion error.
+        # innocents into the exhaustion error. Mid-prefill rows never
+        # grow — admission sized their pages for the whole prompt.
         grow = []
         for slot, row in enumerate(self._rows):
-            if row is None:
+            if row is None or "pf_seq" in row:
                 continue
             use = min(self.chunk, row["req"].max_new - len(row["toks"]))
             target = int(self._lens[slot]) + use
@@ -980,6 +1209,27 @@ class DecodeEngine:
             pages = self._reclaim_allocate(extra, self._prio(row["req"]),
                                            exclude=slot,
                                            claimant=row["req"])
+            if pages is None and self.chunked_prefill:
+                # a decode-complete row's growth outranks equal-or-
+                # lower-priority rows still MID-prefill: they lose the
+                # least work and resume losslessly. Without this a tiny
+                # pool livelocks — the grower self-preempts, re-admits,
+                # re-prefills, and self-preempts again while the
+                # mid-prefill row it starves never retires a page.
+                my_p = self._prio(row["req"])
+                pf = [i for i, r in enumerate(self._rows)
+                      if r is not None and i != slot and "pf_seq" in r
+                      and self._prio(r["req"]) <= my_p]
+                pf.sort(key=lambda i:            # newest arrival first
+                        -self._rows[i]["req"]._sched_seq)
+                while pages is None and pf:
+                    v = pf.pop(0)
+                    evicted = int(self._rows[v]["pf_pos"])
+                    self._preempt_row(v)
+                    self._qos_charge(row["req"], evicted)
+                    if self._cache is not None:
+                        self._evict_cached(extra - self._alloc.num_free)
+                    pages = self._alloc.allocate(extra)
             if pages is None:
                 others = any(r is not None and i != slot
                              for i, r in enumerate(self._rows))
@@ -999,6 +1249,9 @@ class DecodeEngine:
             self._tables[slot, start:start + extra] = pages
         if self._no_rows():
             return 0
+        if self.chunked_prefill and not any(
+                r is not None and "pf_seq" not in r for r in self._rows):
+            return sum(r is not None for r in self._rows)
         st, embed, fnorm, lm = self._weights()
         t0 = _now()
         with RecordEvent("engine.decode_chunk", "engine", worker=self.worker_id):
@@ -1023,6 +1276,9 @@ class DecodeEngine:
         for slot, row in enumerate(self._rows):
             if row is None:
                 continue
+            if "pf_seq" in row:
+                alive += 1          # mid-prefill: alive, not decoding
+                continue            # (its lane wrote to the NULL page)
             emitted_before = len(row["toks"])
             row["toks"].extend(int(t) for t in toks[:, slot])
             self._tok[slot] = int(toks[-1, slot])

@@ -31,6 +31,13 @@ The XLA fallback (`_paged_attn_reference`) gathers the row's pages into
 a contiguous view and runs the same masked softmax math as
 ``models.llama._decode_attention`` — bit-matching the contiguous-cache
 decode on CPU, which is what the engine parity tests pin.
+
+ISSUE 7 extends the file with a MIXED launch
+(:func:`mixed_paged_attention`): one program serves decode rows (1
+query at position len-1) and prefill-chunk rows (q_len queries at an
+arbitrary position offset, causal within the chunk, attending to all
+previously-written pages) side by side — the ragged-row shape chunked
+prefill schedules into every decode step.
 """
 
 from __future__ import annotations
@@ -49,6 +56,7 @@ except Exception:  # noqa: BLE001
     _HAS_PLTPU = False
 
 __all__ = ["paged_decode_attention", "paged_attention_pallas",
+           "mixed_paged_attention", "mixed_attention_pallas",
            "NULL_PAGE"]
 
 #: page id 0 is never allocated: padded block-table entries and
@@ -205,3 +213,174 @@ def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens):
                                       seq_lens)
     return _paged_attn_reference(q, k_pages, v_pages, block_table,
                                  seq_lens)
+
+
+# ---------------------------------------------------------------------------
+# Mixed prefill-chunk + decode launch (ISSUE 7 tentpole)
+# ---------------------------------------------------------------------------
+# One launch serves rows of BOTH serving kinds ("Ragged Paged
+# Attention"'s actual shape — decode is just the q_len=1 special case):
+# - decode rows: 1 query token sitting at position kv_len-1
+# - prefill-chunk rows: q_len query tokens ending at kv_len-1 (a page
+#   of prompt scheduled into a decode step), causal WITHIN the chunk
+#   and attending to every previously-written position through the
+#   row's block table
+# Contract: the chunk's own K/V are already resident in the pool
+# (scatter-then-attend, the same convention as the decode step's
+# lens+1), so query i of row b sits at absolute position
+# ``kv_lens[b] - q_lens[b] + i`` and attends to positions <= its own.
+# Query slots i >= q_lens[b] are padding: they compute finite garbage
+# that callers ignore (no masks needed in the launch shape).
+
+def _mixed_kernel(tables, kv_lens, q_lens, q_ref, k_hbm, v_hbm, o_ref,
+                  k_s, v_s, ksem, vsem, *, bs, scale):
+    """One program = one (row, kv_head): T*G query rows against the
+    row's ragged page list with PER-QUERY causal limits; pages
+    double-buffered HBM→VMEM exactly like the decode kernel."""
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    q = q_ref[0, :, 0].astype(jnp.float32)             # [T, G, hd]
+    t, g, hd = q.shape
+    q = q.reshape(t * g, hd)
+
+    n = kv_lens[b]                                     # resident tokens
+    qn = q_lens[b]                                     # valid queries
+    n_blk = jax.lax.div(n + bs - 1, bs)
+    # query row r = i*G + gg sits at position n - qn + i: its inclusive
+    # attend limit. Padding queries (i >= qn) get limit >= n-1 — every
+    # resident position, finite garbage out.
+    qi = jax.lax.div(
+        jax.lax.broadcasted_iota(jnp.int32, (t * g, bs), 0), g)
+    limit = n - qn + qi                                # [t*g, bs]
+
+    def kdma(slot, j):
+        return pltpu.make_async_copy(
+            k_hbm.at[tables[b, j], :, h, :], k_s.at[slot], ksem.at[slot])
+
+    def vdma(slot, j):
+        return pltpu.make_async_copy(
+            v_hbm.at[tables[b, j], :, h, :], v_s.at[slot], vsem.at[slot])
+
+    m0 = jnp.full((t * g,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((t * g,), jnp.float32)
+    acc0 = jnp.zeros((t * g, hd), jnp.float32)
+
+    @pl.when(n_blk > 0)
+    def _start():
+        kdma(0, 0).start()
+        vdma(0, 0).start()
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, 2)
+        nxt = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < n_blk)
+        def _prefetch():
+            kdma(nxt, j + 1).start()
+            vdma(nxt, j + 1).start()
+
+        kdma(slot, j).wait()
+        vdma(slot, j).wait()
+        k = k_s[slot]                                  # [bs, hd]
+        v = v_s[slot]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [t*g, bs]
+        k_ids = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (t * g, bs), 1)
+        ok = (k_ids <= limit) & (k_ids < n)            # ragged + causal
+        s = jnp.where(ok, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(ok, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p, v.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_blk, body, (m0, l0, acc0))
+    out = (acc / jnp.maximum(l, 1e-30)[:, None]).reshape(t, g, hd)
+    o_ref[0, :, 0] = out.astype(o_ref.dtype)
+
+
+def mixed_attention_pallas(q, k_pages, v_pages, block_table, kv_lens,
+                           q_lens, interpret=False):
+    """Raw Pallas launch for a MIXED batch. q [B, T, kvh, G, hd] (T =
+    padded query tokens per row; decode rows use q_lens=1); k/v_pages
+    [N, bs, kvh, hd]; block_table [B, max_blocks] int32; kv_lens [B]
+    int32 resident tokens INCLUDING this launch's queries; q_lens [B]
+    int32 valid query tokens. Returns [B, T, kvh, G, hd] f32."""
+    B, T, kvh, G, hd = q.shape
+    bs = k_pages.shape[1]
+    scale = 1.0 / (hd ** 0.5)
+    kernel = functools.partial(_mixed_kernel, bs=bs, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, kvh),
+        in_specs=[
+            pl.BlockSpec((1, T, 1, G, hd),
+                         lambda b, h, *_: (b, 0, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, T, 1, G, hd),
+                               lambda b, h, *_: (b, 0, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, bs, hd), k_pages.dtype),
+            pltpu.VMEM((2, bs, hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, T, kvh, G, hd), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(block_table, jnp.int32),
+      jnp.asarray(kv_lens, jnp.int32),
+      jnp.asarray(q_lens, jnp.int32), q, k_pages, v_pages)
+
+
+def _mixed_attn_reference(q, k_pages, v_pages, block_table, kv_lens,
+                          q_lens):
+    """Gather-then-masked-softmax over the per-query causal mask — the
+    mixed counterpart of `_paged_attn_reference` (same exact-zeros
+    masking, so a q_lens=1 launch is the decode math). Rows with no
+    attendable position (kv_len 0) output exact zeros, matching the
+    kernel's l=0 branch."""
+    ck = gather_pages(k_pages, block_table)     # [B, S, kvh, hd]
+    cv = gather_pages(v_pages, block_table)
+    T = q.shape[1]
+    s_tot = ck.shape[1]
+    pos = (kv_lens[:, None] - q_lens[:, None]
+           + jnp.arange(T)[None, :])            # [B, T] query positions
+    j = jnp.arange(s_tot)[None, None, :]
+    ok = (j <= pos[:, :, None]) & (j < kv_lens[:, None, None])
+    qf = q.astype(jnp.float32)                  # [B, T, kvh, G, hd]
+    scale = q.shape[-1] ** 0.5
+    s = jnp.einsum("btngd,bsnd->btngs", qf,
+                   ck.astype(jnp.float32)) / scale
+    s = jnp.where(ok[:, :, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isfinite(s).any(-1, keepdims=True), p, 0.0)
+    return jnp.einsum("btngs,bsnd->btngd", p, cv.astype(jnp.float32))
+
+
+def mixed_paged_attention(q, k_pages, v_pages, block_table, kv_lens,
+                          q_lens):
+    """Entry for mixed prefill-chunk + decode launches: the Pallas
+    kernel on TPU when the pool is tileable, else the XLA gather
+    reference (the kernel's parity is pinned in interpret mode; the
+    serving engine's CPU chunk path rides the bucketed prefix-prefill
+    programs, whose bit-parity the r7 tests pin)."""
+    bs, hd = k_pages.shape[1], k_pages.shape[3]
+    if (_HAS_PLTPU and jax.default_backend() == "tpu"
+            and hd % 128 == 0 and bs % 8 == 0):
+        return mixed_attention_pallas(q, k_pages, v_pages, block_table,
+                                      kv_lens, q_lens)
+    return _mixed_attn_reference(q, k_pages, v_pages, block_table,
+                                 kv_lens, q_lens)

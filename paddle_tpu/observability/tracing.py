@@ -25,10 +25,15 @@ from .metrics import now
 
 __all__ = ["RequestTrace", "TERMINAL_STATES", "LIFECYCLE_STATES"]
 
-#: canonical transition vocabulary, in lifecycle order
+#: canonical transition vocabulary, in lifecycle order.
+#: ``prefill_chunk`` (ISSUE 7): one mark per prompt chunk scheduled
+#: into a decode step — ``first_token`` fires only when the LAST chunk
+#: lands, so derived TTFT spans admission → last-chunk first token,
+#: and ``mark_once`` keeps it the request's first ever across
+#: preemption/resume stints.
 LIFECYCLE_STATES = ("arrival", "queued", "admitted", "prefill",
-                    "first_token", "decode_chunk", "preempted",
-                    "retired", "failed")
+                    "prefill_chunk", "first_token", "decode_chunk",
+                    "preempted", "retired", "failed")
 TERMINAL_STATES = frozenset({"retired", "failed"})
 
 _ids = itertools.count(1)

@@ -56,6 +56,10 @@ def test_infra_skip_metric_follows_preset(monkeypatch, capsys):
     bench._emit_infra_skip("tunnel down")
     out = json.loads(capsys.readouterr().out.strip())
     assert out["metric"] == "overload_p99_ttft_ms"
+    monkeypatch.setenv("BENCH_PRESET", "mixed")
+    bench._emit_infra_skip("tunnel down")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "mixed_p99_ttft_ms"
 
 
 @pytest.mark.slow
@@ -213,6 +217,46 @@ def test_overload_preset_cpu_smoke(tmp_path):
         "qos_shed_total"] == 0
     assert snap["fleet"]["histograms"]["engine_ttft_seconds"][
         "count"] > 0
+
+
+@pytest.mark.slow
+def test_mixed_preset_cpu_smoke(tmp_path):
+    """End-to-end CPU run of BENCH_PRESET=mixed (ISSUE 7 satellite):
+    one JSON line; the chunked and admission runs of the same seeded
+    flood produce bit-identical greedy outputs; chunked p99 TTFT is no
+    worse than admission p99 TTFT (the perf claim, on the same engine
+    config); and the chunk windows stayed inside the documented bucket
+    set (no third program shape)."""
+    env = dict(os.environ, BENCH_PRESET="mixed",
+               BENCH_ALLOW_CPU="1", BENCH_NO_WALL="1",
+               BENCH_SKIP_PROBE="1", BENCH_METRICS_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, bench.__file__], env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1                         # one-JSON-line contract
+    out = json.loads(lines[0])
+    assert out["metric"] == "mixed_p99_ttft_ms"
+    assert out["value"] > 0
+    extra = out["extra"]
+    # the correctness oracle: same flood, same greedy outputs
+    assert extra["outputs_identical"] is True
+    # the perf claim: chunking flattens (or at worst matches) the tail
+    assert (extra["chunked_p99_ttft_ms"]
+            <= extra["admission_p99_ttft_ms"])
+    assert out["vs_baseline"] >= 1.0
+    # shape discipline: every chunk window is a documented power-of-two
+    # bucket (the default page-sized chunk rides exactly {16})
+    assert extra["chunk_prog_windows"] == [16]
+    assert extra["prefill_chunks"] > 0
+    snap_path = extra["metrics_snapshot"]
+    assert snap_path == str(tmp_path / "bench_metrics_mixed.json")
+    snap = json.load(open(snap_path))
+    assert snap["counters"]["engine_prefill_chunks_total"] == \
+        extra["prefill_chunks"]
+    assert snap["histograms"]["engine_step_budget_used"]["count"] > 0
 
 
 def test_env_flag_tolerant(monkeypatch):

@@ -85,6 +85,154 @@ class TestPagedKernel:
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def _random_mixed(seed=0, B=4, T=8, kvh=2, G=4, hd=128, n_blocks=13,
+                  bs=16, max_blocks=4, kv_lens=(37, 24, 64, 16),
+                  q_lens=(1, 8, 1, 8)):
+    """Random pool + tables for a MIXED launch: decode rows (q_len 1)
+    beside prefill-chunk rows (q_len up to T) at ragged positions."""
+    rng = np.random.RandomState(seed)
+    q = rng.randn(B, T, kvh, G, hd).astype(np.float32) * 0.5
+    kp = rng.randn(n_blocks, bs, kvh, hd).astype(np.float32) * 0.5
+    vp = rng.randn(n_blocks, bs, kvh, hd).astype(np.float32) * 0.5
+    kv_lens = np.asarray(kv_lens, np.int32)
+    q_lens = np.asarray(q_lens, np.int32)
+    table = np.zeros((B, max_blocks), np.int32)
+    free = list(range(1, n_blocks))          # page 0 = NULL
+    for b in range(B):
+        for j in range(-(-int(kv_lens[b]) // bs)):
+            table[b, j] = free.pop(0)
+    return (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(table), jnp.asarray(kv_lens),
+            jnp.asarray(q_lens))
+
+
+def _mixed_oracle(q, kp, vp, table, kv_lens, q_lens):
+    """Straight-line numpy math: query i of row b sits at position
+    kv_len - q_len + i and attends positions <= its own. Padding query
+    slots are left at zero (callers ignore them)."""
+    q, kp, vp = (np.asarray(a, np.float64) for a in (q, kp, vp))
+    table = np.asarray(table)
+    B, T, kvh, G, hd = q.shape
+    out = np.zeros((B, T, kvh, G, hd), np.float64)
+    for b in range(B):
+        n, qn = int(kv_lens[b]), int(q_lens[b])
+        if n == 0:
+            continue
+        keys = np.concatenate([kp[p] for p in table[b]], 0)[:n]
+        vals = np.concatenate([vp[p] for p in table[b]], 0)[:n]
+        for i in range(qn):
+            pos = n - qn + i
+            for h in range(kvh):
+                s = q[b, i, h] @ keys[:pos + 1, h].T / np.sqrt(hd)
+                s -= s.max(axis=-1, keepdims=True)
+                p = np.exp(s)
+                p /= p.sum(axis=-1, keepdims=True)
+                out[b, i, h] = p @ vals[:pos + 1, h]
+    return out.astype(np.float32)
+
+
+class TestMixedKernel:
+    """ISSUE 7 tentpole layer 1: one launch serves decode rows and
+    prefill-chunk rows at arbitrary position offsets. Every case runs
+    the Pallas kernel in interpret mode AND the XLA reference against
+    the straight-line numpy oracle."""
+
+    def _check(self, q, kp, vp, table, kv_lens, q_lens):
+        from paddle_tpu.kernels.paged_attention import (
+            _mixed_attn_reference, mixed_attention_pallas)
+        oracle = _mixed_oracle(q, kp, vp, table, kv_lens, q_lens)
+        ref = np.asarray(_mixed_attn_reference(q, kp, vp, table,
+                                               kv_lens, q_lens))
+        out = np.asarray(mixed_attention_pallas(q, kp, vp, table,
+                                                kv_lens, q_lens,
+                                                interpret=True))
+        ql = np.asarray(q_lens)
+        for b in range(q.shape[0]):          # padding slots excluded
+            sl = (b, slice(0, int(ql[b])))
+            assert np.allclose(ref[sl], oracle[sl], atol=2e-5), \
+                np.abs(ref[sl] - oracle[sl]).max()
+            assert np.allclose(out[sl], oracle[sl], atol=2e-5), \
+                np.abs(out[sl] - oracle[sl]).max()
+
+    def test_decode_only_rows(self):
+        """q_len=1 everywhere: the mixed launch IS the decode kernel
+        (each query at position len-1)."""
+        self._check(*_random_mixed(seed=21, T=1,
+                                   kv_lens=(37, 5, 64, 16),
+                                   q_lens=(1, 1, 1, 1)))
+
+    def test_decode_only_matches_decode_reference(self):
+        """A q_len=1 mixed launch must agree with the single-query
+        decode reference on the same pool (same masked-softmax math,
+        modulo the extra query dim's reduction order)."""
+        from paddle_tpu.kernels.paged_attention import (
+            _mixed_attn_reference, _paged_attn_reference)
+        q, kp, vp, table, kv_lens, q_lens = _random_mixed(
+            seed=23, T=1, kv_lens=(37, 5, 64, 16), q_lens=(1, 1, 1, 1))
+        mixed = np.asarray(_mixed_attn_reference(
+            q, kp, vp, table, kv_lens, q_lens))[:, 0]
+        dec = np.asarray(_paged_attn_reference(
+            q[:, 0], kp, vp, table, kv_lens))
+        assert np.allclose(mixed, dec, atol=2e-5)
+
+    def test_chunk_only_rows(self):
+        """Every row a prefill chunk mid-prompt: full q_len pages at
+        position offsets, causal within the chunk."""
+        self._check(*_random_mixed(seed=25, T=16,
+                                   kv_lens=(48, 40, 16, 32),
+                                   q_lens=(16, 16, 16, 16)))
+
+    def test_interleaved_decode_and_chunks(self):
+        """The serving shape: decode rows and chunk rows in ONE
+        launch, ragged everything."""
+        self._check(*_random_mixed(seed=27, T=8,
+                                   kv_lens=(37, 24, 64, 16),
+                                   q_lens=(1, 8, 1, 8)))
+
+    def test_chunk_at_offset_zero_vs_mid_sequence(self):
+        """A chunk whose queries START the sequence (kv_len == q_len:
+        pure causal self-attention) beside one deep into resident
+        history — the offset arithmetic must hold at both extremes."""
+        self._check(*_random_mixed(seed=29, T=16,
+                                   kv_lens=(16, 61, 64, 30),
+                                   q_lens=(16, 16, 16, 14)))
+
+    def test_final_partial_chunk(self):
+        """The last chunk of a prompt is usually SHORTER than the
+        window: q_len < T with padding query slots, and a kv_len that
+        ends mid-page."""
+        self._check(*_random_mixed(seed=31, T=16,
+                                   kv_lens=(37, 21, 5, 50),
+                                   q_lens=(5, 3, 5, 2)))
+
+    def test_inactive_row_outputs_zeros(self):
+        """kv_len=0 lanes (inactive slots in a fixed-shape launch)
+        output exact zeros from BOTH the kernel and the reference —
+        no NaNs leak from the empty softmax."""
+        from paddle_tpu.kernels.paged_attention import (
+            _mixed_attn_reference, mixed_attention_pallas)
+        q, kp, vp, table, kv_lens, q_lens = _random_mixed(
+            seed=33, T=8, kv_lens=(37, 0, 64, 0), q_lens=(1, 0, 8, 0))
+        ref = np.asarray(_mixed_attn_reference(q, kp, vp, table,
+                                               kv_lens, q_lens))
+        out = np.asarray(mixed_attention_pallas(q, kp, vp, table,
+                                                kv_lens, q_lens,
+                                                interpret=True))
+        assert np.all(np.isfinite(ref)) and np.all(np.isfinite(out))
+        np.testing.assert_array_equal(ref[1], np.zeros_like(ref[1]))
+        np.testing.assert_array_equal(out[1], np.zeros_like(out[1]))
+
+    def test_entry_gate_uses_reference_off_tpu(self):
+        from paddle_tpu.kernels.paged_attention import (
+            _mixed_attn_reference, mixed_paged_attention)
+        if jax.default_backend() == "tpu":
+            pytest.skip("CPU-only gate check")
+        q, kp, vp, table, kv_lens, q_lens = _random_mixed(seed=35)
+        out = mixed_paged_attention(q, kp, vp, table, kv_lens, q_lens)
+        ref = _mixed_attn_reference(q, kp, vp, table, kv_lens, q_lens)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 class TestBlockAllocator:
     def _alloc(self, n=9):
         from paddle_tpu.inference.paged_cache import BlockAllocator
