@@ -44,6 +44,8 @@ class BlockAllocator:
         # LIFO: freed pages are reused first
         self._free = list(range(self.n_blocks - 1, NULL_PAGE, -1))
         self._rc: dict[int, int] = {}   # page -> live reference count
+        self.track_allocations = False  # int8 engines flip this on
+        self._handed_out: list[int] = []  # since last drain_allocated()
         self.high_watermark = 0         # max pages ever in use at once
         self.total_allocated = 0        # cumulative allocate() pages —
         #                                 prefix hits show up as a FLAT
@@ -90,9 +92,24 @@ class BlockAllocator:
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._rc[p] = 1
+        if self.track_allocations:
+            self._handed_out.extend(pages)
         self.total_allocated += n
         self.high_watermark = max(self.high_watermark, len(self._rc))
         return pages
+
+    def drain_allocated(self) -> list[int]:
+        """Pages handed out since the last drain (int8 paged KV, ISSUE
+        8): a recycled page carries the PREVIOUS tenant's running-max
+        scale, which would never shrink and slowly coarsen every new
+        row quantized into it. An engine with int8 pools sets
+        ``track_allocations`` and drains this list before each device
+        step that writes KV, resetting the drained pages' scales to the
+        eps floor. fp engines leave tracking off so the list stays
+        empty."""
+        out = self._handed_out
+        self._handed_out = []
+        return out
 
     def incref(self, page: int) -> None:
         """A new reader maps an already-allocated page (prefix hit)."""

@@ -239,6 +239,12 @@ class QoSPolicy:
 
     # -- accounting -------------------------------------------------------
     def note_served(self, tenant: str, tokens: int) -> None:
+        """Count DELIVERED tokens toward ``qos_served_tokens_total``.
+        Token-denominated by construction, so multi-token steps
+        (chunked decode, speculative verify) change nothing here: a
+        request retires having been served exactly its max_new tokens
+        regardless of how many device steps — or rejected drafts — it
+        took to earn them."""
         if tokens > 0:
             self._state(tenant)["served"].inc(int(tokens))
 

@@ -35,7 +35,11 @@ class StepBudget:
     in full or waits); ``force=True`` is for decode lanes — decode is
     never throttled below its chunk, the budget just records the spend
     so ``used`` reflects the step's real token load (the
-    ``engine_step_budget_used`` histogram reads it)."""
+    ``engine_step_budget_used`` histogram reads it). Speculative
+    verify lanes (ISSUE 8) force-take ``k+1`` — the PROPOSED window,
+    pending token plus drafts — because that is the device work the
+    step performs whether or not the drafts survive; tenants, by
+    contrast, are charged accepted tokens only."""
 
     __slots__ = ("total", "used")
 

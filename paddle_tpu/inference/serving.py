@@ -32,12 +32,15 @@ __all__ = ["GenerationPredictor", "BatchingServer", "DecodeEngine"]
 _log = get_logger("paddle_tpu.inference.engine")
 
 
-def _tmark(req, state, worker=None):
+def _tmark(req, state, worker=None, n_tokens=None):
     """Mark a lifecycle transition on the request's trace (requests
     without one — foreign test doubles — are silently skipped).
-    ``worker`` attributes the event to a fleet worker lane (ISSUE 5)."""
+    ``worker`` attributes the event to a fleet worker lane (ISSUE 5);
+    ``n_tokens`` annotates how many output tokens the event emitted
+    (ISSUE 8: a speculative verify step emits 1..k+1 per mark)."""
     tr = getattr(req, "trace", None)
-    return None if tr is None else tr.mark(state, worker=worker)
+    return None if tr is None else tr.mark(state, worker=worker,
+                                           n_tokens=n_tokens)
 
 
 class DecodeEngine:
@@ -74,7 +77,8 @@ class DecodeEngine:
                  paged=True, block_size=16, n_blocks=None,
                  prefix_cache=True, registry=None, worker_id=None,
                  prefix_listener=None, qos=None, chunked_prefill=False,
-                 prefill_chunk=None, step_budget=None):
+                 prefill_chunk=None, step_budget=None,
+                 spec_decode=False, spec_max_draft=4, kv_dtype="fp"):
         from ..distributed.fleet.mp_layers import current_mesh
         from ..models.llama import _pp_degree
         if _pp_degree(current_mesh()) > 1:
@@ -116,6 +120,37 @@ class DecodeEngine:
         # funding order). Default: every decode lane plus one chunk.
         self.step_budget = int(step_budget) if step_budget \
             else self.capacity * self.chunk + self.prefill_chunk
+        # ISSUE 8: self-speculative decoding. The n-gram drafter
+        # proposes up to spec_max_draft tokens per row; the engine
+        # verifies all of them in ONE position-offset prefill step and
+        # accepts the longest argmax-matching prefix. Default OFF —
+        # prior outputs stay bit-identical.
+        self.spec_decode = bool(spec_decode)
+        self.spec_max_draft = int(spec_max_draft)
+        if self.spec_decode and not self.paged:
+            raise ValueError(
+                "spec_decode requires the paged engine (the verify "
+                "step rides the position-offset prefill programs)")
+        if self.spec_decode and self.spec_max_draft < 1:
+            raise ValueError(f"spec_max_draft={spec_max_draft}")
+        self._drafter = None
+        if self.spec_decode:
+            from .spec_decode import NgramDrafter
+            self._drafter = NgramDrafter(max_draft=self.spec_max_draft)
+        # ISSUE 8: int8 paged KV. "int8" stores the block pools as int8
+        # codes with one f32 scale per (layer, page, kv head) beside
+        # them; writes quantize with a running-max scale, the attention
+        # programs dequantize inside. Default "fp" keeps the r12 pools
+        # and bit-identical outputs.
+        if kv_dtype not in ("fp", "int8"):
+            raise ValueError(f"kv_dtype={kv_dtype!r} (want 'fp' or "
+                             f"'int8')")
+        if kv_dtype == "int8" and not self.paged:
+            raise ValueError("kv_dtype='int8' requires the paged "
+                             "engine (scales live beside the block "
+                             "pool)")
+        self.kv_dtype = kv_dtype
+        self._kv_q = kv_dtype == "int8"
         # stable identity inside a ServingFleet ("w0", "w1", ...) —
         # threaded into stats()/log lines so per-worker output is
         # distinguishable; None for a standalone engine.
@@ -224,6 +259,31 @@ class DecodeEngine:
                         "prefix",
                         fn=lambda: (self._cache.hit_rate
                                     if self._cache is not None else 0.0))
+        if self.paged and self.spec_decode:
+            # ISSUE 8: speculation observability. accepted counts BONUS
+            # tokens only (m-1 per verify step: the first token is what
+            # a plain decode step would have produced anyway), so
+            # accepted/proposed is the draft survival rate and
+            # accept_len's mean is tokens/step.
+            self._c_spec_proposed = r.counter(
+                "engine_spec_proposed_total",
+                "draft tokens submitted to verify steps")
+            self._c_spec_accepted = r.counter(
+                "engine_spec_accepted_total",
+                "draft tokens accepted (emitted beyond the per-step "
+                "baseline)")
+            self._h_spec_accept = r.histogram(
+                "engine_spec_accept_len",
+                "tokens emitted per verify step (1 = every draft "
+                "rejected)",
+                buckets=tuple(float(i) for i in
+                              range(1, self.spec_max_draft + 2)))
+            r.gauge(
+                "engine_spec_accept_rate",
+                "accepted/proposed draft token fraction",
+                fn=lambda: (self._c_spec_accepted.value
+                            / self._c_spec_proposed.value
+                            if self._c_spec_proposed.value else 0.0))
 
     # -- compiled programs --------------------------------------------------
     def _build(self):
@@ -285,8 +345,16 @@ class DecodeEngine:
 
             return decode_chunk
 
+        # Paged closures take the pool arrays LAST as ``*pool`` (ISSUE
+        # 8): fp engines pass (kp, vp), int8 engines (kp, vp, kscale,
+        # vscale) — one closure body serves both layouts, and the int8
+        # scale updates stay inside the compiled programs.
+
+        def _kv_scales_of(pool):
+            return (pool[2], pool[3]) if len(pool) == 4 else None
+
         def prefill_paged(stacked, embed, fnorm, lm, scales, ids,
-                          pad_len, kp, vp, table_row):
+                          pad_len, table_row, *pool):
             """ids [1, s_max] right-aligned; the prompt's K/V scatter
             into the block pools THROUGH table_row inside the program
             (pad positions route to the NULL page), so admission is one
@@ -298,12 +366,13 @@ class DecodeEngine:
             logits, ks, vs = _llama.masked_prefill(
                 cfg, stacked, embed, fnorm, lm, ids, pad_len,
                 last_index=self.s_max - 1)
-            kp, vp = _llama.scatter_prefill_kv(kp, vp, ks, vs,
-                                               table_row, pad_len[0])
-            return jnp.argmax(logits, axis=-1), kp, vp
+            out = _llama.scatter_prefill_kv(
+                pool[0], pool[1], ks, vs, table_row, pad_len[0],
+                kv_scales=_kv_scales_of(pool))
+            return (jnp.argmax(logits, axis=-1), *out)
 
         def decode_chunk_paged(stacked, embed, fnorm, lm, scales, tok,
-                               kp, vp, tables, lens):
+                               tables, lens, *pool):
             """One chunk against the block pool; tables/lens are DATA,
             so every admission pattern reuses this one program."""
             stacked, lm = _llama._dequantize_weights(cfg, stacked, lm,
@@ -312,16 +381,16 @@ class DecodeEngine:
                 lm = embed.T
 
             def body(carry, i):
-                tok, kp, vp = carry
-                logits, kp, vp = _llama._paged_decode_step(
-                    cfg, stacked, embed, fnorm, lm, tok, kp, vp,
-                    tables, lens + i)
-                nxt = jnp.argmax(logits, axis=-1)
-                return (nxt, kp, vp), nxt
+                tok = carry[0]
+                out = _llama._paged_decode_step(
+                    cfg, stacked, embed, fnorm, lm, tok, carry[1],
+                    carry[2], tables, lens + i, *carry[3:])
+                nxt = jnp.argmax(out[0], axis=-1)
+                return (nxt, *out[1:]), nxt
 
-            (tok, kp, vp), toks = jax.lax.scan(
-                body, (tok, kp, vp), jnp.arange(self.chunk))
-            return toks, kp, vp
+            (tok, *pool), toks = jax.lax.scan(
+                body, (tok, *pool), jnp.arange(self.chunk))
+            return (toks, *pool)
 
         def make_prefix_prefill(sc):
             """Prefix-hit prefill over a BUCKETED tail window of ``sc``
@@ -331,35 +400,66 @@ class DecodeEngine:
             cold admissions keep the untouched full-window program."""
 
             def prefill_prefix(stacked, embed, fnorm, lm, scales, ids,
-                               pad_len, prefix_len, kp, vp, table_row):
+                               pad_len, prefix_len, table_row, *pool):
                 stacked, lm = _llama._dequantize_weights(cfg, stacked,
                                                          lm, scales)
                 if lm is None:
                     lm = embed.T
-                logits, kp, vp = _llama.prefix_prefill(
+                out = _llama.prefix_prefill(
                     cfg, stacked, embed, fnorm, lm, ids, pad_len,
-                    prefix_len, kp, vp, table_row)
-                return jnp.argmax(logits, axis=-1), kp, vp
+                    prefix_len, pool[0], pool[1], table_row,
+                    kv_scales=_kv_scales_of(pool))
+                return (jnp.argmax(out[0], axis=-1), *out[1:])
 
             return prefill_prefix
 
-        def cow_copy(kp, vp, src, dst):
+        def make_verify_prefill(sc):
+            """Speculative VERIFY program over a bucketed ``sc`` window
+            (ISSUE 8): the tail is the row's pending next-input token
+            plus its k drafts at ``prefix_len = tokens-resident``, and
+            the program returns the argmax at EVERY window position —
+            the engine reads the greedy chain off the last k+1 slots
+            and accepts the longest prefix the drafts matched. Same
+            math as the prefix-prefill program (r7/r12 parity), one new
+            compiled shape per bucket."""
+
+            def verify_prefill(stacked, embed, fnorm, lm, scales, ids,
+                               pad_len, prefix_len, table_row, *pool):
+                stacked, lm = _llama._dequantize_weights(cfg, stacked,
+                                                         lm, scales)
+                if lm is None:
+                    lm = embed.T
+                out = _llama.prefix_prefill(
+                    cfg, stacked, embed, fnorm, lm, ids, pad_len,
+                    prefix_len, pool[0], pool[1], table_row,
+                    kv_scales=_kv_scales_of(pool), all_logits=True)
+                return (jnp.argmax(out[0], axis=-1), *out[1:])
+
+            return verify_prefill
+
+        def cow_copy(src, dst, *pool):
             """Copy-on-write: clone page ``src`` into the row's private
-            page ``dst`` (both pools, all layers). src/dst are DATA, so
-            every COW admission reuses this one program."""
-            kp = kp.at[:, dst].set(kp[:, src])
-            vp = vp.at[:, dst].set(vp[:, src])
-            return kp, vp
+            page ``dst`` (both pools, all layers; int8 engines copy the
+            page scales with the codes). src/dst are DATA, so every COW
+            admission reuses this one program."""
+            out = tuple(a.at[:, dst].set(a[:, src]) for a in pool)
+            return out
 
         self._make_decode = make_decode
         self._decode_progs = {}
         self._make_prefix_prefill = make_prefix_prefill
         self._prefix_progs = {}
+        self._make_verify_prefill = make_verify_prefill
+        self._verify_progs = {}
+        self._n_pool = 4 if self._kv_q else 2
         if self.paged:
             self._prefill = jax.jit(prefill_paged)
-            self._decode = jax.jit(decode_chunk_paged,
-                                   donate_argnums=(6, 7))
-            self._cow = jax.jit(cow_copy, donate_argnums=(0, 1))
+            self._decode = jax.jit(
+                decode_chunk_paged,
+                donate_argnums=tuple(range(8, 8 + self._n_pool)))
+            self._cow = jax.jit(
+                cow_copy,
+                donate_argnums=tuple(range(2, 2 + self._n_pool)))
         else:
             self._prefill = jax.jit(prefill)
             self._decode = self._decode_for(self.chunk)
@@ -396,8 +496,22 @@ class DecodeEngine:
         fn = self._prefix_progs.get(sc)
         if fn is None:
             fn = jax.jit(self._make_prefix_prefill(sc),
-                         donate_argnums=(8, 9))
+                         donate_argnums=tuple(
+                             range(9, 9 + self._n_pool)))
             self._prefix_progs[sc] = fn
+        return fn
+
+    def _verify_prefill_for(self, sc):
+        """Compiled verify program for an ``sc``-slot window (cached;
+        with the default draft cap every window is the 16-slot
+        bucket)."""
+        import jax
+        fn = self._verify_progs.get(sc)
+        if fn is None:
+            fn = jax.jit(self._make_verify_prefill(sc),
+                         donate_argnums=tuple(
+                             range(9, 9 + self._n_pool)))
+            self._verify_progs[sc] = fn
         return fn
 
     def _reset(self):
@@ -408,11 +522,22 @@ class DecodeEngine:
         if self.paged:
             from .paged_cache import BlockAllocator
             from .prefix_cache import PrefixCache
+            pool_dtype = jnp.int8 if self._kv_q else self._cache_dtype
             self._kp = jnp.zeros((self._L, self.n_blocks,
                                   self.block_size, self._kvh,
-                                  self._hd), self._cache_dtype)
+                                  self._hd), pool_dtype)
             self._vp = jnp.zeros_like(self._kp)
+            if self._kv_q:
+                from ..kernels.paged_attention import KV_SCALE_EPS
+                self._kscale = jnp.full(
+                    (self._L, self.n_blocks, self._kvh),
+                    KV_SCALE_EPS, jnp.float32)
+                self._vscale = jnp.full_like(self._kscale,
+                                             KV_SCALE_EPS)
             self._alloc = BlockAllocator(self.n_blocks)
+            # int8: recycled pages must drop the previous tenant's
+            # running-max scale before their next write
+            self._alloc.track_allocations = self._kv_q
             self._cache = PrefixCache(self._alloc, self.block_size,
                                       listener=self._prefix_listener) \
                 if self._prefix_on else None
@@ -426,6 +551,39 @@ class DecodeEngine:
             self._pad = _np.zeros((B,), _np.int32)
         self._tok = _np.zeros((B,), _np.int32)
         self._rows = [None] * B         # per-slot host state
+
+    # -- pool plumbing (ISSUE 8) --------------------------------------------
+    def _pool(self):
+        """The device arrays every paged program takes LAST: (kp, vp)
+        for fp pools, (kp, vp, kscale, vscale) for int8."""
+        if self._kv_q:
+            return (self._kp, self._vp, self._kscale, self._vscale)
+        return (self._kp, self._vp)
+
+    def _set_pool(self, vals):
+        if self._kv_q:
+            self._kp, self._vp, self._kscale, self._vscale = vals
+        else:
+            self._kp, self._vp = vals
+
+    def _drain_scale_resets(self):
+        """int8 only: reset the scales of pages the allocator handed
+        out since the last drain back to the eps floor. A recycled page
+        keeps its codes (garbage until overwritten, masked by lens) but
+        must NOT keep the previous tenant's running-max scale — scales
+        only grow, so a stale one would permanently coarsen every new
+        row quantized into the page. Runs BEFORE any program that
+        writes KV (and before COW, so a copied scale isn't clobbered)."""
+        if not self._kv_q:
+            return
+        dirty = self._alloc.drain_allocated()
+        if not dirty:
+            return
+        import jax.numpy as jnp
+        from ..kernels.paged_attention import KV_SCALE_EPS
+        idx = jnp.asarray(dirty, jnp.int32)
+        self._kscale = self._kscale.at[:, idx].set(KV_SCALE_EPS)
+        self._vscale = self._vscale.at[:, idx].set(KV_SCALE_EPS)
 
     # -- engine loop pieces -------------------------------------------------
     def _no_rows(self) -> bool:
@@ -485,6 +643,20 @@ class DecodeEngine:
             s["prefill_chunks"] = int(self._c_prefill_chunks.value)
             if self._cache is not None:
                 s["prefix_cache"] = self._cache.stats()
+            if self.spec_decode:
+                prop = int(self._c_spec_proposed.value)
+                acc = int(self._c_spec_accepted.value)
+                steps = int(self._h_spec_accept.count)
+                s["spec"] = {
+                    "proposed": prop,
+                    "accepted": acc,
+                    "accept_rate": acc / prop if prop else 0.0,
+                    "verify_steps": steps,
+                    # emitted per verify step (accepted run INCLUDES the
+                    # free base token, so this floors at 1.0)
+                    "tokens_per_step":
+                        self._h_spec_accept.sum / steps if steps else 0.0,
+                }
         return s
 
     # -- lifecycle telemetry (ISSUE 3) --------------------------------------
@@ -904,33 +1076,35 @@ class DecodeEngine:
         allp = (m.pages if m is not None else []) + pages
         table_row[:len(allp)] = allp
         st, embed, fnorm, lm = self._weights()
+        self._drain_scale_resets()
         if cached == 0:
             ids = _np.full((1, self.s_max), self.pad_id, _np.int32)
             ids[0, self.s_max - ns:] = seq
             pad = self.s_max - ns
-            first, self._kp, self._vp = self._prefill(
+            first, *pool = self._prefill(
                 st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
-                jnp.asarray([pad], jnp.int32), self._kp, self._vp,
-                jnp.asarray(table_row))
+                jnp.asarray([pad], jnp.int32), jnp.asarray(table_row),
+                *self._pool())
+            self._set_pool(pool)
         else:
             if m.cow_src is not None:
                 # private copy of the partially-shared page: the tail's
                 # first write lands mid-page at position ``cached``
-                self._kp, self._vp = self._cow(
-                    self._kp, self._vp,
+                self._set_pool(self._cow(
                     jnp.asarray(m.cow_src, jnp.int32),
-                    jnp.asarray(pages[0], jnp.int32))
+                    jnp.asarray(pages[0], jnp.int32), *self._pool()))
                 self._cache.release_cow(m)
             tail = seq[cached:]
             sc = self._bucket_window(tail.size)
             ids = _np.full((1, sc), self.pad_id, _np.int32)
             ids[0, sc - tail.size:] = tail
             pad = sc - tail.size
-            first, self._kp, self._vp = self._prefix_prefill_for(sc)(
+            first, *pool = self._prefix_prefill_for(sc)(
                 st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
                 jnp.asarray([pad], jnp.int32),
-                jnp.asarray([cached], jnp.int32), self._kp, self._vp,
-                jnp.asarray(table_row))
+                jnp.asarray([cached], jnp.int32),
+                jnp.asarray(table_row), *self._pool())
+            self._set_pool(pool)
         self._tables[slot] = table_row
         return int(first[0])
 
@@ -948,13 +1122,13 @@ class DecodeEngine:
         import jax.numpy as jnp
         import numpy as _np
         cached = m.cached_len if m is not None else 0
+        self._drain_scale_resets()      # before COW: keep copied scales
         if m is not None and m.cow_src is not None:
             with RecordEvent("engine.prefill", "engine",
                              worker=self.worker_id):
-                self._kp, self._vp = self._cow(
-                    self._kp, self._vp,
+                self._set_pool(self._cow(
                     jnp.asarray(m.cow_src, jnp.int32),
-                    jnp.asarray(pages[0], jnp.int32))
+                    jnp.asarray(pages[0], jnp.int32), *self._pool()))
             self._cache.release_cow(m)
         all_pages = (m.pages if m is not None else []) + pages
         table_row = _np.zeros((self._max_blocks,), _np.int32)
@@ -1018,13 +1192,15 @@ class DecodeEngine:
         ids[0, sc - tail.size:] = tail
         pad = sc - tail.size
         st, embed, fnorm, lm = self._weights()
+        self._drain_scale_resets()
         with RecordEvent("engine.prefill_chunk", "engine",
                          worker=self.worker_id):
-            first, self._kp, self._vp = self._prefix_prefill_for(sc)(
+            first, *pool = self._prefix_prefill_for(sc)(
                 st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
                 jnp.asarray([pad], jnp.int32),
-                jnp.asarray([pos], jnp.int32), self._kp, self._vp,
-                jnp.asarray(row["pf_table"]))
+                jnp.asarray([pos], jnp.int32),
+                jnp.asarray(row["pf_table"]), *self._pool())
+            self._set_pool(pool)
         row["pf_pos"] = pos + tail.size
         self._c_prefill_chunks.inc()
         _tmark(req, "prefill_chunk", worker=self.worker_id)
@@ -1057,6 +1233,8 @@ class DecodeEngine:
         if self.idle():
             return 0
         if self.paged:
+            if self.spec_decode:
+                return self._decode_once_spec()
             return self._decode_once_paged()
         steps = self.chunk
         if self._g + steps > self.s_max:
@@ -1103,10 +1281,12 @@ class DecodeEngine:
         for slot, row in enumerate(self._rows):
             if row is None:
                 continue
+            emitted_before = len(row["toks"])
             row["toks"].extend(int(t) for t in toks[:, slot])
             self._tok[slot] = int(toks[-1, slot])
             req = row["req"]
-            _tmark(req, "decode_chunk", worker=self.worker_id)
+            _tmark(req, "decode_chunk", worker=self.worker_id,
+                   n_tokens=min(steps, req.max_new - emitted_before))
             if len(row["toks"]) >= req.max_new:
                 req.result = _np.concatenate(
                     [row["prompt"],
@@ -1253,12 +1433,14 @@ class DecodeEngine:
                 r is not None and "pf_seq" not in r for r in self._rows):
             return sum(r is not None for r in self._rows)
         st, embed, fnorm, lm = self._weights()
+        self._drain_scale_resets()
         t0 = _now()
         with RecordEvent("engine.decode_chunk", "engine", worker=self.worker_id):
-            toks, self._kp, self._vp = self._decode(
+            toks, *pool = self._decode(
                 st, embed, fnorm, lm, self._scales,
-                jnp.asarray(self._tok), self._kp, self._vp,
-                jnp.asarray(self._tables), jnp.asarray(self._lens))
+                jnp.asarray(self._tok), jnp.asarray(self._tables),
+                jnp.asarray(self._lens), *self._pool())
+            self._set_pool(pool)
             toks = _np.asarray(toks)    # [chunk, B] (fetch = sync)
         wall = _now() - t0
         self.device_steps += self.chunk
@@ -1283,12 +1465,13 @@ class DecodeEngine:
             row["toks"].extend(int(t) for t in toks[:, slot])
             self._tok[slot] = int(toks[-1, slot])
             req = row["req"]
-            _tmark(req, "decode_chunk", worker=self.worker_id)
+            useful = min(self.chunk, req.max_new - emitted_before)
+            _tmark(req, "decode_chunk", worker=self.worker_id,
+                   n_tokens=useful)
             # fair-share: the tenant pays for the USEFUL tokens this
             # chunk produced (overshoot past max_new is engine padding,
             # not tenant work)
-            self._qos_charge(
-                req, min(self.chunk, req.max_new - emitted_before))
+            self._qos_charge(req, useful)
             if len(row["toks"]) >= req.max_new:
                 req.result = _np.concatenate(
                     [row["prompt"],
@@ -1302,6 +1485,201 @@ class DecodeEngine:
                 self._lens[slot] += self.chunk
                 alive += 1
         return alive
+
+    # -- self-speculative decoding (ISSUE 8 tentpole) -----------------------
+    def _draft_for(self, slot, row):
+        """Draft tokens for one decode-ready row from its OWN history
+        (prompt + emitted tokens, the last being the pending next
+        input), clamped so the verify step can never emit past the
+        request's max_new (at most k+1 emissions) nor write KV past
+        s_max (k+1 writes at positions lens..lens+k)."""
+        import numpy as _np
+        req = row["req"]
+        limit = min(req.max_new - len(row["toks"]) - 1,
+                    self.s_max - int(self._lens[slot]) - 1)
+        if limit <= 0:
+            return _np.zeros((0,), _np.int32)
+        ctx = _np.concatenate(
+            [row["prompt"], _np.asarray(row["toks"], _np.int32)])
+        return self._drafter.propose(ctx, limit=limit)
+
+    def _decode_once_spec(self):
+        """One SPECULATIVE engine step (ISSUE 8 tentpole): every
+        decode-ready row drafts k tokens from its own history, verifies
+        all of them in ONE bucketed position-offset prefill (the
+        pending token + drafts at ``prefix_len = tokens-resident``) and
+        accepts the longest argmax-matching prefix — 1..k+1 tokens per
+        row per step, bit-identical to plain greedy decode because
+        every accepted token IS the verify program's argmax. Rejected
+        drafts roll back implicitly: ``lens`` advances only past the
+        accepted positions, so their stale KV is masked out and simply
+        re-written when the cursor reaches them (no COW churn).
+
+        Chunked-prefill interplay mirrors _decode_once_paged: decode
+        lanes force-charge their verify tokens (k+1 — the step budget
+        pays for PROPOSED work) first, prefill chunks spend the
+        remainder, and rows whose last chunk lands this step verify
+        too. Tenants, by contrast, are charged for ACCEPTED tokens only
+        (inside _verify_row)."""
+        drafts = {}
+        if self.chunked_prefill:
+            from .scheduler import StepBudget
+            budget = StepBudget(self.step_budget)
+            for slot, row in enumerate(self._rows):
+                if row is not None and "pf_seq" not in row:
+                    d = self._draft_for(slot, row)
+                    drafts[slot] = d
+                    budget.take(d.size + 1, force=True)
+            self._run_prefill_chunks(budget)
+            for slot, row in enumerate(self._rows):
+                if row is not None and "pf_seq" not in row \
+                        and slot not in drafts:
+                    d = self._draft_for(slot, row)
+                    drafts[slot] = d
+                    budget.take(d.size + 1, force=True)
+            self._h_budget.observe(budget.used)
+        self._g_occupancy.set(sum(r is not None for r in self._rows))
+        alive = 0
+        for slot in range(self.capacity):
+            row = self._rows[slot]
+            if row is None:
+                continue
+            if "pf_seq" in row:
+                alive += 1          # mid-prefill: alive, not decoding
+                continue
+            d = drafts.get(slot)
+            if d is None:
+                # drafted lazily: either spec without chunked prefill,
+                # or the row's draft map entry predates a preemption
+                d = self._draft_for(slot, row)
+            try:
+                self._verify_row(slot, row, d)
+            except Exception as e:  # noqa: BLE001 — fail THIS request,
+                if self._rows[slot] is row:  # not the whole engine
+                    self._fail_row_paged(slot, e)
+                continue
+            if self._rows[slot] is not None:
+                alive += 1
+        return alive
+
+    def _verify_row(self, slot, row, draft):
+        """Grow, verify, and accept for ONE row (one device step).
+
+        The verify window is ``[pending_tok, d1..dk]`` right-aligned in
+        a bucketed ``sc`` window at ``prefix_len = lens``; the program
+        returns the greedy argmax at every position. Acceptance walks
+        the chain: position i's argmax is the TRUE next token iff every
+        earlier draft matched, so the emitted run is exactly what k+1
+        plain decode steps would have produced. State update preserves
+        the resident invariant (resident == prompt + toks[:-1], length
+        == lens): toks grows by the accepted run, lens by its length,
+        and the new pending input is the run's last token.
+
+        Preempt-mid-verify safety: page growth may preempt OTHER rows
+        (``exclude=slot`` protects this one), and a row preempted
+        BETWEEN drafting and verifying is skipped by the caller's
+        ``self._rows[slot]`` re-check — it re-queues with its full
+        emitted history and resumes losslessly."""
+        import jax.numpy as jnp
+        import numpy as _np
+        bs = self.block_size
+        req = row["req"]
+        k = int(draft.size)
+        lens0 = int(self._lens[slot])
+        target = lens0 + k + 1
+        if target > self.s_max:
+            self._fail_row_paged(slot, RuntimeError(
+                f"row exceeds engine s_max={self.s_max} at length "
+                f"{lens0}"))
+            return
+        extra = -(-target // bs) - len(row["pages"])
+        if extra > 0:
+            pages = self._reclaim_allocate(extra, self._prio(req),
+                                           exclude=slot, claimant=req)
+            if pages is None and self.chunked_prefill:
+                # decode-complete growth outranks equal-or-lower
+                # priority mid-prefill rows (same anti-livelock rule as
+                # the plain path)
+                my_p = self._prio(req)
+                pf = [i for i, r in enumerate(self._rows)
+                      if r is not None and i != slot and "pf_seq" in r
+                      and self._prio(r["req"]) <= my_p]
+                pf.sort(key=lambda i: -self._rows[i]["req"]._sched_seq)
+                while pages is None and pf:
+                    v = pf.pop(0)
+                    evicted = int(self._rows[v]["pf_pos"])
+                    self._preempt_row(v)
+                    self._qos_charge(req, evicted)
+                    if self._cache is not None:
+                        self._evict_cached(extra - self._alloc.num_free)
+                    pages = self._alloc.allocate(extra)
+            if pages is None:
+                others = any(r is not None and i != slot
+                             for i, r in enumerate(self._rows))
+                if others and self._cache is not None:
+                    # lossless self-preemption (mirrors the plain path)
+                    self._preempt_row(slot)
+                    return
+                self._fail_row_paged(slot, RuntimeError(
+                    f"paged KV pool exhausted: needed {extra} more "
+                    f"pages, {self._alloc.num_free} free "
+                    f"(n_blocks={self.n_blocks}, bs={bs})"))
+                return
+            start = len(row["pages"])
+            row["pages"] = row["pages"] + pages
+            self._tables[slot, start:start + extra] = pages
+        st, embed, fnorm, lm = self._weights()
+        self._drain_scale_resets()
+        tail = _np.empty((k + 1,), _np.int32)
+        tail[0] = self._tok[slot]
+        tail[1:] = draft
+        sc = self._bucket_window(k + 1)
+        ids = _np.full((1, sc), self.pad_id, _np.int32)
+        ids[0, sc - (k + 1):] = tail
+        pad = sc - (k + 1)
+        t0 = _now()
+        with RecordEvent("engine.spec_verify", "engine",
+                         worker=self.worker_id):
+            preds, *pool = self._verify_prefill_for(sc)(
+                st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
+                jnp.asarray([pad], jnp.int32),
+                jnp.asarray([lens0], jnp.int32),
+                jnp.asarray(self._tables[slot]), *self._pool())
+            self._set_pool(pool)
+            preds = _np.asarray(preds)[0, pad:]  # [k+1] greedy chain
+        wall = _now() - t0
+        self.device_steps += 1
+        self._c_steps.inc(1)
+        self._h_chunk.observe(wall)
+        out = [int(preds[0])]
+        for i in range(k):
+            if int(draft[i]) != out[i]:
+                break
+            out.append(int(preds[i + 1]))
+        m_len = len(out)
+        self._c_spec_proposed.inc(k)
+        self._c_spec_accepted.inc(m_len - 1)
+        self._h_spec_accept.observe(m_len)
+        _tmark(req, "spec_verify", worker=self.worker_id)
+        row["toks"].extend(out)
+        self._tok[slot] = out[-1]
+        # the draft clamp guarantees len(toks) never passes max_new, so
+        # every accepted token is useful — the tenant pays for exactly
+        # what it got, never for rejected speculation
+        _tmark(req, "decode_chunk", worker=self.worker_id,
+               n_tokens=m_len)
+        self._qos_charge(req, m_len)
+        if len(row["toks"]) >= req.max_new:
+            req.result = _np.concatenate(
+                [row["prompt"],
+                 _np.asarray(row["toks"][:req.max_new], _np.int32)])
+            self._retire_paged(slot)      # pages free for next admit
+            req.event.set()
+            if self.qos is not None:
+                from .qos import tenant_of
+                self.qos.note_served(tenant_of(req), req.max_new)
+        else:
+            self._lens[slot] = lens0 + m_len
 
 
 class GenerationPredictor:

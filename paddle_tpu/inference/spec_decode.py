@@ -1,0 +1,95 @@
+"""Self-speculative drafting for the paged DecodeEngine (ISSUE 8
+tentpole; reference shape: prompt-lookup decoding / vLLM's ngram
+speculative proposer — no second model, draft tokens come from
+matching the request's OWN prompt + output history).
+
+The drafter is the cheap half of verify-k speculation: given the token
+sequence the engine is about to extend (prompt + every emitted token,
+INCLUDING the pending next-input token at the end), it proposes up to
+``max_draft`` continuation tokens by finding the most recent earlier
+occurrence of the sequence's current n-gram suffix and copying the
+tokens that followed it. The engine then verifies all k proposals in
+ONE position-offset prefill step and accepts the longest prefix whose
+argmax chain matches greedy decode — so the drafter can never change
+OUTPUTS, only the number of device steps they cost. A bad draft costs
+one wasted verify slot; a good one turns k+1 tokens per step.
+
+Determinism contract: ``propose`` is a pure function of its arguments
+(longest n-gram first, most recent match wins, no RNG), so the engine's
+step sequence — and therefore every QoS/accounting counter — replays
+bit-for-bit for a fixed workload. Timing never enters the decision;
+this module must stay clean under tests/test_no_adhoc_timers.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["NgramDrafter"]
+
+
+class NgramDrafter:
+    """Prompt-lookup n-gram proposer over a request's own history.
+
+    ``max_ngram``..``min_ngram`` is the suffix-match ladder: longer
+    suffixes are tried first (a longer match is stronger evidence the
+    history is repeating), and within one length the MOST RECENT earlier
+    occurrence wins (recent repetition predicts the immediate future
+    better than distant repetition). No match at any length proposes
+    nothing — the engine's verify step then degenerates to a plain
+    single-token decode."""
+
+    def __init__(self, max_draft: int = 4, max_ngram: int = 3,
+                 min_ngram: int = 1):
+        if max_draft < 0:
+            raise ValueError(f"max_draft={max_draft}")
+        if not (1 <= min_ngram <= max_ngram):
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"min_ngram={min_ngram} max_ngram={max_ngram}")
+        self.max_draft = int(max_draft)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, context, limit: int | None = None) -> np.ndarray:
+        """Draft up to ``min(limit, max_draft)`` tokens continuing
+        ``context`` (1-D int array: prompt + emitted tokens, the last
+        entry being the engine's pending next-input token). The engine
+        passes ``limit = max_new - emitted - 1`` so a draft can never
+        propose past the request's token budget — the verify step emits
+        at most ``len(draft) + 1`` tokens. Returns an int32 array,
+        possibly empty."""
+        ctx = np.asarray(context).reshape(-1).astype(np.int64)
+        cap = self.max_draft if limit is None \
+            else min(self.max_draft, int(limit))
+        n_ctx = ctx.size
+        if cap <= 0 or n_ctx < self.min_ngram + 1:
+            return np.zeros((0,), np.int32)
+        for n in range(min(self.max_ngram, n_ctx - 1),
+                       self.min_ngram - 1, -1):
+            suffix = ctx[n_ctx - n:]
+            # candidate starts i with i+n < n_ctx: the match must have
+            # at least one following token to copy; scan from the most
+            # recent candidate backwards
+            windows = np.lib.stride_tricks.sliding_window_view(
+                ctx[:-1], n)                       # [n_ctx - n, n]
+            hits = np.flatnonzero((windows == suffix).all(axis=1))
+            # drop the trivial self-match at the very end (i == n_ctx-n
+            # would have zero following tokens and is excluded already
+            # by the ctx[:-1] window base)
+            if hits.size == 0:
+                continue
+            # most recent match that can supply a FULL-length draft;
+            # when every match sits too close to the end (periodic
+            # tails), the earliest match maximizes the continuation
+            full = hits[hits + n + cap <= n_ctx]
+            i = int(full[-1]) if full.size else int(hits[0])
+            out = ctx[i + n:i + n + cap]
+            if out.size:
+                return out.astype(np.int32)
+        return np.zeros((0,), np.int32)
+
+    def __repr__(self):
+        return (f"NgramDrafter(max_draft={self.max_draft}, "
+                f"max_ngram={self.max_ngram}, "
+                f"min_ngram={self.min_ngram})")

@@ -38,6 +38,21 @@ query at position len-1) and prefill-chunk rows (q_len queries at an
 arbitrary position offset, causal within the chunk, attending to all
 previously-written pages) side by side — the ragged-row shape chunked
 prefill schedules into every decode step.
+
+ISSUE 8 adds int8 PAGE READS: the block pool may store K/V as int8
+with one f32 scale per (page, kv head) living beside the pool
+(``kv_scales=(kscale, vscale)``, each [N, kvh]), dequantized INSIDE
+the attention program — the r6 weight-dequant-inside-the-kernel recipe
+applied to the KV stream, halving the bytes a decode step moves.
+The int8 XLA reference (:func:`_paged_attn_reference_int8`) is a
+block-looped online softmax built from the SAME
+:func:`_int8_block_update` helper the Pallas kernel body calls, so the
+interpret-mode kernel and the reference execute the identical op
+sequence on identical data and agree BIT-exactly — the parity
+contract the int8 tests pin. A verify chunk (self-speculative
+decoding's k-draft scoring step) is just a mixed-launch row whose
+``q_len`` is the draft length + 1; :func:`verify_chunk_scores` is that
+entry, spelled out.
 """
 
 from __future__ import annotations
@@ -57,11 +72,17 @@ except Exception:  # noqa: BLE001
 
 __all__ = ["paged_decode_attention", "paged_attention_pallas",
            "mixed_paged_attention", "mixed_attention_pallas",
-           "NULL_PAGE"]
+           "verify_chunk_scores", "gather_pages_dequant",
+           "KV_SCALE_EPS", "NULL_PAGE"]
 
 #: page id 0 is never allocated: padded block-table entries and
 #: inactive rows read/write it, keeping every program shape-static.
 NULL_PAGE = 0
+
+#: floor for the per-(page, kv head) int8 scales: an unwritten page
+#: dequantizes to exact zeros instead of dividing by zero, and the
+#: running-max scale update's old/new ratio stays finite.
+KV_SCALE_EPS = 1e-8
 
 _NEG_INF = -1e30
 
@@ -134,11 +155,98 @@ def _paged_kernel(tables, lens, q_ref, k_hbm, v_hbm, o_ref, k_s, v_s,
         o_ref.dtype)
 
 
+def _int8_block_update(q, kc, vc, ks, vs, m, l, acc, k_ids, n,
+                       sm_scale):
+    """ONE page of the int8 online softmax: dequantize the page's
+    K/V codes with their per-(page, kv head) scales, fold the page into
+    the running (m, l, acc) state. This helper is the WHOLE math of an
+    int8 block — the Pallas kernel body and the XLA reference both call
+    it, so interpret mode and the reference execute the identical op
+    sequence and agree bit-exactly.
+
+    q [G, hd] f32; kc/vc [bs, hd] int8 codes; ks/vs scalar f32 scales;
+    k_ids [G, bs] absolute key positions; n scalar row length."""
+    k = kc.astype(jnp.float32) * ks
+    v = vc.astype(jnp.float32) * vs
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale      # [G, bs]
+    s = jnp.where(k_ids < n, s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[:, None])
+    p = jnp.where(k_ids < n, p, 0.0)
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + p.sum(axis=-1)
+    acc = acc * alpha[:, None] + jnp.dot(
+        p, v, preferred_element_type=jnp.float32)
+    return m_new, l, acc
+
+
+def _paged_kernel_int8(tables, lens, kscale, vscale, q_ref, k_hbm,
+                       v_hbm, o_ref, k_s, v_s, ksem, vsem, *, bs,
+                       scale):
+    """int8 twin of :func:`_paged_kernel`: identical DMA structure, but
+    the streamed pages are int8 codes dequantized inside the program —
+    the scale arrays ride the scalar-prefetch lane beside the block
+    table, one f32 per (page, kv head)."""
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    q = q_ref[0, 0].astype(jnp.float32)               # [G, hd]
+    g, hd = q.shape
+
+    n = lens[b]
+    n_blk = jax.lax.div(n + bs - 1, bs)
+
+    def kdma(slot, j):
+        return pltpu.make_async_copy(
+            k_hbm.at[tables[b, j], :, h, :], k_s.at[slot], ksem.at[slot])
+
+    def vdma(slot, j):
+        return pltpu.make_async_copy(
+            v_hbm.at[tables[b, j], :, h, :], v_s.at[slot], vsem.at[slot])
+
+    m0 = jnp.full((g,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g,), jnp.float32)
+    acc0 = jnp.zeros((g, hd), jnp.float32)
+
+    @pl.when(n_blk > 0)
+    def _start():
+        kdma(0, 0).start()
+        vdma(0, 0).start()
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, 2)
+        nxt = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < n_blk)
+        def _prefetch():
+            kdma(nxt, j + 1).start()
+            vdma(nxt, j + 1).start()
+
+        kdma(slot, j).wait()
+        vdma(slot, j).wait()
+        k_ids = j * bs + jax.lax.broadcasted_iota(jnp.int32, (g, bs), 1)
+        return _int8_block_update(
+            q, k_s[slot], v_s[slot], kscale[tables[b, j], h],
+            vscale[tables[b, j], h], m, l, acc, k_ids, n, scale)
+
+    m, l, acc = jax.lax.fori_loop(0, n_blk, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(
+        o_ref.dtype)
+
+
 def paged_attention_pallas(q, k_pages, v_pages, block_table, seq_lens,
-                           interpret=False):
+                           interpret=False, kv_scales=None):
     """Raw Pallas launch. q [B, kvh, G, hd]; k/v_pages [N, bs, kvh, hd];
     block_table [B, max_blocks] int32; seq_lens [B] int32. Returns
-    [B, kvh, G, hd] f32."""
+    [B, kvh, G, hd] f32. ``kv_scales=(kscale, vscale)`` ([N, kvh] f32
+    each) switches to the int8 kernel: the pools hold int8 codes,
+    dequantized inside the program."""
+    if kv_scales is not None:
+        return _paged_attention_pallas_int8(
+            q, k_pages, v_pages, block_table, seq_lens, kv_scales,
+            interpret=interpret)
     B, kvh, G, hd = q.shape
     bs = k_pages.shape[1]
     scale = 1.0 / (hd ** 0.5)
@@ -169,6 +277,44 @@ def paged_attention_pallas(q, k_pages, v_pages, block_table, seq_lens,
       jnp.asarray(seq_lens, jnp.int32), q, k_pages, v_pages)
 
 
+def _paged_attention_pallas_int8(q, k_pages, v_pages, block_table,
+                                 seq_lens, kv_scales, interpret=False):
+    """int8 launch: pools are int8 codes, ``kv_scales=(kscale, vscale)``
+    ([N, kvh] f32 each) ride the scalar-prefetch lane beside the block
+    table so every program can read its pages' scales from SMEM."""
+    kscale, vscale = kv_scales
+    B, kvh, G, hd = q.shape
+    bs = k_pages.shape[1]
+    scale = 1.0 / (hd ** 0.5)
+    kernel = functools.partial(_paged_kernel_int8, bs=bs, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B, kvh),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, hd), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, hd),
+                               lambda b, h, *_: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, bs, hd), k_pages.dtype),
+            pltpu.VMEM((2, bs, hd), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, kvh, G, hd), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(block_table, jnp.int32),
+      jnp.asarray(seq_lens, jnp.int32),
+      jnp.asarray(kscale, jnp.float32),
+      jnp.asarray(vscale, jnp.float32), q, k_pages, v_pages)
+
+
 # ---------------------------------------------------------------------------
 # XLA reference / fallback
 # ---------------------------------------------------------------------------
@@ -180,6 +326,22 @@ def gather_pages(pages, block_table):
     B, mb = block_table.shape
     bs = pages.shape[1]
     g = jnp.take(pages, block_table.reshape(-1), axis=0)
+    return g.reshape(B, mb * bs, *pages.shape[2:])
+
+
+def gather_pages_dequant(pages, block_table, scales):
+    """int8 counterpart of :func:`gather_pages`: gather code pages AND
+    their per-(page, kv head) scales, dequantize to f32. pages
+    [N, bs, kvh, hd] int8; scales [N, kvh] f32. Returns
+    [B, max_blocks*bs, kvh, hd] f32 (NULL-page tail dequantizes with
+    whatever scale page 0 carries — masked out by seq_lens downstream
+    exactly like the fp gather)."""
+    B, mb = block_table.shape
+    bs = pages.shape[1]
+    flat = block_table.reshape(-1)
+    g = jnp.take(pages, flat, axis=0).astype(jnp.float32)
+    sc = jnp.take(scales, flat, axis=0)            # [B*mb, kvh]
+    g = g * sc[:, None, :, None]
     return g.reshape(B, mb * bs, *pages.shape[2:])
 
 
@@ -201,12 +363,74 @@ def _paged_attn_reference(q, k_pages, v_pages, block_table, seq_lens):
     return jnp.einsum("bngt,btnd->bngd", p, cv.astype(jnp.float32))
 
 
-def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens):
+def _paged_attn_reference_int8(q, k_pages, v_pages, block_table,
+                               seq_lens, kv_scales):
+    """int8 XLA reference: a BLOCK-LOOPED online softmax, deliberately
+    NOT the single-softmax gather shape of
+    :func:`_paged_attn_reference`. Each (row, kv head) cell walks its
+    page list through :func:`_int8_block_update` — the same helper the
+    Pallas kernel body calls — so the interpret-mode kernel and this
+    reference execute the identical op sequence on identical data and
+    agree bit-exactly. B and kvh are static (shape-derived), so the
+    python loops unroll at trace time; the per-cell page walk is a
+    traced fori_loop over the row's ragged page count."""
+    kscale = jnp.asarray(kv_scales[0], jnp.float32)
+    vscale = jnp.asarray(kv_scales[1], jnp.float32)
+    k_pages = jnp.asarray(k_pages)
+    v_pages = jnp.asarray(v_pages)
+    B, kvh, G, hd = q.shape
+    bs = k_pages.shape[1]
+    scale = 1.0 / (hd ** 0.5)
+    tables = jnp.asarray(block_table, jnp.int32)
+    lens = jnp.asarray(seq_lens, jnp.int32)
+    rows = []
+    for b in range(B):
+        n = lens[b]
+        n_blk = jax.lax.div(n + bs - 1, bs)
+        heads = []
+        for h in range(kvh):
+            qc = q[b, h].astype(jnp.float32)       # [G, hd]
+
+            def body(j, carry, b=b, h=h, qc=qc, n=n):
+                m, l, acc = carry
+                page = tables[b, j]
+                kc = jax.lax.dynamic_index_in_dim(
+                    k_pages, page, 0, keepdims=False)[:, h, :]
+                vc = jax.lax.dynamic_index_in_dim(
+                    v_pages, page, 0, keepdims=False)[:, h, :]
+                k_ids = j * bs + jax.lax.broadcasted_iota(
+                    jnp.int32, (G, bs), 1)
+                return _int8_block_update(
+                    qc, kc, vc, kscale[page, h], vscale[page, h],
+                    m, l, acc, k_ids, n, scale)
+
+            m0 = jnp.full((G,), _NEG_INF, jnp.float32)
+            l0 = jnp.zeros((G,), jnp.float32)
+            acc0 = jnp.zeros((G, hd), jnp.float32)
+            m, l, acc = jax.lax.fori_loop(0, n_blk, body,
+                                          (m0, l0, acc0))
+            heads.append(acc / jnp.maximum(l, 1e-30)[:, None])
+        rows.append(jnp.stack(heads))
+    return jnp.stack(rows)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens,
+                           kv_scales=None):
     """Entry used by the llama paged decode step: the Pallas kernel on
     TPU when the block pool is tileable, else the XLA gather reference
     (CPU tests pin the reference's bit-parity with the contiguous
-    path; the kernel's own parity is pinned in interpret mode)."""
+    path; the kernel's own parity is pinned in interpret mode).
+    ``kv_scales`` switches to the int8 path — the TPU gate tightens to
+    the int8 minimum tile (bs % 32, hd % 128)."""
     bs, hd = k_pages.shape[1], k_pages.shape[3]
+    if kv_scales is not None:
+        if (_HAS_PLTPU and jax.default_backend() == "tpu"
+                and hd % 128 == 0 and bs % 32 == 0):
+            return paged_attention_pallas(
+                q, k_pages, v_pages, block_table, seq_lens,
+                kv_scales=kv_scales)
+        return _paged_attn_reference_int8(
+            q, k_pages, v_pages, block_table, seq_lens, kv_scales)
     if (_HAS_PLTPU and jax.default_backend() == "tpu"
             and hd % 128 == 0 and bs % 8 == 0):
         return paged_attention_pallas(q, k_pages, v_pages, block_table,
@@ -346,14 +570,19 @@ def mixed_attention_pallas(q, k_pages, v_pages, block_table, kv_lens,
 
 
 def _mixed_attn_reference(q, k_pages, v_pages, block_table, kv_lens,
-                          q_lens):
+                          q_lens, kv_scales=None):
     """Gather-then-masked-softmax over the per-query causal mask — the
     mixed counterpart of `_paged_attn_reference` (same exact-zeros
     masking, so a q_lens=1 launch is the decode math). Rows with no
     attendable position (kv_len 0) output exact zeros, matching the
-    kernel's l=0 branch."""
-    ck = gather_pages(k_pages, block_table)     # [B, S, kvh, hd]
-    cv = gather_pages(v_pages, block_table)
+    kernel's l=0 branch. ``kv_scales`` dequantizes int8 pools on
+    gather."""
+    if kv_scales is not None:
+        ck = gather_pages_dequant(k_pages, block_table, kv_scales[0])
+        cv = gather_pages_dequant(v_pages, block_table, kv_scales[1])
+    else:
+        ck = gather_pages(k_pages, block_table)  # [B, S, kvh, hd]
+        cv = gather_pages(v_pages, block_table)
     T = q.shape[1]
     s_tot = ck.shape[1]
     pos = (kv_lens[:, None] - q_lens[:, None]
@@ -371,16 +600,42 @@ def _mixed_attn_reference(q, k_pages, v_pages, block_table, kv_lens,
 
 
 def mixed_paged_attention(q, k_pages, v_pages, block_table, kv_lens,
-                          q_lens):
+                          q_lens, kv_scales=None):
     """Entry for mixed prefill-chunk + decode launches: the Pallas
     kernel on TPU when the pool is tileable, else the XLA gather
     reference (the kernel's parity is pinned in interpret mode; the
     serving engine's CPU chunk path rides the bucketed prefix-prefill
-    programs, whose bit-parity the r7 tests pin)."""
+    programs, whose bit-parity the r7 tests pin). int8 pools
+    (``kv_scales`` given) always take the gather reference — the mixed
+    int8 kernel is the per-page fp8 follow-on's problem, and decode
+    steps (the bandwidth-bound path ISSUE 8 targets) never come through
+    here."""
     bs, hd = k_pages.shape[1], k_pages.shape[3]
+    if kv_scales is not None:
+        return _mixed_attn_reference(q, k_pages, v_pages, block_table,
+                                     kv_lens, q_lens, kv_scales)
     if (_HAS_PLTPU and jax.default_backend() == "tpu"
             and hd % 128 == 0 and bs % 8 == 0):
         return mixed_attention_pallas(q, k_pages, v_pages, block_table,
                                       kv_lens, q_lens)
     return _mixed_attn_reference(q, k_pages, v_pages, block_table,
                                  kv_lens, q_lens)
+
+
+# ---------------------------------------------------------------------------
+# Verify-chunk scoring (ISSUE 8 tentpole)
+# ---------------------------------------------------------------------------
+
+def verify_chunk_scores(q, k_pages, v_pages, block_table, kv_lens,
+                        q_lens, kv_scales=None):
+    """Attention for a speculative VERIFY chunk: row b's q_lens[b]
+    query tokens are the pending next-input token plus its k drafts,
+    already scattered into the pool at absolute positions
+    ``kv_lens[b] - q_lens[b] .. kv_lens[b] - 1`` (scatter-then-attend,
+    the decode-step convention). This is exactly the mixed launch
+    contract — a verify chunk IS a prefill chunk whose tokens happen to
+    be guesses — so the wrapper just documents the shape and delegates;
+    query slots past q_lens[b] compute finite garbage the engine's
+    accept loop never reads."""
+    return mixed_paged_attention(q, k_pages, v_pages, block_table,
+                                 kv_lens, q_lens, kv_scales=kv_scales)

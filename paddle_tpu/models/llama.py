@@ -965,12 +965,43 @@ def _decode_step(cfg, stacked, embed, final_norm, lm_head, token, cache_k,
     return logits, cks, cvs
 
 
-def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens):
+def _quantized_token_insert(pool, scales, page, off, tok):
+    """Append ONE token per row into an int8 pool page with a
+    RUNNING-MAX per-(page, kv head) scale (ISSUE 8 int8 paged KV).
+
+    pool [N, bs, kvh, hd] int8 codes; scales [N, kvh] f32; page/off [b]
+    int32 write cursors; tok [b, kvh, hd] f32. The page's scale only
+    ever grows (``new = max(old, amax(tok)/127)``), and the resident
+    codes are re-expressed in the new scale by ``round(q * old/new)`` —
+    when the token doesn't raise the max the ratio is exactly 1.0 and
+    ``round(q * 1.0) == q``, so untouched tokens keep their codes
+    bit-identical (the no-op case every step but the occasional
+    outlier). Inactive rows write the NULL page, same as the fp path."""
+    b = tok.shape[0]
+    amax = jnp.abs(tok).max(axis=-1)                     # [b, kvh]
+    old = jnp.take(scales, page, axis=0)                 # [b, kvh]
+    new = jnp.maximum(old, amax / 127.0)
+    codes = jnp.take(pool, page, axis=0)                 # [b, bs, kvh, hd]
+    ratio = (old / new)[:, None, :, None]
+    req = jnp.clip(jnp.round(codes.astype(jnp.float32) * ratio),
+                   -127, 127)
+    qt = jnp.clip(jnp.round(tok / new[:, :, None]), -127, 127)
+    req = req.at[jnp.arange(b), off].set(qt)
+    pool = pool.at[page].set(req.astype(pool.dtype))
+    scales = scales.at[page].set(new)
+    return pool, scales
+
+
+def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens,
+                             kscale=None, vscale=None):
     """One decoder layer for ONE token per row against the PAGED KV
     cache: kp/vp [N, bs, kvh, hd] block pool, tables [b, max_blocks]
     int32 page ids, lens [b] int32 = tokens already cached (the new
     token's 0-based position). No left-pad: every row's history starts
-    at its own position 0, so admission needs no global fill."""
+    at its own position 0, so admission needs no global fill. With
+    ``kscale``/``vscale`` ([N, kvh] f32) the pools are int8 codes:
+    writes go through :func:`_quantized_token_insert` and the attention
+    dequantizes inside the paged program."""
     hd = cfg.head_dim
     h = lp["wq"].shape[-1] // hd
     kvh = lp["wk"].shape[-1] // hd
@@ -996,10 +1027,19 @@ def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens):
     page = jnp.take_along_axis(tables, (lens // bs)[:, None],
                                axis=1)[:, 0]
     off = lens % bs
-    kp = kp.at[page, off].set(k[:, 0].astype(kp.dtype))
-    vp = vp.at[page, off].set(v[:, 0].astype(vp.dtype))
+    if kscale is not None:
+        kp, kscale = _quantized_token_insert(kp, kscale, page, off,
+                                             k[:, 0].astype(jnp.float32))
+        vp, vscale = _quantized_token_insert(vp, vscale, page, off,
+                                             v[:, 0].astype(jnp.float32))
+        kv_scales = (kscale, vscale)
+    else:
+        kp = kp.at[page, off].set(k[:, 0].astype(kp.dtype))
+        vp = vp.at[page, off].set(v[:, 0].astype(vp.dtype))
+        kv_scales = None
     qg = q[:, 0].reshape(b, kvh, g, hd)
-    attn = paged_decode_attention(qg, kp, vp, tables, lens + 1)
+    attn = paged_decode_attention(qg, kp, vp, tables, lens + 1,
+                                  kv_scales=kv_scales)
     attn = attn.astype(x.dtype).reshape(b, 1, h * hd)
     x = x + attn @ lp["wo"]
 
@@ -1011,37 +1051,87 @@ def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens):
     else:
         gate = jax.nn.silu(y @ lp["w_gate"])
         x = x + (gate * (y @ lp["w_up"])) @ lp["w_down"]
-    return x, kp, vp
+    return x, kp, vp, kscale, vscale
 
 
 def _paged_decode_step(cfg, stacked, embed, final_norm, lm_head, token,
-                       pages_k, pages_v, tables, lens):
+                       pages_k, pages_v, tables, lens, kscales=None,
+                       vscales=None):
     """Jittable paged single-token step: [b] token ids +
     [L, N, bs, kvh, hd] block pools + [b, max_blocks] tables + [b] lens
     -> (logits [b, V], updated pools). The tables/lens are DATA, so one
-    compiled program serves every admission pattern."""
+    compiled program serves every admission pattern. int8 pools thread
+    ``kscales``/``vscales`` [L, N, kvh] through the layer scan and the
+    return grows to (logits, kps, vps, kscales, vscales)."""
     x = jnp.take(embed, token, axis=0)[:, None, :]       # [b, 1, d]
 
-    def layer_fn(carry, xs):
-        lp, kp, vp = xs
-        out, kp, vp = _paged_decode_layer_step(cfg, lp, carry, kp, vp,
-                                               tables, lens)
-        return out, (kp, vp)
+    if kscales is None:
+        def layer_fn(carry, xs):
+            lp, kp, vp = xs
+            out, kp, vp, _, _ = _paged_decode_layer_step(
+                cfg, lp, carry, kp, vp, tables, lens)
+            return out, (kp, vp)
 
-    x, (kps, vps) = jax.lax.scan(layer_fn, x, (stacked, pages_k, pages_v))
+        x, (kps, vps) = jax.lax.scan(layer_fn, x,
+                                     (stacked, pages_k, pages_v))
+        x = _rms(x, final_norm, cfg.rms_norm_eps)
+        logits = (x[:, 0] @ lm_head).astype(jnp.float32)
+        return logits, kps, vps
+
+    def layer_fn(carry, xs):
+        lp, kp, vp, ksc, vsc = xs
+        out, kp, vp, ksc, vsc = _paged_decode_layer_step(
+            cfg, lp, carry, kp, vp, tables, lens, ksc, vsc)
+        return out, (kp, vp, ksc, vsc)
+
+    x, (kps, vps, kscales, vscales) = jax.lax.scan(
+        layer_fn, x, (stacked, pages_k, pages_v, kscales, vscales))
     x = _rms(x, final_norm, cfg.rms_norm_eps)
     logits = (x[:, 0] @ lm_head).astype(jnp.float32)
-    return logits, kps, vps
+    return logits, kps, vps, kscales, vscales
 
 
-def scatter_prefill_kv(kp, vp, ks, vs, table_row, pad, offset=0):
+def _quantized_prefill_scatter(pool, scales, toks, page, off, valid,
+                               table_row):
+    """int8 half of :func:`scatter_prefill_kv` for ONE pool. toks
+    [L, sp, kvh, hd] f32; page/off/valid [sp]; scales [L, N, kvh].
+    Scale update is a SCATTER-MAX (order-independent, so the multiple
+    tokens landing on one page update its scale deterministically),
+    then every page the row references is re-expressed in its new scale
+    — pages whose max didn't move get ratio exactly 1.0, i.e. their
+    codes survive bit-identical (this is what keeps SHARED prefix pages
+    unperturbed by a tail prefill: the tail never scatter-maxes into a
+    full shared page)."""
+    amax = jnp.where(valid[None, :, None],
+                     jnp.abs(toks).max(axis=-1), 0.0)    # [L, sp, kvh]
+    old_all = scales
+    scales = scales.at[:, page].max(amax / 127.0)
+    # re-express the row's resident codes in the grown scales
+    codes = jnp.take(pool, table_row, axis=1)    # [L, mb, bs, kvh, hd]
+    old = jnp.take(old_all, table_row, axis=1)           # [L, mb, kvh]
+    new = jnp.take(scales, table_row, axis=1)
+    ratio = (old / new)[:, :, None, :, None]
+    req = jnp.clip(jnp.round(codes.astype(jnp.float32) * ratio),
+                   -127, 127)
+    pool = pool.at[:, table_row].set(req.astype(pool.dtype))
+    # quantize the new tokens against their page's (post-max) scale
+    sc_tok = jnp.take(scales, page, axis=1)              # [L, sp, kvh]
+    qt = jnp.clip(jnp.round(toks / sc_tok[..., None]), -127, 127)
+    pool = pool.at[:, page, off].set(qt.astype(pool.dtype))
+    return pool, scales
+
+
+def scatter_prefill_kv(kp, vp, ks, vs, table_row, pad, offset=0,
+                       kv_scales=None):
     """Insert ONE row's prefill K/V into the block pools. ks/vs
     [L, 1, sp, kvh, hd] (right-aligned, ``pad`` left pads); table_row
     [max_blocks] int32. Pad positions are routed to the NULL page, so
     the scatter is shape-static. ``offset`` shifts the write positions
     by a cached-prefix length (prefix-hit admission: the tail's first
     real token lands at context position ``offset``, which may sit
-    mid-page inside the row's private COW copy)."""
+    mid-page inside the row's private COW copy). With
+    ``kv_scales=(kscale, vscale)`` ([L, N, kvh] f32) the pools are int8
+    codes and the return grows to (kp, vp, kscale, vscale)."""
     bs = kp.shape[2]
     sp = ks.shape[2]
     j = jnp.arange(sp)
@@ -1049,6 +1139,15 @@ def scatter_prefill_kv(kp, vp, ks, vs, table_row, pad, offset=0):
     valid = j >= pad
     page = jnp.where(valid, jnp.take(table_row, cpos // bs), 0)
     off = jnp.where(valid, cpos % bs, 0)
+    if kv_scales is not None:
+        kscale, vscale = kv_scales
+        kp, kscale = _quantized_prefill_scatter(
+            kp, kscale, ks[:, 0].astype(jnp.float32), page, off, valid,
+            table_row)
+        vp, vscale = _quantized_prefill_scatter(
+            vp, vscale, vs[:, 0].astype(jnp.float32), page, off, valid,
+            table_row)
+        return kp, vp, kscale, vscale
     kp = kp.at[:, page, off].set(ks[:, 0].astype(kp.dtype))
     vp = vp.at[:, page, off].set(vs[:, 0].astype(vp.dtype))
     return kp, vp
@@ -1194,7 +1293,7 @@ def _prefix_decoder_layer(cfg, lp, x, positions, key_mask, pk, pv,
 
 def prefix_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
                    pad_len, prefix_len, kp, vp, table_row,
-                   last_index=None):
+                   last_index=None, kv_scales=None, all_logits=False):
     """Position-offset prefill of an UNCACHED TAIL over a prefix already
     resident in the paged pool (prefix-hit admission, ISSUE 2).
 
@@ -1205,8 +1304,17 @@ def prefix_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
     gathers its prefix K/V through the table (stale positions masked
     with exact zeros), the tail attends over prefix + causal window,
     and the tail's K/V scatter into the pool at ``offset=prefix_len``.
-    Returns (last-real-position logits [1, V], kp, vp)."""
-    from ..kernels.paged_attention import gather_pages
+    Returns (last-real-position logits [1, V], kp, vp).
+
+    ``all_logits=True`` returns logits at EVERY window position
+    [1, sc, V] instead — the speculative VERIFY shape (ISSUE 8): the
+    tail is the pending token + k drafts, and the caller reads the
+    argmax chain off the last k+1 positions. ``kv_scales`` ([L, N, kvh]
+    f32 pair) switches the pools to int8 codes — gathers dequantize,
+    the final scatter quantizes — and appends the updated scales to the
+    return."""
+    from ..kernels.paged_attention import gather_pages, \
+        gather_pages_dequant
     b, sc = ids.shape
     bs = kp.shape[2]
     mb = table_row.shape[0]
@@ -1217,23 +1325,42 @@ def prefix_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
     prefix_mask = jnp.arange(mb * bs)[None, :] < prefix_len[:, None]
     x = jnp.take(embed, ids, axis=0)
 
-    def layer_fn(carry, xs):
-        lp, kpl, vpl = xs
-        pk = gather_pages(kpl, table_row[None, :]).astype(x.dtype)
-        pv = gather_pages(vpl, table_row[None, :]).astype(x.dtype)
-        out, k, v = _prefix_decoder_layer(cfg, lp, carry, positions,
-                                          key_mask, pk, pv, prefix_mask)
-        return out, (k, v)
+    if kv_scales is None:
+        def layer_fn(carry, xs):
+            lp, kpl, vpl = xs
+            pk = gather_pages(kpl, table_row[None, :]).astype(x.dtype)
+            pv = gather_pages(vpl, table_row[None, :]).astype(x.dtype)
+            out, k, v = _prefix_decoder_layer(
+                cfg, lp, carry, positions, key_mask, pk, pv,
+                prefix_mask)
+            return out, (k, v)
 
-    x, (ks, vs) = jax.lax.scan(layer_fn, x, (stacked, kp, vp))
+        x, (ks, vs) = jax.lax.scan(layer_fn, x, (stacked, kp, vp))
+    else:
+        def layer_fn(carry, xs):
+            lp, kpl, vpl, kscl, vscl = xs
+            pk = gather_pages_dequant(
+                kpl, table_row[None, :], kscl).astype(x.dtype)
+            pv = gather_pages_dequant(
+                vpl, table_row[None, :], vscl).astype(x.dtype)
+            out, k, v = _prefix_decoder_layer(
+                cfg, lp, carry, positions, key_mask, pk, pv,
+                prefix_mask)
+            return out, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(
+            layer_fn, x, (stacked, kp, vp, *kv_scales))
     x = _rms(x, final_norm, cfg.rms_norm_eps)
-    last = x[:, -1] if last_index is None else \
-        jax.lax.dynamic_index_in_dim(x, last_index, axis=1,
-                                     keepdims=False)
-    logits = (last @ lm_head).astype(jnp.float32)
-    kp, vp = scatter_prefill_kv(kp, vp, ks, vs, table_row, pad_len[0],
-                                offset=prefix_len[0])
-    return logits, kp, vp
+    if all_logits:
+        logits = (x @ lm_head).astype(jnp.float32)       # [1, sc, V]
+    else:
+        last = x[:, -1] if last_index is None else \
+            jax.lax.dynamic_index_in_dim(x, last_index, axis=1,
+                                         keepdims=False)
+        logits = (last @ lm_head).astype(jnp.float32)
+    out = scatter_prefill_kv(kp, vp, ks, vs, table_row, pad_len[0],
+                             offset=prefix_len[0], kv_scales=kv_scales)
+    return (logits, *out)
 
 
 def _generate_all(cfg, max_new_tokens, greedy, top_k, has_mask, stacked,

@@ -31,9 +31,12 @@ __all__ = ["RequestTrace", "TERMINAL_STATES", "LIFECYCLE_STATES"]
 #: lands, so derived TTFT spans admission → last-chunk first token,
 #: and ``mark_once`` keeps it the request's first ever across
 #: preemption/resume stints.
+#: ``spec_verify`` (ISSUE 8): one mark per speculative verify step —
+#: a decode step that scored k draft tokens; its ``decode_chunk``
+#: marks carry ``n_tokens`` so multi-token steps don't read as one.
 LIFECYCLE_STATES = ("arrival", "queued", "admitted", "prefill",
                     "prefill_chunk", "first_token", "decode_chunk",
-                    "preempted", "retired", "failed")
+                    "spec_verify", "preempted", "retired", "failed")
 TERMINAL_STATES = frozenset({"retired", "failed"})
 
 _ids = itertools.count(1)
@@ -57,7 +60,7 @@ class RequestTrace:
     parallel sparse map keyed by event index."""
 
     __slots__ = ("request_id", "trace_id", "tenant", "events", "attrs",
-                 "hops", "_event_workers")
+                 "hops", "_event_workers", "_event_tokens")
 
     def __init__(self, request_id=None, t=None, trace_id=None,
                  tenant=None):
@@ -73,15 +76,22 @@ class RequestTrace:
         self.attrs: dict = {}
         self.hops: list[dict] = []
         self._event_workers: dict[int, str] = {}
+        self._event_tokens: dict[int, int] = {}
 
     def mark(self, state: str, t: float | None = None,
-             worker: str | None = None) -> float:
+             worker: str | None = None,
+             n_tokens: int | None = None) -> float:
         """Append a transition; returns its timestamp. ``t`` overrides
         the clock (tests only); ``worker`` attributes the event to a
-        fleet worker lane."""
+        fleet worker lane; ``n_tokens`` records how many output tokens
+        the event emitted (ISSUE 8 satellite: a speculative verify step
+        emits 1..k+1 tokens per ``decode_chunk`` mark, so token-derived
+        metrics can no longer assume one per event)."""
         t = now() if t is None else t
         if worker is not None:
             self._event_workers[len(self.events)] = worker
+        if n_tokens is not None:
+            self._event_tokens[len(self.events)] = int(n_tokens)
         self.events.append((state, t))
         return t
 
@@ -207,6 +217,27 @@ class RequestTrace:
     def decode_chunks(self) -> int:
         return self.count("decode_chunk")
 
+    def tokens_of(self, index: int) -> int | None:
+        """Output tokens annotated on ``events[index]`` (None if the
+        event carries no annotation)."""
+        return self._event_tokens.get(index)
+
+    @property
+    def served_tokens(self) -> int:
+        """Output tokens actually emitted so far, derived from the
+        event annotations: annotated events contribute their
+        ``n_tokens``; an UNannotated ``decode_chunk`` keeps the r8
+        one-token reading so pre-ISSUE-8 traces (and the contiguous
+        engine's chunked marks, which annotate) stay comparable."""
+        total = 0
+        for i, (s, _) in enumerate(self.events):
+            n = self._event_tokens.get(i)
+            if n is not None:
+                total += n
+            elif s == "decode_chunk":
+                total += 1
+        return total
+
     # -- validation ---------------------------------------------------------
     def is_monotone(self) -> bool:
         """Timestamps never go backwards (append order == time order)."""
@@ -240,6 +271,7 @@ class RequestTrace:
             "queue_wait_s": self.queue_wait,
             "preemptions": self.preemptions,
             "decode_chunks": self.decode_chunks,
+            "served_tokens": self.served_tokens,
             "events": [(s, round(t, 6)) for s, t in self.events],
             "trace_id": self.trace_id,
             "worker_id": self.attrs.get("worker_id"),

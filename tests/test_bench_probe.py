@@ -60,6 +60,10 @@ def test_infra_skip_metric_follows_preset(monkeypatch, capsys):
     bench._emit_infra_skip("tunnel down")
     out = json.loads(capsys.readouterr().out.strip())
     assert out["metric"] == "mixed_p99_ttft_ms"
+    monkeypatch.setenv("BENCH_PRESET", "spec")
+    bench._emit_infra_skip("tunnel down")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "spec_tokens_per_step"
 
 
 @pytest.mark.slow
@@ -257,6 +261,45 @@ def test_mixed_preset_cpu_smoke(tmp_path):
     assert snap["counters"]["engine_prefill_chunks_total"] == \
         extra["prefill_chunks"]
     assert snap["histograms"]["engine_step_budget_used"]["count"] > 0
+
+
+@pytest.mark.slow
+def test_spec_preset_cpu_smoke(tmp_path):
+    """End-to-end CPU run of BENCH_PRESET=spec (ISSUE 8 satellite):
+    one JSON line; spec ON emits bit-identical outputs to plain greedy
+    on the same seeded prompt mix (the speculation oracle — every
+    accepted token is the verify program's argmax); the draft-friendly
+    repetitive mix earns at least 1.2 tokens per verify step; and the
+    accept accounting in the snapshot is self-consistent with the
+    BENCH row."""
+    env = dict(os.environ, BENCH_PRESET="spec",
+               BENCH_ALLOW_CPU="1", BENCH_NO_WALL="1",
+               BENCH_SKIP_PROBE="1", BENCH_METRICS_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, bench.__file__], env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1                         # one-JSON-line contract
+    out = json.loads(lines[0])
+    assert out["metric"] == "spec_tokens_per_step"
+    extra = out["extra"]
+    # the correctness oracle: speculation changes WHEN tokens are
+    # computed, never WHICH tokens come out
+    assert extra["outputs_identical"] is True
+    # the perf claim: drafts pay on the repetitive mix
+    assert out["value"] >= 1.2
+    assert 1.0 <= extra["tokens_per_step_mix"] <= out["value"] + 1e-9
+    assert 0.0 < extra["accept_rate_mix"] <= 1.0
+    assert extra["accepted"] <= extra["proposed"]
+    # deterministic accounting: the snapshot's counters back the row
+    snap = json.load(open(extra["metrics_snapshot"]))
+    assert snap["counters"]["engine_spec_proposed_total"] == \
+        extra["proposed"]
+    assert snap["counters"]["engine_spec_accepted_total"] == \
+        extra["accepted"]
+    assert snap["histograms"]["engine_spec_accept_len"]["count"] > 0
 
 
 def test_env_flag_tolerant(monkeypatch):
