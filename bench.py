@@ -48,6 +48,7 @@ _PRESET_METRICS = {
     "overload": "overload_p99_ttft_ms",
     "mixed": "mixed_p99_ttft_ms",
     "spec": "spec_tokens_per_step",
+    "chaos": "chaos_goodput_ratio",
     "smoke": "smoke_wall_seconds",
 }
 
@@ -1172,6 +1173,164 @@ def bench_spec():
     }))
 
 
+def bench_chaos():
+    """Self-healing under adversarial faults (ISSUE 9): overload-style
+    seeded traffic drives a 3-worker fleet with auto-restart armed
+    (capped exponential backoff on the virtual clock) — once FAULT-FREE
+    and twice under the SAME seeded :class:`FaultPlan` (crashes, hangs
+    long enough to trip the stall watchdog, slow steps, allocator OOMs,
+    sink failures). Every fault, restart and re-route is step-indexed,
+    so the repeated chaos run must replay bit-for-bit —
+    ``extra.deterministic`` records the check. value = goodput
+    (retired / submitted) under chaos; vs_baseline = chaos goodput /
+    fault-free goodput (1.0 means every fault was healed). extra
+    carries recovery time (steps from a capacity dip until the fleet is
+    back to N healthy workers), the fired fault mix, restart/failover
+    counters, and the completed-output bit-parity oracle — failover is
+    recompute-resume, so every output completed under chaos must
+    bit-match the fault-free run."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.chaos import FaultInjector, FaultPlan
+    from paddle_tpu.inference.fleet import (NoHealthyWorkersError,
+                                            RestartPolicy, ServingFleet)
+    from paddle_tpu.inference.traffic import (TenantProfile,
+                                              TrafficGenerator)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    on_tpu = jax.default_backend() not in ("cpu",)
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
+                          intermediate_size=14336, num_hidden_layers=2,
+                          num_attention_heads=32, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16")
+        s_max, chunk, bs = 512, 8, 16
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=344, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2)
+        s_max, chunk, bs = 64, 4, 16
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    gen = TrafficGenerator(
+        [TenantProfile("t_a", share=6.0),
+         TenantProfile("t_b", share=4.0)],
+        rate=2.5, seed=0, process="bursty", prompt_dist="heavy_tail",
+        prompt_min=4, prompt_max=24, max_new=8)
+    arrivals = gen.arrivals(10.0)
+    dt, n_steps, n_workers = 0.25, 72, 3
+
+    def run_once(fault_seed):
+        vt = [0.0]
+        fleet = ServingFleet(
+            model, n_workers=n_workers, policy="round_robin",
+            engine_kwargs=dict(capacity=2, s_max=s_max, chunk=chunk,
+                               block_size=bs),
+            stall_s=1.0,
+            restart=RestartPolicy(auto=True, backoff_base_s=0.5,
+                                  backoff_max_s=4.0, probation_steps=2,
+                                  clock=lambda: vt[0]))
+        inj = None
+        if fault_seed is not None:
+            plan = FaultPlan.random(
+                fault_seed, n_steps=n_steps,
+                workers=[w.wid for w in fleet.workers],
+                rate=0.10, duration=6, magnitude=0.4)
+            inj = FaultInjector(plan).install(fleet)
+        reqs, idx = [], 0
+        healthy_hist = []
+
+        def one_step():
+            fleet.step()
+            fleet.check_watchdogs(now=vt[0])
+            healthy_hist.append(
+                sum(1 for w in fleet.workers if w.healthy))
+            vt[0] += dt
+
+        for _ in range(n_steps):
+            while idx < len(arrivals) and arrivals[idx].t <= vt[0]:
+                sr = arrivals[idx]
+                ids = gen.prompt_ids(sr, cfg.vocab_size, index=idx)
+                try:
+                    reqs.append(fleet.submit(
+                        ids, max_new_tokens=sr.max_new,
+                        tenant=sr.tenant))
+                except NoHealthyWorkersError:
+                    break       # total outage: retry the arrival next
+                #                 step (deterministic — the outage
+                #                 window is part of the schedule)
+                idx += 1
+            one_step()
+        # drain: keep the virtual clock moving so scheduled restarts
+        # fire and parked requests re-route
+        extra = 0
+        while fleet.pending_work() and extra < 800:
+            one_step()
+            extra += 1
+        outs = {i: np.asarray(r.result) for i, r in enumerate(reqs)
+                if r.trace.terminal == "retired"}
+        st = fleet.stats()
+        sig = {"submitted": idx,
+               "retired": sorted(outs),
+               "outputs": [(i, outs[i].tolist()) for i in sorted(outs)],
+               "failovers": st["failovers"],
+               "restarts": st["restarts"],
+               "rerouted": st["rerouted"],
+               "poisoned": st["poisoned"],
+               "fired": inj.fired if inj is not None else []}
+        # recovery episodes: maximal runs of below-N capacity, each
+        # measured in steps until the fleet is whole again
+        episodes, cur = [], 0
+        for h in healthy_hist:
+            if h < n_workers:
+                cur += 1
+            elif cur:
+                episodes.append(cur)
+                cur = 0
+        if cur:
+            episodes.append(cur)
+        snap = fleet.aggregator().snapshot()
+        final_healthy = sum(1 for w in fleet.workers if w.healthy)
+        fleet.close()
+        return sig, outs, episodes, final_healthy, snap
+
+    sig_free, outs_free, _, _, _ = run_once(None)
+    sig_a, outs_a, episodes, healthy_end, snap = run_once(9)
+    sig_b, _, _, _, _ = run_once(9)
+
+    both = sorted(set(outs_free) & set(outs_a))
+    parity = all(np.array_equal(outs_free[i], outs_a[i]) for i in both)
+    goodput = len(outs_a) / max(sig_a["submitted"], 1)
+    goodput_free = len(outs_free) / max(sig_free["submitted"], 1)
+    fired_mix: dict = {}
+    for _, kind, _ in sig_a["fired"]:
+        fired_mix[kind] = fired_mix.get(kind, 0) + 1
+    snap_path = _dump_metrics_snapshot(None, "chaos", snapshot=snap)
+    print(json.dumps({
+        "metric": "chaos_goodput_ratio",
+        "value": round(goodput, 4),
+        "unit": "retired/submitted",
+        "vs_baseline": round(goodput / max(goodput_free, 1e-9), 4),
+        "extra": {"deterministic": sig_a == sig_b,
+                  "outputs_bit_parity": parity,
+                  "compared_outputs": len(both),
+                  "submitted": sig_a["submitted"],
+                  "retired": len(outs_a),
+                  "faults_fired": fired_mix,
+                  "failovers": sig_a["failovers"],
+                  "restarts": sig_a["restarts"],
+                  "rerouted": sig_a["rerouted"],
+                  "poisoned": sig_a["poisoned"],
+                  "healthy_workers_end": healthy_end,
+                  "recovery_steps_max": max(episodes, default=0),
+                  "recovery_episodes": episodes,
+                  "virtual_window_s": round(n_steps * dt, 2),
+                  "metrics_snapshot": snap_path,
+                  "backend": jax.default_backend()},
+    }))
+
+
 def bench_smoke():
     """Sub-minute pipeline probe: ONE tiny compiled train step
     (fwd+bwd+AdamW) plus ONE compiled flash-attention fwd+bwd. The
@@ -1265,6 +1424,8 @@ def main():
         return bench_mixed()
     if preset == "spec":
         return bench_spec()
+    if preset == "chaos":
+        return bench_chaos()
     if preset == "smoke":
         return bench_smoke()
     if on_tpu:

@@ -212,6 +212,13 @@ class EngineStallWatchdog:
         return info
 
     # -- background polling -------------------------------------------------
+    @property
+    def running(self) -> bool:
+        """True while the background poll thread is alive (the fleet's
+        restart path uses this to rebuild a replacement watchdog in the
+        same mode — polling or manually-checked — as the old one)."""
+        return self._thread is not None and self._thread.is_alive()
+
     def start(self):
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()

@@ -1,0 +1,294 @@
+"""Deterministic fault injection for the serving fleet (ISSUE 9
+tentpole; reference shape: Jepsen/chaos-engineering practice applied to
+a single-process fleet — a SEEDED schedule of faults, not a random
+monkey, so every failure scenario replays bit-identically).
+
+A :class:`FaultPlan` is an immutable schedule of :class:`FaultEvent`\\ s
+keyed by FLEET STEP INDEX — the injected clock here is the step
+counter, never wall time (the timer lint bans raw clocks in this
+package, and a wall clock would de-determinize the schedule). A
+:class:`FaultInjector` installs the plan onto a
+:class:`~paddle_tpu.inference.fleet.ServingFleet` via ``fleet.chaos``;
+every hook on the serving path is a single ``if self.chaos is None``
+check, so a fleet without an injector pays nothing and emits
+bit-identical outputs (regression-tested).
+
+Fault vocabulary (each drives an EXISTING failure path, never a
+bespoke one):
+
+- ``worker_crash`` — the worker's next step raises
+  :class:`ChaosWorkerCrash` inside the fleet's per-worker try block,
+  exercising the ``step_raised`` → failover → (auto-)restart path.
+- ``worker_hang`` — the worker's engine is suppressed for ``duration``
+  steps: no decode, so the ``engine_device_steps_total`` heartbeat
+  freezes and the :class:`EngineStallWatchdog` fires through the
+  normal ``check(now=)`` → ``on_stall`` → flag path.
+- ``slow_step`` — ``magnitude`` seconds are observed into the target
+  worker's ``engine_ttft_seconds`` histogram each affected step
+  (synthetic latency inflation: injected clocks mean nothing actually
+  sleeps), driving the r10 SLO rules and the ISSUE 9 degradation
+  ladder deterministically.
+- ``alloc_oom`` — the target engine's
+  :meth:`~paddle_tpu.inference.paged_cache.BlockAllocator.allocate`
+  raises :class:`ChaosAllocOOM` for the window, surfacing through
+  admission as a ``step_raised`` worker fault.
+- ``sink_fail`` — every shipper sink raises for the window, exercising
+  the r10 backoff/drop accounting.
+
+A ``poison_token`` additionally models a POISON REQUEST: while any
+admitted row's prompt contains the token, that worker's step raises
+:class:`ChaosPoisonError` — the adversarial input the fleet's
+quarantine (``retry_count`` / ``max_retries`` /
+:class:`~paddle_tpu.inference.fleet.RequestPoisonedError`) exists to
+contain."""
+
+from __future__ import annotations
+
+import logging
+import random
+from dataclasses import dataclass
+
+from ..utils.log import get_logger, log_event, log_kv
+
+__all__ = ["FAULT_KINDS", "FaultEvent", "FaultPlan", "FaultInjector",
+           "ChaosWorkerCrash", "ChaosAllocOOM", "ChaosPoisonError"]
+
+_log = get_logger("paddle_tpu.inference.chaos")
+
+#: canonical fault vocabulary (see module docstring for semantics)
+FAULT_KINDS = ("worker_crash", "worker_hang", "slow_step", "alloc_oom",
+               "sink_fail")
+
+
+class ChaosWorkerCrash(RuntimeError):
+    """Injected ``worker_crash``: raised from the worker's step."""
+
+
+class ChaosAllocOOM(MemoryError):
+    """Injected ``alloc_oom``: raised from BlockAllocator.allocate."""
+
+
+class ChaosPoisonError(RuntimeError):
+    """Injected poison request: raised while a row whose prompt holds
+    the injector's ``poison_token`` is admitted on the worker."""
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault. ``step`` is the fleet step index at which
+    it fires; ``worker`` is the target wid (None = the injector picks
+    the first worker); windowed kinds (hang/slow/oom/sink_fail) stay
+    active for ``duration`` steps; ``magnitude`` is the slow_step
+    latency in seconds."""
+
+    step: int
+    kind: str
+    worker: str | None = None
+    duration: int = 1
+    magnitude: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (one of "
+                f"{FAULT_KINDS})")
+        if self.step < 0:
+            raise ValueError(f"step={self.step}")
+        if self.duration < 1:
+            raise ValueError(f"duration={self.duration}")
+
+
+class FaultPlan:
+    """Immutable, deterministic schedule of :class:`FaultEvent`\\ s.
+
+    Build one explicitly (tests pin exact scenarios) or with
+    :meth:`random` — a seeded ``random.Random`` draws the schedule, so
+    the same seed always yields the same plan and therefore the same
+    fault sequence and outputs (the chaos bench's repeatability
+    signature rides :meth:`signature`)."""
+
+    def __init__(self, events=()):
+        self.events: tuple[FaultEvent, ...] = tuple(sorted(
+            events, key=lambda e: (e.step, e.kind, e.worker or "")))
+
+    @classmethod
+    def random(cls, seed, n_steps, workers, kinds=FAULT_KINDS,
+               rate=0.05, duration=3, magnitude=1.0):
+        """Seeded schedule: each step fires at most one fault with
+        probability ``rate``, uniform over ``kinds`` × ``workers``."""
+        rng = random.Random(int(seed))
+        workers = list(workers)
+        kinds = tuple(kinds)
+        events = []
+        for step in range(int(n_steps)):
+            if rng.random() >= rate:
+                continue
+            kind = kinds[rng.randrange(len(kinds))]
+            wid = workers[rng.randrange(len(workers))]
+            events.append(FaultEvent(
+                step=step, kind=kind, worker=wid,
+                duration=1 if kind == "worker_crash" else int(duration),
+                magnitude=float(magnitude) if kind == "slow_step"
+                else 0.0))
+        return cls(events)
+
+    def at(self, step: int) -> list[FaultEvent]:
+        return [e for e in self.events if e.step == step]
+
+    def signature(self) -> list[tuple]:
+        """Hashable determinism signature (bench repeatability check)."""
+        return [(e.step, e.kind, e.worker, e.duration, e.magnitude)
+                for e in self.events]
+
+    def __len__(self):
+        return len(self.events)
+
+    def __repr__(self):
+        return f"FaultPlan({len(self.events)} events)"
+
+
+class _FailingSink:
+    """Stand-in wrapped over a real sink during a ``sink_fail`` window
+    (the shipper's backoff machinery sees an ordinary emit failure)."""
+
+    __slots__ = ("inner",)
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def emit(self, payload):
+        raise OSError("chaos: injected sink_fail")
+
+    def __repr__(self):
+        return f"_FailingSink({self.inner!r})"
+
+
+class FaultInjector:
+    """Applies a :class:`FaultPlan` to a fleet, one
+    :meth:`begin_step` per fleet step.
+
+    All state is step-indexed and host-side; ``fired`` is the audit
+    log of applied events — with a seeded plan it is part of the
+    deterministic run signature. The fleet calls exactly three hooks,
+    each behind a ``fleet.chaos is not None`` check:
+
+    - :meth:`begin_step` — advance the schedule, arm/expire windows.
+    - :meth:`suppress_step` — True while the worker is hung (the
+      fleet skips its engine step, freezing the heartbeat).
+    - :meth:`before_worker_step` — raises for an armed crash, a
+      resident poison row, and installs/removes the allocator OOM
+      wrapper; observes slow_step latency."""
+
+    def __init__(self, plan: FaultPlan, poison_token=None):
+        self.plan = plan
+        self.poison_token = (None if poison_token is None
+                             else int(poison_token))
+        self.step_idx = -1
+        self.fired: list[tuple] = []       # (step, kind, worker) audit
+        self.fleet = None
+        self._crash: set[str] = set()      # one-shot arms
+        self._hang: dict[str, int] = {}    # wid -> last hung step
+        self._slow: dict[str, tuple[int, float]] = {}
+        self._oom: dict[str, int] = {}     # wid -> last oom step
+        self._oom_wrapped: dict[str, tuple] = {}   # wid -> (alloc, fn)
+        self._sink_until = -1
+        self._sink_wrapped: list[tuple] = []       # (_SinkState, sink)
+
+    def install(self, fleet) -> "FaultInjector":
+        fleet.chaos = self
+        self.fleet = fleet
+        return self
+
+    # -- schedule -----------------------------------------------------------
+    def begin_step(self, fleet) -> list[FaultEvent]:
+        """Advance the injected clock by one fleet step; arm the step's
+        events and expire finished windows. Returns the events fired."""
+        self.step_idx += 1
+        events = self.plan.at(self.step_idx)
+        for e in events:
+            wid = e.worker or (fleet.workers[0].wid if fleet.workers
+                               else None)
+            last = self.step_idx + e.duration - 1
+            self.fired.append((self.step_idx, e.kind, wid))
+            log_kv(_log, "chaos_fault", level=logging.WARNING,
+                   step=self.step_idx, kind=e.kind, worker=wid,
+                   duration=e.duration)
+            log_event("chaos_fault", step=self.step_idx, kind=e.kind,
+                      worker=wid)
+            if e.kind == "worker_crash":
+                self._crash.add(wid)
+            elif e.kind == "worker_hang":
+                self._hang[wid] = max(self._hang.get(wid, -1), last)
+            elif e.kind == "slow_step":
+                self._slow[wid] = (last, float(e.magnitude))
+            elif e.kind == "alloc_oom":
+                self._oom[wid] = max(self._oom.get(wid, -1), last)
+            elif e.kind == "sink_fail":
+                self._sink_until = max(self._sink_until, last)
+                self._wrap_sinks(fleet)
+        self._expire(fleet)
+        return events
+
+    def _expire(self, fleet) -> None:
+        if self._sink_wrapped and self.step_idx > self._sink_until:
+            for state, orig in self._sink_wrapped:
+                state.sink = orig
+            self._sink_wrapped = []
+        for wid in list(self._oom_wrapped):
+            if self.step_idx > self._oom.get(wid, -1):
+                alloc, orig = self._oom_wrapped.pop(wid)
+                alloc.allocate = orig
+
+    def _wrap_sinks(self, fleet) -> None:
+        shipper = getattr(fleet, "shipper", None)
+        if shipper is None or self._sink_wrapped:
+            return
+        for state in shipper._sinks:
+            self._sink_wrapped.append((state, state.sink))
+            state.sink = _FailingSink(state.sink)
+
+    # -- per-worker hooks (called inside the fleet's try block) -------------
+    def suppress_step(self, worker) -> bool:
+        """True while ``worker`` is hung: the fleet skips admit+decode,
+        so the device-steps heartbeat freezes and the watchdog's
+        ``check(now=)`` fires through the normal stall path."""
+        return self.step_idx <= self._hang.get(worker.wid, -1)
+
+    def before_worker_step(self, worker) -> None:
+        wid = worker.wid
+        if wid in self._crash:
+            self._crash.discard(wid)
+            raise ChaosWorkerCrash(
+                f"chaos: injected worker_crash on {wid} at step "
+                f"{self.step_idx}")
+        if self.poison_token is not None:
+            for row in worker.engine._rows:
+                if row is None:
+                    continue
+                if bool((row["prompt"] == self.poison_token).any()):
+                    raise ChaosPoisonError(
+                        f"chaos: poison token {self.poison_token} "
+                        f"resident on {wid} at step {self.step_idx}")
+        slow = self._slow.get(wid)
+        if slow is not None and self.step_idx <= slow[0]:
+            h = worker.registry.get("engine_ttft_seconds")
+            if h is not None:
+                h.observe(slow[1])
+        if (self.step_idx <= self._oom.get(wid, -1)
+                and wid not in self._oom_wrapped):
+            alloc = getattr(worker.engine, "_alloc", None)
+            if alloc is not None:
+                self._oom_wrapped[wid] = (alloc, alloc.allocate)
+
+                def _boom(n, _wid=wid):
+                    raise ChaosAllocOOM(
+                        f"chaos: injected alloc_oom on {_wid}")
+
+                alloc.allocate = _boom
+
+    # -- views --------------------------------------------------------------
+    def summary(self) -> dict:
+        """Deterministic audit digest (bench signature component)."""
+        return {"steps": self.step_idx + 1,
+                "fired": list(self.fired),
+                "plan": self.plan.signature()}

@@ -40,6 +40,35 @@ A :class:`ServingFleet` owns N in-process :class:`DecodeEngine` workers
 The fleet is driven synchronously (:meth:`step` /
 :meth:`run_until_drained`) so failover tests are deterministic;
 watchdog poll threads are opt-in via :meth:`start_watchdogs`.
+
+ISSUE 9 makes the fleet SELF-HEALING instead of merely degrading:
+
+- Restart & rejoin — :meth:`restart_worker` rebuilds a drained
+  worker's engine (fresh pool/registry/watchdog) under the SAME wid;
+  the prefix directory repopulates through the re-registered listener
+  as the new cache publishes, and the router re-includes the worker
+  after a probation warm-up. A :class:`RestartPolicy` adds automatic
+  restarts with capped exponential backoff on an injected clock.
+- Poison quarantine — a ``step_raised`` crash is attributed to the
+  rows admitted on the crashed worker: each gets ``retry_count`` += 1
+  and a ``retry`` trace mark. A request exceeding ``max_retries``
+  (default 2) fails LOUDLY with :class:`RequestPoisonedError` and a
+  ``poison_reason`` trace attr instead of cascading through the
+  fleet; innocents co-batched with it re-route and finish
+  bit-identical to a fault-free run.
+- Parking — when a failover finds ZERO healthy workers, unrouteable
+  requests PARK instead of raising through :meth:`step`; they
+  re-route (hop reason ``restarted``) as soon as a worker rejoins.
+- Degradation ladder — with an SLO engine attached, consecutive
+  firing evaluations escalate a deterministic brownout: level 1
+  boosts the router load penalty, level 2 disables speculative
+  decode, level 3 halves the per-step token budget; everything is
+  restored when the alerts resolve (``fleet_degradation_level``
+  gauges it).
+- Fault injection — a
+  :class:`~paddle_tpu.inference.chaos.FaultInjector` installed on
+  ``self.chaos`` drives all of the above from a seeded step-indexed
+  schedule; ``chaos is None`` (the default) costs nothing.
 """
 
 from __future__ import annotations
@@ -54,9 +83,59 @@ from ..observability import MetricsRegistry, merge_snapshots
 from ..utils.log import get_logger, log_event, log_kv
 from .serving import DecodeEngine, _Request, _tmark
 
-__all__ = ["GlobalPrefixDirectory", "ServingFleet"]
+__all__ = ["GlobalPrefixDirectory", "NoHealthyWorkersError",
+           "RequestPoisonedError", "RestartPolicy", "ServingFleet"]
 
 _log = get_logger("paddle_tpu.inference.fleet")
+
+
+class NoHealthyWorkersError(RuntimeError):
+    """Routing found zero healthy workers. Subclasses RuntimeError so
+    pre-ISSUE-9 callers catching the bare type keep working; raised
+    from :meth:`ServingFleet.submit` — internal failover paths PARK
+    unrouteable requests instead of letting this escape ``step()``."""
+
+
+class RequestPoisonedError(RuntimeError):
+    """A request was attributed more than ``max_retries`` worker
+    crashes (``step_raised`` failovers while it was admitted) and has
+    been quarantined: failed loudly instead of re-routed into the next
+    worker. The trace carries ``poison_reason``."""
+
+
+class RestartPolicy:
+    """Worker auto-restart policy: capped exponential backoff on an
+    INJECTED clock (tests and the chaos bench drive a virtual clock;
+    production defaults to the shared observability clock).
+
+    A drained worker's n-th restart is scheduled ``backoff_base_s *
+    2**n`` seconds (capped at ``backoff_max_s``) after the drain is
+    observed; ``max_restarts`` (None = unlimited) stops a
+    crash-looping worker from flapping forever. ``probation_steps``
+    is how many healthy steps a rejoined worker runs before the
+    router includes it again (it still drains its own backlog during
+    probation). ``auto=False`` keeps the knobs (probation, backoff
+    accounting for manual :meth:`ServingFleet.restart_worker` calls)
+    without the automatic trigger."""
+
+    __slots__ = ("auto", "backoff_base_s", "backoff_max_s",
+                 "max_restarts", "probation_steps", "clock")
+
+    def __init__(self, auto=True, backoff_base_s=1.0,
+                 backoff_max_s=30.0, max_restarts=None,
+                 probation_steps=2, clock=None):
+        from ..observability.metrics import now as _now
+        self.auto = bool(auto)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.max_restarts = (None if max_restarts is None
+                             else int(max_restarts))
+        self.probation_steps = int(probation_steps)
+        self.clock = clock if clock is not None else _now
+
+    def backoff_s(self, n_prior_restarts: int) -> float:
+        return min(self.backoff_base_s * 2 ** int(n_prior_restarts),
+                   self.backoff_max_s)
 
 
 class _DirectoryListener:
@@ -158,7 +237,8 @@ class GlobalPrefixDirectory:
 
 class _Worker:
     __slots__ = ("wid", "engine", "registry", "watchdog", "pending",
-                 "healthy", "fail_reason")
+                 "healthy", "fail_reason", "restarts", "restart_at",
+                 "probation", "deg_saved", "legacy_snap")
 
     def __init__(self, wid, engine, registry, watchdog):
         self.wid = wid
@@ -168,6 +248,14 @@ class _Worker:
         self.pending: list = []         # routed, not yet handed to admit
         self.healthy = True
         self.fail_reason = None
+        self.restarts = 0               # completed restarts (ISSUE 9)
+        self.restart_at = None          # scheduled auto-restart time
+        self.probation = 0              # healthy steps before the
+        #                                 router re-includes a rejoin
+        self.deg_saved = None           # engine knobs saved by the
+        #                                 degradation ladder
+        self.legacy_snap = None         # counters/histograms folded in
+        #                                 from pre-restart incarnations
 
     @property
     def occupancy(self) -> int:
@@ -198,7 +286,8 @@ class ServingFleet:
 
     def __init__(self, model, n_workers=2, policy="affinity",
                  load_penalty=None, engine_kwargs=None,
-                 stall_s=30.0, registry=None, qos=None):
+                 stall_s=30.0, registry=None, qos=None,
+                 max_retries=2, restart=None):
         if n_workers < 1:
             raise ValueError(f"n_workers={n_workers}")
         if policy not in ("affinity", "round_robin"):
@@ -239,21 +328,38 @@ class ServingFleet:
         self._c_qos_rejected = self.metrics.counter(
             "fleet_qos_rejected_total",
             "requests rejected by tenant admission")
+        # ISSUE 9: self-healing accounting
+        self._c_restarts = self.metrics.counter(
+            "fleet_restarts_total",
+            "drained workers rebuilt and rejoined")
+        self._c_poisoned = self.metrics.counter(
+            "fleet_poisoned_total",
+            "requests quarantined after max_retries crash attributions")
         self.metrics.gauge(
             "fleet_healthy_workers", "workers currently routable",
             fn=lambda: sum(1 for w in self.workers if w.healthy))
+        self.metrics.gauge(
+            "fleet_degradation_level",
+            "brownout ladder level (0=normal, 1=penalty boost, "
+            "2=+spec off, 3=+step budget halved)",
+            fn=lambda: self._degradation)
+        # restart_worker rebuilds engines with EXACTLY the ctor args
+        # the fleet was born with — keep them
+        self.model = model
+        self._engine_kw = kw
+        self._stall_s = stall_s
+        self.max_retries = int(max_retries)
+        self.restart = restart          # RestartPolicy or None
+        self._parked: list = []         # unrouteable during failover;
+        #                                 re-route on rejoin, never
+        #                                 raise through step()
+        self.chaos = None               # FaultInjector.install() hook
+        self._degradation = 0
+        self._deg_boost = 1.0           # set by enable_slo
         self.workers: list[_Worker] = []
         for i in range(n_workers):
             wid = f"w{i}"
-            reg = MetricsRegistry()
-            eng = DecodeEngine(
-                model, registry=reg, worker_id=wid,
-                prefix_listener=self.directory.listener(wid),
-                qos=qos, **kw)
-            wd = EngineStallWatchdog(
-                reg, stall_s=stall_s,
-                on_stall=lambda info, w=wid: self._mark_unhealthy(
-                    w, "stall", info))
+            eng, reg, wd = self._build_worker(wid)
             self.workers.append(_Worker(wid, eng, reg, wd))
         self._rr = 0                    # round-robin cursor
         self._seq = 0                   # fleet-wide FCFS stamp: keeps
@@ -278,6 +384,23 @@ class ServingFleet:
             "current router load penalty (SLO alerts raise it)",
             fn=lambda: self.load_penalty)
 
+    def _build_worker(self, wid):
+        """One worker's engine + private registry + watchdog. Used at
+        construction AND by :meth:`restart_worker` — a rebuilt worker
+        is indistinguishable from a fresh one (fresh pool, fresh
+        registry, fresh watchdog, listener re-registered so the prefix
+        directory repopulates as the new cache publishes)."""
+        reg = MetricsRegistry()
+        eng = DecodeEngine(
+            self.model, registry=reg, worker_id=wid,
+            prefix_listener=self.directory.listener(wid),
+            qos=self.qos, **self._engine_kw)
+        wd = EngineStallWatchdog(
+            reg, stall_s=self._stall_s,
+            on_stall=lambda info, w=wid: self._mark_unhealthy(
+                w, "stall", info))
+        return eng, reg, wd
+
     # -- routing ------------------------------------------------------------
     def _healthy(self) -> list[_Worker]:
         return [w for w in self.workers if w.healthy]
@@ -288,9 +411,15 @@ class ServingFleet:
         decision (reason + scored candidates) is kept on
         ``self._last_route`` so callers can stamp it onto the request
         trace (ISSUE 5 router span)."""
-        healthy = self._healthy()
-        if not healthy:
-            raise RuntimeError("ServingFleet has no healthy workers")
+        all_healthy = self._healthy()
+        if not all_healthy:
+            raise NoHealthyWorkersError(
+                "ServingFleet has no healthy workers")
+        # probation (ISSUE 9): a freshly-rejoined worker drains its own
+        # work for a warm-up window before the router includes it again
+        # — unless it is all that's left
+        healthy = [w for w in all_healthy if not w.probation] \
+            or all_healthy
         if self.policy == "round_robin" or len(healthy) == 1:
             w = healthy[self._rr % len(healthy)]
             self._rr += 1
@@ -403,14 +532,19 @@ class ServingFleet:
                 return 0
             return self._failover_locked()
 
-    def _harvest(self, w: _Worker) -> list:
+    def _harvest(self, w: _Worker, blame: bool = False) -> list:
         """Host-side drain of a dead worker: in-flight rows become
         recompute-resume requests exactly like r7 preemption (emitted
         tokens snapshotted, trace marked), scheduler backlog and the
         unadmitted pending list ride along untouched. The engine's
         device arrays/allocator are NOT touched — the worker is dead,
         its pages are unreachable, and correctness only needs the host
-        tokens."""
+        tokens.
+
+        ``blame=True`` (a ``step_raised`` crash, ISSUE 9) attributes
+        the crash to exactly the rows ADMITTED at crash time: each
+        gets ``retry_count`` += 1 and a ``retry`` trace mark. Backlog
+        and pending requests were not running — they stay innocent."""
         eng = w.engine
         out = []
         for slot, row in enumerate(eng._rows):
@@ -425,6 +559,12 @@ class ServingFleet:
             else:
                 req._resume_toks = list(row["toks"])
             _tmark(req, "preempted")
+            if blame:
+                req.retry_count = getattr(req, "retry_count", 0) + 1
+                tr = getattr(req, "trace", None)
+                if tr is not None:
+                    tr.set_attr("retry_count", req.retry_count)
+                _tmark(req, "retry", worker=w.wid)
             eng._rows[slot] = None
             out.append(req)
         out.extend(eng.drain_pending())
@@ -446,12 +586,26 @@ class ServingFleet:
             if w.healthy or w.fail_reason == "drained":
                 continue
             reason = w.fail_reason or "failover"
-            reqs = self._harvest(w)
+            # ISSUE 9: only a raising STEP blames its admitted rows —
+            # a stall/hang says nothing about which request is poison
+            blame = reason.startswith("step_raised")
+            reqs = self._harvest(w, blame=blame)
             self.directory.drop_worker(w.wid)
             self._c_failovers.inc()
             w.fail_reason = "drained"
+            parked = 0
             for req in reqs:
-                target = self._route(req.ids.reshape(-1))
+                if getattr(req, "retry_count", 0) > self.max_retries:
+                    self._poison_request(req, reason, w.wid)
+                    continue
+                try:
+                    target = self._route(req.ids.reshape(-1))
+                except NoHealthyWorkersError:
+                    # nowhere to go mid-failover: PARK, never raise
+                    # through step() — a rejoining worker unparks
+                    self._park_locked(req, w.wid)
+                    parked += 1
+                    continue
                 tr = getattr(req, "trace", None)
                 if tr is not None:
                     # ONE trace tells the whole story: the harvested
@@ -463,10 +617,149 @@ class ServingFleet:
                 self._c_rerouted.inc()
                 moved += 1
             log_kv(_log, "failover", level=logging.ERROR,
-                   worker=w.wid, rerouted=len(reqs))
+                   worker=w.wid, rerouted=len(reqs) - parked,
+                   parked=parked)
             log_event("fleet_failover", worker=w.wid,
                       rerouted=len(reqs))
         return moved
+
+    def _poison_request(self, req, reason: str, wid: str) -> None:
+        """Quarantine (ISSUE 9): the request rode more than
+        ``max_retries`` crashing workers — fail it loudly instead of
+        feeding it to the next one. The trace keeps the whole story:
+        ``retry`` marks per attribution, ``quarantined`` +
+        ``poison_reason`` here, then the terminal ``failed``."""
+        tr = getattr(req, "trace", None)
+        n = getattr(req, "retry_count", 0)
+        poison_reason = (f"{reason} on {wid}: {n} crash attributions "
+                         f"exceed max_retries={self.max_retries}")
+        if tr is not None:
+            tr.set_attr("poison_reason", poison_reason)
+            tr.mark("quarantined", worker=wid)
+        req.error = RequestPoisonedError(
+            f"request quarantined as poison ({poison_reason}); "
+            f"workers it crashed: "
+            f"{tr.workers if tr is not None else '?'}")
+        req.event.set()
+        _tmark(req, "failed")
+        self._c_poisoned.inc()
+        log_kv(_log, "request_poisoned", level=logging.ERROR,
+               worker=wid, retries=n,
+               req=tr.request_id if tr is not None else None,
+               reason=poison_reason)
+        log_event("fleet_request_poisoned", worker=wid, retries=n)
+
+    def _park_locked(self, req, frm) -> None:
+        req._parked_from = frm
+        tr = getattr(req, "trace", None)
+        if tr is not None:
+            tr.set_attr("parked", True)
+        self._parked.append(req)
+        log_kv(_log, "request_parked", level=logging.WARNING,
+               frm=frm,
+               req=tr.request_id if tr is not None else None)
+
+    def _unpark_locked(self) -> int:
+        """Re-route parked requests once a healthy worker exists (a
+        rejoin, or late discovery that one survived). The hop reason
+        is ``restarted`` — the trace shows the request waited out the
+        outage."""
+        if not self._parked or not self._healthy():
+            return 0
+        parked, self._parked = self._parked, []
+        moved = 0
+        for req in sorted(parked, key=lambda r: (
+                -int(getattr(r, "priority", 0) or 0), r._sched_seq)):
+            target = self._route(req.ids.reshape(-1))
+            tr = getattr(req, "trace", None)
+            if tr is not None:
+                tr.add_hop(getattr(req, "_parked_from", None),
+                           target.wid, reason="restarted")
+                tr.set_attr("parked", False)
+                self._stamp_route(req, target)
+            target.pending.append(req)
+            self._c_rerouted.inc()
+            moved += 1
+        if moved:
+            log_kv(_log, "unparked", level=logging.WARNING,
+                   count=moved)
+        return moved
+
+    # -- restart & rejoin (ISSUE 9) -----------------------------------------
+    def restart_worker(self, wid: str) -> int:
+        """Rebuild a drained worker in place and rejoin it: fresh
+        engine/pool/registry/watchdog under the same wid, listener
+        re-registered (the prefix directory repopulates as the new
+        cache publishes), probation warm-up before the router includes
+        it. Returns the worker's completed restart count."""
+        with self._lock:
+            return self._restart_worker_locked(wid)
+
+    def _restart_worker_locked(self, wid: str) -> int:
+        w = next((x for x in self.workers if x.wid == wid), None)
+        if w is None:
+            raise ValueError(f"unknown worker {wid!r}")
+        if w.healthy:
+            raise RuntimeError(
+                f"worker {wid} is healthy — nothing to restart")
+        if w.fail_reason != "drained":
+            self._failover_locked()     # harvest leftovers first
+        was_polling = w.watchdog.running
+        w.watchdog.stop()
+        # counter continuity (ISSUE 9): the dead incarnation's counters
+        # and histograms stay part of the fleet story — only its gauges
+        # die with it (a dead engine's point-in-time state must not sum
+        # into the live fleet's). Per-worker Prometheus output still
+        # shows the reset; rate() consumers handle that natively.
+        final = w.registry.snapshot()
+        final.pop("gauges", None)
+        w.legacy_snap = (final if w.legacy_snap is None
+                         else merge_snapshots([w.legacy_snap, final]))
+        eng, reg, wd = self._build_worker(wid)
+        w.engine, w.registry, w.watchdog = eng, reg, wd
+        if was_polling:
+            w.watchdog.start()
+        w.pending = []
+        w.healthy = True
+        w.fail_reason = None
+        w.restarts += 1
+        w.restart_at = None
+        w.probation = (self.restart.probation_steps
+                       if self.restart is not None else 2)
+        w.deg_saved = None
+        self._apply_degradation_worker(w)
+        self._c_restarts.inc()
+        log_kv(_log, "worker_restarted", level=logging.WARNING,
+               worker=wid, restarts=w.restarts,
+               probation=w.probation)
+        log_event("fleet_worker_restarted", worker=wid,
+                  restarts=w.restarts)
+        self._unpark_locked()
+        return w.restarts
+
+    def _auto_restart_locked(self) -> int:
+        """Advance the restart policy's injected clock: schedule a
+        backoff for freshly-drained workers, restart those whose
+        backoff elapsed. Runs every step; no policy = no-op."""
+        if self.restart is None or not self.restart.auto:
+            return 0
+        t = self.restart.clock()
+        n = 0
+        for w in self.workers:
+            if w.healthy or w.fail_reason != "drained":
+                continue
+            if w.restart_at is None:
+                if (self.restart.max_restarts is not None
+                        and w.restarts >= self.restart.max_restarts):
+                    continue            # flapping cap: stays dead
+                w.restart_at = t + self.restart.backoff_s(w.restarts)
+                log_kv(_log, "restart_scheduled",
+                       level=logging.WARNING, worker=w.wid,
+                       at=w.restart_at, prior_restarts=w.restarts)
+            elif t >= w.restart_at:
+                self._restart_worker_locked(w.wid)
+                n += 1
+        return n
 
     # -- SLO-driven load shedding (ISSUE 6) ---------------------------------
     def _shed_locked(self) -> int:
@@ -538,15 +831,25 @@ class ServingFleet:
         unhealthy, then admit + one decode chunk per healthy worker (a
         raising step fails the WORKER, not the fleet — its requests
         re-route on the spot). Returns live rows across the fleet."""
+        if self.chaos is not None:
+            # deterministic fault injection (ISSUE 9): advance the
+            # step-indexed schedule before anything else observes it
+            self.chaos.begin_step(self)
         with self._lock:
             if self._qos_gate is not None:
                 # buckets refilled since submit: route the released
                 # requests in arrival order before this step's admission
                 for req in self._qos_gate.release():
-                    w = self._route(req.ids.reshape(-1))
+                    try:
+                        w = self._route(req.ids.reshape(-1))
+                    except NoHealthyWorkersError:
+                        self._park_locked(req, None)
+                        continue
                     self._stamp_route(req, w)
                     w.pending.append(req)
             self._failover_locked()
+            self._auto_restart_locked()
+            self._unpark_locked()
             if (self._shed and self.slo is not None
                     and self.slo.firing()):
                 self._shed_locked()
@@ -556,6 +859,13 @@ class ServingFleet:
                 continue
             eng = w.engine
             try:
+                if self.chaos is not None:
+                    if self.chaos.suppress_step(w):
+                        # injected hang: heartbeat frozen, rows stuck —
+                        # the watchdog path is how this gets noticed
+                        alive += w.occupancy
+                        continue
+                    self.chaos.before_worker_step(w)
                 with self._lock:
                     batch, w.pending = w.pending, []
                 # run admission even with nothing newly routed: freed
@@ -572,6 +882,9 @@ class ServingFleet:
                         w.wid, f"step_raised:{type(e).__name__}")
                     self._failover_locked()
                 continue
+            if w.probation:
+                # a healthy step served: burn down the rejoin warm-up
+                w.probation -= 1
             alive += w.occupancy
         if self.shipper is not None:
             # periodic off-host flush rides the step loop; tick() is
@@ -590,7 +903,46 @@ class ServingFleet:
         return sum(w.load for w in self.workers if w.healthy) \
             + sum(len(w.pending) for w in self.workers
                   if not w.healthy) \
+            + len(self._parked) \
             + gated
+
+    def _stuck_report(self) -> str:
+        """Every request still in flight, one line each with worker,
+        tenant and last lifecycle state — a max-steps hang must be
+        diagnosable from the exception message alone (ISSUE 9)."""
+        from .qos import tenant_of
+
+        def line(where, req, health):
+            tr = getattr(req, "trace", None)
+            rid = tr.request_id if tr is not None else id(req)
+            state = (tr.events[-1][0]
+                     if tr is not None and tr.events else "?")
+            return (f"  {where}[{health}] req={rid} "
+                    f"tenant={tenant_of(req)!r} state={state}")
+
+        lines = []
+        for w in self.workers:
+            health = "healthy" if w.healthy else (
+                w.fail_reason or "unhealthy")
+            for req in w.pending:
+                lines.append(line(f"{w.wid} routed", req, health))
+            sch = w.engine._sched
+            if sch is not None:
+                for req in sch.requests():
+                    lines.append(line(f"{w.wid} scheduled", req,
+                                      health))
+            for row in w.engine._rows:
+                if row is not None:
+                    lines.append(line(f"{w.wid} running", row["req"],
+                                      health))
+        for req in self._parked:
+            lines.append(line(
+                f"parked(from {getattr(req, '_parked_from', None)})",
+                req, "no_healthy_workers"))
+        if self._qos_gate is not None:
+            for req in self._qos_gate.held():
+                lines.append(line("qos gate", req, "throttled"))
+        return "\n".join(lines) if lines else "  (none attributable)"
 
     def run_until_drained(self, max_steps=10_000) -> int:
         """Step until no healthy worker has work. Returns steps taken."""
@@ -599,7 +951,8 @@ class ServingFleet:
             if steps >= max_steps:
                 raise RuntimeError(
                     f"fleet not drained after {max_steps} steps "
-                    f"({self.pending_work()} requests in flight)")
+                    f"({self.pending_work()} requests in flight); "
+                    f"stuck work:\n{self._stuck_report()}")
             self.step()
             steps += 1
         return steps
@@ -637,6 +990,8 @@ class ServingFleet:
         agg = MetricsAggregator()
         for w in self.workers:
             agg.add(w.wid, w.registry)
+            if w.legacy_snap is not None:
+                agg.add_baseline(w.legacy_snap)
         agg.add("router", self.metrics)
         if self.shipper is not None:
             agg.add("shipper", self.shipper.registry)
@@ -647,9 +1002,13 @@ class ServingFleet:
 
     def merged_snapshot(self) -> dict:
         """Union-equivalent merge of every worker registry snapshot
-        (the SLO engine's observation unit)."""
-        return merge_snapshots(w.registry.snapshot()
-                               for w in self.workers)
+        (the SLO engine's observation unit), plus the counter/histogram
+        baselines of pre-restart incarnations — a restart must not
+        reset fleet-level totals out from under burn-rate rules."""
+        return merge_snapshots(
+            [w.registry.snapshot() for w in self.workers]
+            + [w.legacy_snap for w in self.workers
+               if w.legacy_snap is not None])
 
     def _sweep_traces(self) -> list[dict]:
         """Move freshly-terminal traces to the unshipped summary list;
@@ -679,6 +1038,16 @@ class ServingFleet:
         imbalance); it is restored when the last alert resolves.
         ``on_alert`` is called after the built-in hook with the same
         transition dict. Drive evaluation with :meth:`check_slo`.
+
+        ISSUE 9 extends the control loop into a DEGRADATION LADDER:
+        every :meth:`check_slo` evaluation while any alert fires
+        escalates one level (capped at 3) — level 1 is the load
+        penalty boost above, level 2 additionally disables
+        speculative decode on every worker, level 3 additionally
+        halves each worker's per-step token budget (never below one
+        decode chunk). The first evaluation with nothing firing
+        restores every knob (``fleet_degradation_level`` gauges the
+        ladder; each transition is logged and trace-evented).
 
         ``shed=True`` (ISSUE 6; requires a fleet constructed with
         ``qos=``) arms load shedding: while any alert fires, each
@@ -726,6 +1095,7 @@ class ServingFleet:
             if on_alert is not None:
                 on_alert(info)
 
+        self._deg_boost = boost
         self.slo = SLOEngine(rules, on_alert=_hook,
                              registry=self.metrics)
         return self.slo
@@ -737,7 +1107,54 @@ class ServingFleet:
         if self.slo is None:
             return []
         self.slo.observe(self.merged_snapshot(), now_=now)
-        return self.slo.check(now_=now)
+        out = self.slo.check(now_=now)
+        # degradation ladder (ISSUE 9): one deterministic escalation
+        # per firing evaluation, full restore on the first clean one
+        self._set_degradation(
+            min(3, self._degradation + 1) if self.slo.firing() else 0)
+        return out
+
+    # -- degradation ladder (ISSUE 9) ---------------------------------------
+    def _set_degradation(self, level: int) -> None:
+        if level == self._degradation:
+            return
+        old, self._degradation = self._degradation, level
+        # lever 1 — router load penalty (the alert hook also maintains
+        # this on transitions; both write the same value)
+        self.load_penalty = self._base_load_penalty * (
+            self._deg_boost if level >= 1 else 1.0)
+        for w in self.workers:
+            if w.healthy:
+                self._apply_degradation_worker(w)
+        log_kv(_log, "degradation", level=logging.WARNING,
+               old=old, new=level, load_penalty=self.load_penalty)
+        log_event("fleet_degradation", old=old, new=level)
+
+    def _apply_degradation_worker(self, w: _Worker) -> None:
+        """Apply the CURRENT ladder level to one worker's engine —
+        called on every transition and on worker rejoin (a restarted
+        engine must join at the fleet's current brownout level). The
+        engine's original knobs are saved on first touch and restored
+        verbatim at level 0 ("fully restored on resolve")."""
+        eng = w.engine
+        if self._degradation == 0:
+            if w.deg_saved is not None:
+                eng.spec_decode = w.deg_saved["spec_decode"]
+                eng.step_budget = w.deg_saved["step_budget"]
+                w.deg_saved = None
+            return
+        if w.deg_saved is None:
+            w.deg_saved = {"spec_decode": eng.spec_decode,
+                           "step_budget": eng.step_budget}
+        # lever 2 — speculative decode off (verify steps burn budget
+        # on drafts that overload traffic rarely accepts)
+        eng.spec_decode = (False if self._degradation >= 2
+                           else w.deg_saved["spec_decode"])
+        # lever 3 — halve the per-step token budget, never below one
+        # decode chunk (brownout: trade throughput for stability)
+        eng.step_budget = (
+            max(eng.chunk, w.deg_saved["step_budget"] // 2)
+            if self._degradation >= 3 else w.deg_saved["step_budget"])
 
     # -- off-host telemetry (ISSUE 5) ---------------------------------------
     def enable_shipper(self, sinks, interval_s=5.0, **kw):
@@ -827,6 +1244,10 @@ class ServingFleet:
             "affinity_hits": int(self._c_affinity_hits.value),
             "failovers": int(self._c_failovers.value),
             "rerouted": int(self._c_rerouted.value),
+            "restarts": int(self._c_restarts.value),
+            "poisoned": int(self._c_poisoned.value),
+            "parked": len(self._parked),
+            "degradation": self._degradation,
             "healthy_workers": sum(1 for w in self.workers if w.healthy),
             "directory": self.directory.stats(),
             "workers": {w.wid: w.engine.stats() for w in self.workers},
@@ -841,7 +1262,9 @@ class ServingFleet:
         for w in self.workers:
             w.watchdog.stop()
         if self.shipper is not None:
-            self.shipper.stop(final_flush=False)
+            # ISSUE 9 satellite: best-effort final drain of queued
+            # telemetry through whichever sinks still accept it
+            self.shipper.close()
         if self._http is not None:
             self._http.close()
             self._http = None

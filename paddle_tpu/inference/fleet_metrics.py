@@ -41,6 +41,7 @@ class MetricsAggregator:
                  | None = None):
         # key -> (labels dict, registry); worker adds key by bare label
         self._regs: dict[str, tuple[dict, MetricsRegistry]] = {}
+        self._baselines: list[dict] = []
         for label, reg in (registries or {}).items():
             self.add(label, reg)
 
@@ -65,19 +66,28 @@ class MetricsAggregator:
     def labels(self) -> list[str]:
         return list(self._regs)
 
+    def add_baseline(self, snap: dict) -> None:
+        """Fold a pre-recorded snapshot (ISSUE 9: a restarted worker's
+        dead incarnation — counters/histograms only) into the fleet
+        merge. Baselines never appear as their own ``workers`` entry or
+        in the Prometheus body; they exist so fleet totals survive
+        registry replacement."""
+        self._baselines.append(snap)
+
     def snapshot(self) -> dict:
         """``{"workers": {key: snap}, "fleet": merged}`` — per-entry
         registries verbatim plus the union-equivalent merge (counters
-        summed, histograms bucket-merged with recomputed quantiles).
-        Tenant entries appear under their ``tenant=...`` key and are
-        EXCLUDED from the fleet merge: per-tenant counters partition
-        the same events the worker registries already count, and
-        double-merging would double the fleet totals."""
+        summed, histograms bucket-merged with recomputed quantiles),
+        including any :meth:`add_baseline` snapshots. Tenant entries
+        appear under their ``tenant=...`` key and are EXCLUDED from the
+        fleet merge: per-tenant counters partition the same events the
+        worker registries already count, and double-merging would
+        double the fleet totals."""
         per = {key: reg.snapshot()
                for key, (_, reg) in self._regs.items()}
         merged = merge_snapshots(
-            snap for key, snap in per.items()
-            if "worker" in self._regs[key][0])
+            [snap for key, snap in per.items()
+             if "worker" in self._regs[key][0]] + self._baselines)
         return {"workers": per, "fleet": merged}
 
     def prometheus_text(self) -> str:

@@ -27,11 +27,15 @@ Granularity rules:
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 
+from ..utils.log import get_logger, log_kv
 from .paged_cache import BlockAllocator
 
 __all__ = ["PrefixCache", "PrefixMatch"]
+
+_log = get_logger("paddle_tpu.inference.prefix_cache")
 
 
 @dataclass
@@ -196,8 +200,10 @@ class PrefixCache:
         if self._listener is not None:
             try:                        # routing hint only — a listener
                 self._listener.on_insert(tokens)   # fault must not break
-            except Exception:           # noqa: BLE001 — publish
-                pass
+            except Exception as e:      # noqa: BLE001 — publish
+                log_kv(_log, "prefix_listener_failed",
+                       level=logging.WARNING, hook="on_insert",
+                       error=type(e).__name__, detail=str(e))
         return adopted
 
     # -- reclaim ------------------------------------------------------------
@@ -228,8 +234,10 @@ class PrefixCache:
                 toks = [t for key in reversed(chain) for t in key]
                 try:
                     self._listener.on_evict(toks)
-                except Exception:       # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    log_kv(_log, "prefix_listener_failed",
+                           level=logging.WARNING, hook="on_evict",
+                           error=type(e).__name__, detail=str(e))
             del victim.parent.children[victim.key]
             self._alloc.decref(victim.page)     # rc 1 -> page freed
             self._n_nodes -= 1

@@ -1730,7 +1730,10 @@ class GenerationPredictor:
                     self.model.generate).parameters:
                 return False               # family without a masked path
             return _axis_size(current_mesh(), "sep") <= 1
-        except Exception:  # noqa: BLE001 — unknown model family
+        except Exception as e:  # noqa: BLE001 — unknown model family
+            log_kv(_log, "supports_mask_probe_failed",
+                   level=logging.DEBUG, error=type(e).__name__,
+                   detail=str(e))
             return False
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
@@ -1781,6 +1784,8 @@ class _Request:
         self._sched_seq = None          # FCFS stamp (RequestScheduler)
         self._resume_toks = None        # preemption: emitted tokens to
         #                                 resume from losslessly
+        self.retry_count = 0            # step_raised crash attributions
+        #                                 (ISSUE 9 poison quarantine)
 
     def wait(self, timeout=None):
         if not self.event.wait(timeout):

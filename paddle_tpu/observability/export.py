@@ -19,14 +19,18 @@ replay exactly in tests."""
 from __future__ import annotations
 
 import json
+import logging
 import random
 import threading
 import urllib.request
 from collections import deque
 
+from ..utils.log import get_logger, log_kv
 from .metrics import MetricsRegistry, now
 
 __all__ = ["TelemetryShipper", "JsonlFileSink", "HTTPPostSink"]
+
+_log = get_logger("paddle_tpu.observability.export")
 
 
 class JsonlFileSink:
@@ -182,7 +186,10 @@ class TelemetryShipper:
         if self.collect is not None:
             try:
                 payload = self.collect()
-            except Exception:   # noqa: BLE001 — hot path stays alive
+            except Exception as e:  # noqa: BLE001 — hot path stays alive
+                log_kv(_log, "shipper_collect_failed",
+                       level=logging.WARNING, error=type(e).__name__,
+                       detail=str(e))
                 payload = None
             if payload is not None:
                 self.enqueue(payload)
@@ -233,8 +240,10 @@ class TelemetryShipper:
             while not self._stop.wait(self.interval_s):
                 try:
                     self.flush()
-                except Exception:   # noqa: BLE001 — daemon never dies
-                    pass
+                except Exception as e:  # noqa: BLE001 — daemon never dies
+                    log_kv(_log, "shipper_flush_failed",
+                           level=logging.ERROR,
+                           error=type(e).__name__, detail=str(e))
 
         self._thread = threading.Thread(
             target=_loop, name="telemetry-shipper", daemon=True)
@@ -250,3 +259,56 @@ class TelemetryShipper:
         self._thread = None
         if final_flush:
             self.flush()
+
+    def close(self) -> dict:
+        """Shutdown with a FINAL best-effort flush (ISSUE 9 satellite:
+        ``stop(final_flush=False)`` silently lost everything still
+        queued). Backoff windows are ignored — this is the last chance
+        — but each sink gets ONE attempt per payload and is abandoned
+        at its first failure (a dead sink must not stall shutdown).
+        Whatever could not be delivered is counted dropped. Returns
+        ``{"flushed": n, "dropped": n, "per_sink": {...}}`` and logs
+        the same."""
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        flushed = 0
+        dropped = 0
+        per_sink: dict[str, dict] = {}
+        for i, s in enumerate(self._sinks):
+            key = f"{i}:{s.sink!r}"
+            ok, lost = 0, 0
+            while True:
+                with self._lock:
+                    if not s.queue:
+                        break
+                    payload = s.queue[0]
+                try:
+                    s.sink.emit(payload)
+                except Exception as e:  # noqa: BLE001 — contained
+                    self._errors.inc()
+                    with self._lock:
+                        lost = len(s.queue)
+                        s.queue.clear()
+                    for _ in range(lost):
+                        self._dropped.inc()
+                    log_kv(_log, "shipper_close_sink_failed",
+                           level=logging.WARNING, sink=key,
+                           error=type(e).__name__, detail=str(e),
+                           dropped=lost)
+                    break
+                else:
+                    self._shipped.inc()
+                    ok += 1
+                    with self._lock:
+                        if s.queue and s.queue[0] is payload:
+                            s.queue.popleft()
+            flushed += ok
+            dropped += lost
+            per_sink[key] = {"flushed": ok, "dropped": lost}
+        counts = {"flushed": flushed, "dropped": dropped,
+                  "per_sink": per_sink}
+        log_kv(_log, "shipper_closed", level=logging.INFO,
+               flushed=flushed, dropped=dropped)
+        return counts

@@ -20,13 +20,18 @@ exposed bucket counts are cumulative, ending at ``+Inf == _count``.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from bisect import bisect_left
 
+from ..utils.log import get_logger, log_kv
+
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "get_registry", "merge_snapshots", "now",
            "DEFAULT_LATENCY_BUCKETS", "escape_help", "escape_label"]
+
+_log = get_logger("paddle_tpu.observability.metrics")
 
 #: monotonic high-resolution clock used by every telemetry call site —
 #: hot-path code imports this alias instead of calling the stdlib
@@ -111,7 +116,11 @@ class Gauge:
         if self._fn is not None:
             try:
                 return float(self._fn())
-            except Exception:  # noqa: BLE001 — collection must not throw
+            except Exception as e:  # noqa: BLE001 — collection must
+                # not throw; NaN is the sentinel scrapers expect
+                log_kv(_log, "gauge_callback_failed",
+                       level=logging.DEBUG, gauge=self.name,
+                       error=type(e).__name__, detail=str(e))
                 return float("nan")
         with self._lock:
             return self._value

@@ -21,12 +21,16 @@ fraction over the window divided by that budget is the burn. A burn of
 
 from __future__ import annotations
 
+import logging
 from collections import deque
 from dataclasses import dataclass, field
 
+from ..utils.log import get_logger, log_kv
 from .metrics import _parse_le, now
 
 __all__ = ["SLORule", "SLOEngine", "AlertState"]
+
+_log = get_logger("paddle_tpu.observability.slo")
 
 _QUANTILE_STATS = {"p50": 0.5, "p90": 0.9, "p99": 0.99}
 
@@ -314,8 +318,10 @@ class SLOEngine:
         if self.on_alert is not None:
             try:
                 self.on_alert(info)
-            except Exception:   # noqa: BLE001 — never crash serving
-                pass
+            except Exception as e:  # noqa: BLE001 — never crash serving
+                log_kv(_log, "on_alert_callback_failed",
+                       level=logging.ERROR, rule=info.get("rule"),
+                       error=type(e).__name__, detail=str(e))
         return info
 
     # -- views --------------------------------------------------------------

@@ -34,9 +34,15 @@ __all__ = ["RequestTrace", "TERMINAL_STATES", "LIFECYCLE_STATES"]
 #: ``spec_verify`` (ISSUE 8): one mark per speculative verify step —
 #: a decode step that scored k draft tokens; its ``decode_chunk``
 #: marks carry ``n_tokens`` so multi-token steps don't read as one.
+#: ``retry`` / ``quarantined`` (ISSUE 9): a ``retry`` mark records one
+#: step_raised crash attributed to the request (it was admitted on the
+#: worker that crashed); ``quarantined`` fires once when attributions
+#: exceed the fleet's ``max_retries`` and the request fails
+#: ``RequestPoisonedError`` instead of cascading.
 LIFECYCLE_STATES = ("arrival", "queued", "admitted", "prefill",
                     "prefill_chunk", "first_token", "decode_chunk",
-                    "spec_verify", "preempted", "retired", "failed")
+                    "spec_verify", "preempted", "retry", "quarantined",
+                    "retired", "failed")
 TERMINAL_STATES = frozenset({"retired", "failed"})
 
 _ids = itertools.count(1)
@@ -261,7 +267,9 @@ class RequestTrace:
         """JSON-able digest (stall-watchdog dumps, debug logging,
         shipper export). r8 keys are unchanged; ISSUE 5 appends
         ``trace_id``/``worker_id``/``hops``/``attrs``; ISSUE 6 appends
-        ``tenant`` after those."""
+        ``tenant`` after those; ISSUE 9 appends ``retries`` /
+        ``poison_reason`` after ``tenant`` (shape-compat: consumers
+        indexing the r11 keys positionally are unaffected)."""
         term = self.terminal
         return {
             "request_id": self.request_id,
@@ -278,6 +286,8 @@ class RequestTrace:
             "hops": [dict(h) for h in self.hops],
             "attrs": dict(self.attrs),
             "tenant": self.tenant,
+            "retries": self.count("retry"),
+            "poison_reason": self.attrs.get("poison_reason"),
         }
 
     # -- Chrome trace export ------------------------------------------------

@@ -64,6 +64,10 @@ def test_infra_skip_metric_follows_preset(monkeypatch, capsys):
     bench._emit_infra_skip("tunnel down")
     out = json.loads(capsys.readouterr().out.strip())
     assert out["metric"] == "spec_tokens_per_step"
+    monkeypatch.setenv("BENCH_PRESET", "chaos")
+    bench._emit_infra_skip("tunnel down")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "chaos_goodput_ratio"
 
 
 @pytest.mark.slow
@@ -300,6 +304,43 @@ def test_spec_preset_cpu_smoke(tmp_path):
     assert snap["counters"]["engine_spec_accepted_total"] == \
         extra["accepted"]
     assert snap["histograms"]["engine_spec_accept_len"]["count"] > 0
+
+
+@pytest.mark.slow
+def test_chaos_preset_cpu_smoke(tmp_path):
+    """End-to-end CPU run of BENCH_PRESET=chaos (ISSUE 9 satellite):
+    one JSON line; the same-seed chaos run replays bit-for-bit; every
+    output completed under faults bit-matches the fault-free run
+    (failover is recompute-resume); and the fleet healed back to full
+    capacity by the end of the window."""
+    env = dict(os.environ, BENCH_PRESET="chaos",
+               BENCH_ALLOW_CPU="1", BENCH_NO_WALL="1",
+               BENCH_SKIP_PROBE="1", BENCH_METRICS_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, bench.__file__], env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1                         # one-JSON-line contract
+    out = json.loads(lines[0])
+    assert out["metric"] == "chaos_goodput_ratio"
+    extra = out["extra"]
+    # same seed, same faults, same outputs — bit-for-bit
+    assert extra["deterministic"] is True
+    # the healing oracle: whatever completed under chaos matches the
+    # fault-free run token-for-token
+    assert extra["outputs_bit_parity"] is True
+    assert extra["compared_outputs"] > 0
+    # the schedule genuinely injected faults and the fleet healed
+    assert sum(extra["faults_fired"].values()) > 0
+    assert extra["restarts"] > 0
+    assert extra["healthy_workers_end"] == 3
+    assert 0.0 < out["value"] <= 1.0
+    snap_path = extra["metrics_snapshot"]
+    assert snap_path == str(tmp_path / "bench_metrics_chaos.json")
+    snap = json.load(open(snap_path))
+    assert snap["fleet"]["counters"]["engine_retired_total"] > 0
 
 
 def test_env_flag_tolerant(monkeypatch):

@@ -62,10 +62,12 @@ def test_lint_covers_fleet_modules():
     """ISSUE 4 grew the package by fleet.py/fleet_metrics.py and
     ISSUE 6 by qos.py/traffic.py; ISSUE 7's chunked prefill rides
     inside serving.py/scheduler.py/qos.py (StepBudget, plan_prefill,
-    the chunk loop), and ISSUE 8 added spec_decode.py (the n-gram
+    the chunk loop), ISSUE 8 added spec_decode.py (the n-gram
     drafter must stay pure — a wall clock in the draft path would
-    de-determinize the verify oracle), so those staying in the scan set
-    keeps their timing under the lint too. The glob above must
+    de-determinize the verify oracle), and ISSUE 9 added chaos.py
+    (the fault schedule's clock is the fleet STEP INDEX — a wall
+    clock anywhere in it would break same-seed replay), so those
+    staying in the scan set keeps their timing under the lint too. The glob above must
     actually be scanning them
     (a rename or package move would silently shrink the lint's
     coverage). QoS/traffic in particular must never grow a wall clock —
@@ -73,7 +75,7 @@ def test_lint_covers_fleet_modules():
     scanned = {py.name for py in INFERENCE.glob("*.py")}
     for required in ("serving.py", "fleet.py", "fleet_metrics.py",
                      "prefix_cache.py", "scheduler.py", "qos.py",
-                     "traffic.py", "spec_decode.py"):
+                     "traffic.py", "spec_decode.py", "chaos.py"):
         assert required in scanned, (
             f"{required} missing from the timer-lint scan set "
             f"{sorted(scanned)}")

@@ -1,0 +1,135 @@
+"""No-silent-except lint (ISSUE 9 satellite): a self-healing fleet is
+only debuggable if every swallowed fault leaves a trace. This AST scan
+walks ``paddle_tpu/inference/`` and ``paddle_tpu/observability/`` and
+requires every BROAD exception handler (bare ``except:``, ``except
+Exception``, ``except BaseException`` — alone or in a tuple) to be
+LOUD in at least one of the sanctioned ways:
+
+- re-raise (``raise`` anywhere in the handler),
+- route through a structured logger (``log_kv`` / ``log_event``),
+- fail the work loudly (``_fail_request`` / ``_fail_row_paged`` /
+  ``_shed_request`` / ``_poison_request`` / ``_park_locked``),
+- flag the worker (``_mark_unhealthy``),
+- count it (``.inc()`` on an attribute whose name mentions error/
+  drop/fail), or
+- surface it on the request (assignment to an ``.error`` attribute).
+
+NARROW handlers (``except queue.Empty``, ``except
+NoHealthyWorkersError`` …) are exempt — catching a specific type is
+already a statement about what can happen there. The lint is
+deliberately syntactic: it cannot prove the log line is *useful*, only
+that the failure isn't silently discarded, which is the failure mode
+chaos testing keeps finding in real fleets."""
+
+import ast
+import pathlib
+
+_ROOT = pathlib.Path(__file__).resolve().parent.parent / "paddle_tpu"
+SCAN = sorted((_ROOT / "inference").glob("*.py")) \
+    + sorted((_ROOT / "observability").glob("*.py"))
+
+_BROAD = {"Exception", "BaseException"}
+_LOUD_CALLS = {"log_kv", "log_event", "_fail_request", "_fail_row_paged",
+               "_mark_unhealthy", "_shed_request", "_poison_request",
+               "_park_locked"}
+_COUNTER_HINTS = ("error", "drop", "fail")
+
+
+def _names_of(node):
+    """Exception-type names in a handler's ``type`` expression."""
+    if node is None:
+        return []
+    elts = node.elts if isinstance(node, ast.Tuple) else [node]
+    out = []
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.append(e.attr)
+    return out
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True                     # bare except:
+    return any(n in _BROAD for n in _names_of(handler.type))
+
+
+def _call_target(call: ast.Call):
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _is_loud(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = _call_target(node)
+            if name in _LOUD_CALLS:
+                return True
+            if name == "inc" and isinstance(node.func, ast.Attribute):
+                base = node.func.value
+                attr = base.attr if isinstance(base, ast.Attribute) \
+                    else (base.id if isinstance(base, ast.Name) else "")
+                if any(h in attr for h in _COUNTER_HINTS):
+                    return True
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and tgt.attr == "error":
+                    return True
+    return False
+
+
+def _broad_handlers():
+    out = []
+    for py in SCAN:
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and _is_broad(node):
+                out.append((py, node))
+    return out
+
+
+def test_every_broad_except_is_loud():
+    offenders = [f"{py.name}:{h.lineno}" for py, h in _broad_handlers()
+                 if not _is_loud(h)]
+    assert not offenders, (
+        "silent broad exception handler(s) — re-raise, log via "
+        "log_kv/log_event, fail the request, mark the worker "
+        "unhealthy, or bump an error counter:\n  "
+        + "\n  ".join(offenders))
+
+
+def test_lint_scan_is_meaningful():
+    """The lint must actually be seeing the handlers it polices — an
+    import-path or glob change that empties the scan would make the
+    lint above pass vacuously."""
+    handlers = _broad_handlers()
+    assert len(handlers) >= 5, (
+        f"only {len(handlers)} broad handlers found — scan set broken?")
+    files = {py.name for py, _ in handlers}
+    for required in ("serving.py", "fleet.py", "export.py"):
+        assert required in files, (
+            f"{required} has no broad handlers in the scan — it "
+            f"historically does; did the glob or the file move?")
+
+
+def test_narrow_handlers_are_exempt():
+    """Sanity-check the classifier itself on synthetic handlers."""
+    tree = ast.parse(
+        "try:\n    pass\n"
+        "except queue.Empty:\n    pass\n"
+        "except (ValueError, KeyError):\n    pass\n"
+        "except (OSError, Exception):\n    pass\n"
+        "except BaseException:\n    raise\n"
+        "except:\n    pass\n")
+    handlers = [n for n in ast.walk(tree)
+                if isinstance(n, ast.ExceptHandler)]
+    assert [_is_broad(h) for h in handlers] == \
+        [False, False, True, True, True]
+    assert _is_loud(handlers[3]) and not _is_loud(handlers[4])
