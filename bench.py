@@ -50,6 +50,7 @@ _PRESET_METRICS = {
     "spec": "spec_tokens_per_step",
     "chaos": "chaos_goodput_ratio",
     "smoke": "smoke_wall_seconds",
+    "tp": "tp_device_calls_per_step",
 }
 
 
@@ -1173,6 +1174,117 @@ def bench_spec():
     }))
 
 
+def bench_tp():
+    """Tensor-parallel sharded engine (ISSUE 10): seeded identical
+    arrivals drive the SAME paged config (chunked prefill + spec decode
+    ON — the launch-heavy mode the single-launch mixed step was built
+    to collapse) unsharded vs sharded over a tp=2 and tp=4 kv-head
+    mesh, plus a tp=2 REPEAT on the same seed. Oracles ride in
+    ``extra``: outputs bit-identical across every run (sharding is
+    wiring, never a quality trade) and the repeat bit-for-bit
+    (determinism). value = device launches per engine step on the tp=2
+    sharded engine (batched verify + mixed step fold O(rows) calls into
+    O(1)); vs_baseline = unsharded calls-per-step / sharded
+    calls-per-step (>1 = the collapse pays). extra carries raw call and
+    step counts, walls, and the per-degree parity flags."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import DecodeEngine, _Request
+    from paddle_tpu.inference.sharding import make_tp_mesh
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    on_tpu = jax.default_backend() not in ("cpu",)
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
+                          intermediate_size=14336, num_hidden_layers=2,
+                          num_attention_heads=32, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16")
+        s_max, chunk, bs = 512, 8, 16
+    else:
+        # head counts divisible by BOTH degrees (8 heads / 4 kv heads),
+        # ff 344 = 4 x 86
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=344, num_hidden_layers=2,
+                          num_attention_heads=8, num_key_value_heads=4)
+        s_max, chunk, bs = 128, 4, 16
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    rep = [np.tile(rng.randint(1, cfg.vocab_size,
+                               (rng.randint(4, 9),)).astype(np.int32),
+                   rng.randint(3, 6)) for _ in range(4)]
+    rand = [rng.randint(1, cfg.vocab_size,
+                        (rng.randint(12, 41),)).astype(np.int32)
+            for _ in range(4)]
+    prompts = rep + rand
+    max_new = 16
+
+    def run_once(tp):
+        eng = DecodeEngine(
+            model, capacity=4, s_max=s_max, chunk=chunk, block_size=bs,
+            chunked_prefill=True, spec_decode=True,
+            mesh=make_tp_mesh(tp) if tp else None)
+        # warmup outside the measurement: compile this mode's programs
+        w = _Request(np.tile(prompts[0][:4], 3), max_new)
+        pending = [w]
+        while pending or not eng.idle():
+            eng.admit(pending)
+            eng.decode_once()
+        w.wait(timeout=120)
+        calls0 = eng.stats()["device_calls"]
+        reqs = [_Request(p, max_new) for p in prompts]
+        pending = list(reqs)
+        loops = 0       # engine steps = decode_once invocations: the
+        #                 denominator the O(rows)->O(1) claim is about
+        t0 = time.perf_counter()
+        for _ in range(20000):
+            eng.admit(pending)
+            eng.decode_once()
+            loops += 1
+            if eng.idle() and not pending:
+                break
+        wall = time.perf_counter() - t0
+        outs = [np.asarray(r.wait(timeout=120)) for r in reqs]
+        return (outs, eng.stats()["device_calls"] - calls0,
+                loops, wall, eng)
+
+    out0, calls0, steps0, wall0, _ = run_once(None)
+    out2, calls2, steps2, wall2, eng2 = run_once(2)
+    out2b, calls2b, _, _, _ = run_once(2)          # determinism repeat
+    n_dev = len(jax.devices())
+    out4 = calls4 = None
+    if n_dev >= 4:
+        out4, calls4, _, _, _ = run_once(4)
+    parity2 = all(np.array_equal(a, b) for a, b in zip(out0, out2))
+    repeat2 = all(np.array_equal(a, b) for a, b in zip(out2, out2b)) \
+        and calls2 == calls2b
+    parity4 = (all(np.array_equal(a, b) for a, b in zip(out0, out4))
+               if out4 is not None else None)
+    cps0 = calls0 / max(steps0, 1)
+    cps2 = calls2 / max(steps2, 1)
+    snap_path = _dump_metrics_snapshot(eng2, "tp")
+    print(json.dumps({
+        "metric": "tp_device_calls_per_step",
+        "value": round(cps2, 4),
+        "unit": "launches/step",
+        "vs_baseline": round(cps0 / max(cps2, 1e-9), 4),
+        "extra": {"outputs_identical_tp2": parity2,
+                  "outputs_identical_tp4": parity4,
+                  "repeat_bit_identical": repeat2,
+                  "unsharded_device_calls": calls0,
+                  "tp2_device_calls": calls2,
+                  "tp4_device_calls": calls4,
+                  "unsharded_steps": steps0,
+                  "tp2_steps": steps2,
+                  "unsharded_calls_per_step": round(cps0, 4),
+                  "unsharded_wall_s": round(wall0, 3),
+                  "tp2_wall_s": round(wall2, 3),
+                  "devices": n_dev,
+                  "metrics_snapshot": snap_path,
+                  "backend": jax.default_backend()},
+    }))
+
+
 def bench_chaos():
     """Self-healing under adversarial faults (ISSUE 9): overload-style
     seeded traffic drives a 3-worker fleet with auto-restart armed
@@ -1396,6 +1508,15 @@ def bench_smoke():
 
 
 def main():
+    if os.environ.get("BENCH_PRESET") == "tp" \
+            and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # the tp preset needs a multi-device mesh; on forced-CPU runs
+        # (smoke tests) carve 8 virtual devices BEFORE backend init
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     import jax
     on_tpu = jax.default_backend() not in ("cpu",)
 
@@ -1426,6 +1547,8 @@ def main():
         return bench_spec()
     if preset == "chaos":
         return bench_chaos()
+    if preset == "tp":
+        return bench_tp()
     if preset == "smoke":
         return bench_smoke()
     if on_tpu:

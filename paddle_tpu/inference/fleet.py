@@ -287,7 +287,7 @@ class ServingFleet:
     def __init__(self, model, n_workers=2, policy="affinity",
                  load_penalty=None, engine_kwargs=None,
                  stall_s=30.0, registry=None, qos=None,
-                 max_retries=2, restart=None):
+                 max_retries=2, restart=None, tp_degree=None):
         if n_workers < 1:
             raise ValueError(f"n_workers={n_workers}")
         if policy not in ("affinity", "round_robin"):
@@ -296,6 +296,22 @@ class ServingFleet:
         kw = dict(engine_kwargs or {})
         kw.setdefault("paged", True)
         kw.pop("qos", None)     # the fleet owns the shared QoS policy
+        # ISSUE 10: scale-out x scale-up. tp_degree builds every worker
+        # as a SHARDED engine over its own disjoint submesh (worker i
+        # gets devices [i*tp, (i+1)*tp)), so routing, failover, restart
+        # and chaos compose with tensor parallelism unchanged. The
+        # submesh is derived from the worker id in _build_worker, NOT
+        # stored in _engine_kw — a restarted worker rebuilds the SAME
+        # submesh.
+        kw.pop("mesh", None)    # per-worker submeshes only
+        self.tp_degree = int(tp_degree) if tp_degree else None
+        if self.tp_degree is not None:
+            import jax
+            n_dev = len(jax.devices())
+            if n_workers * self.tp_degree > n_dev:
+                raise ValueError(
+                    f"n_workers={n_workers} x tp_degree="
+                    f"{self.tp_degree} exceeds {n_dev} devices")
         # ISSUE 6: one QoSPolicy shared by the router (token-bucket
         # admission at submit, shed planning) and every worker engine
         # (fair-share scheduling weights). The fleet's gate is the only
@@ -391,10 +407,19 @@ class ServingFleet:
         registry, fresh watchdog, listener re-registered so the prefix
         directory repopulates as the new cache publishes)."""
         reg = MetricsRegistry()
+        kw = dict(self._engine_kw)
+        if self.tp_degree is not None:
+            import jax
+            from .sharding import make_tp_mesh
+            i = int(wid[1:])
+            kw["mesh"] = make_tp_mesh(
+                self.tp_degree,
+                devices=jax.devices()[i * self.tp_degree:
+                                      (i + 1) * self.tp_degree])
         eng = DecodeEngine(
             self.model, registry=reg, worker_id=wid,
             prefix_listener=self.directory.listener(wid),
-            qos=self.qos, **self._engine_kw)
+            qos=self.qos, **kw)
         wd = EngineStallWatchdog(
             reg, stall_s=self._stall_s,
             on_stall=lambda info, w=wid: self._mark_unhealthy(
@@ -1249,6 +1274,7 @@ class ServingFleet:
             "parked": len(self._parked),
             "degradation": self._degradation,
             "healthy_workers": sum(1 for w in self.workers if w.healthy),
+            "tp_degree": self.tp_degree or 1,
             "directory": self.directory.stats(),
             "workers": {w.wid: w.engine.stats() for w in self.workers},
         }

@@ -78,7 +78,8 @@ class DecodeEngine:
                  prefix_cache=True, registry=None, worker_id=None,
                  prefix_listener=None, qos=None, chunked_prefill=False,
                  prefill_chunk=None, step_budget=None,
-                 spec_decode=False, spec_max_draft=4, kv_dtype="fp"):
+                 spec_decode=False, spec_max_draft=4, kv_dtype="fp",
+                 mesh=None, tp_axis="tp"):
         from ..distributed.fleet.mp_layers import current_mesh
         from ..models.llama import _pp_degree
         if _pp_degree(current_mesh()) > 1:
@@ -182,6 +183,28 @@ class DecodeEngine:
                 self._sched = FairShareScheduler(qos)
             else:
                 self._sched = RequestScheduler()
+        # ISSUE 10: tensor-parallel sharded engine. ``mesh=`` shards
+        # the paged block pools (and int8 page scales) over the kv-head
+        # axis and lowers every paged program through jit + shard_map;
+        # the allocator, block tables, scheduler, prefix cache, and QoS
+        # stay host-side and replicated, so r7-r14 semantics carry over
+        # unchanged. mesh=None keeps the r14 single-device programs
+        # bit-identical.
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        self._tp = 1
+        if mesh is not None:
+            if not self.paged:
+                raise ValueError(
+                    "mesh= requires the paged engine (the block pools "
+                    "are what shards)")
+            if tp_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh axes {mesh.axis_names} have no "
+                    f"tp_axis={tp_axis!r}")
+            from .sharding import validate_tp_config
+            self._tp = int(mesh.shape[tp_axis])
+            validate_tp_config(model.config, self._tp)
         self.device_steps = 0           # decode steps actually executed
         self.prefills = 0
         self.resets = 0                 # cache resets (init counts as 1)
@@ -214,6 +237,19 @@ class DecodeEngine:
             "decode steps executed on device (stall-watchdog heartbeat)")
         self._c_prefills = r.counter(
             "engine_prefills_total", "admission prefill programs run")
+        # ISSUE 10: device-call accounting — every compiled-program
+        # launch (prefill/decode/verify/COW/mixed) bumps this, so the
+        # single-launch mixed step's O(rows)->O(1) collapse is
+        # observable next to engine_device_steps_total (which counts
+        # decode WORK, not launches)
+        self._c_device_calls = r.counter(
+            "engine_device_calls_total",
+            "compiled program launches (prefill, decode, verify, COW, "
+            "mixed)")
+        r.gauge("engine_tp_degree",
+                "tensor-parallel degree of the engine's device mesh "
+                "(1 = unsharded)",
+                fn=lambda: self._tp)
         # ISSUE 7: chunked-prefill observability beside the existing
         # prefill counter — chunks per step and the step's token load
         self._c_prefill_chunks = r.counter(
@@ -295,6 +331,11 @@ class DecodeEngine:
         cfg = m.config
         self._names = m._stacked_names()
         self._scales = getattr(m, "_quant_scales", None) or {}
+        # ISSUE 10: inside a shard_map region the paged programs run on
+        # kv-head shards and finish row-parallel matmuls with a psum
+        # over this axis; mesh=None compiles the identical r14 programs
+        # (mp=None makes every _mp_sum the identity).
+        mp = self.tp_axis if self.mesh is not None else None
 
         def _weights():
             st = {n: m._parameters[n]._value for n in self._names}
@@ -365,7 +406,7 @@ class DecodeEngine:
                 lm = embed.T
             logits, ks, vs = _llama.masked_prefill(
                 cfg, stacked, embed, fnorm, lm, ids, pad_len,
-                last_index=self.s_max - 1)
+                last_index=self.s_max - 1, mp_axis=mp)
             out = _llama.scatter_prefill_kv(
                 pool[0], pool[1], ks, vs, table_row, pad_len[0],
                 kv_scales=_kv_scales_of(pool))
@@ -384,7 +425,8 @@ class DecodeEngine:
                 tok = carry[0]
                 out = _llama._paged_decode_step(
                     cfg, stacked, embed, fnorm, lm, tok, carry[1],
-                    carry[2], tables, lens + i, *carry[3:])
+                    carry[2], tables, lens + i, *carry[3:],
+                    mp_axis=mp)
                 nxt = jnp.argmax(out[0], axis=-1)
                 return (nxt, *out[1:]), nxt
 
@@ -408,7 +450,7 @@ class DecodeEngine:
                 out = _llama.prefix_prefill(
                     cfg, stacked, embed, fnorm, lm, ids, pad_len,
                     prefix_len, pool[0], pool[1], table_row,
-                    kv_scales=_kv_scales_of(pool))
+                    kv_scales=_kv_scales_of(pool), mp_axis=mp)
                 return (jnp.argmax(out[0], axis=-1), *out[1:])
 
             return prefill_prefix
@@ -432,10 +474,27 @@ class DecodeEngine:
                 out = _llama.prefix_prefill(
                     cfg, stacked, embed, fnorm, lm, ids, pad_len,
                     prefix_len, pool[0], pool[1], table_row,
-                    kv_scales=_kv_scales_of(pool), all_logits=True)
+                    kv_scales=_kv_scales_of(pool), all_logits=True,
+                    mp_axis=mp)
                 return (jnp.argmax(out[0], axis=-1), *out[1:])
 
             return verify_prefill
+
+        def mixed_step(stacked, embed, fnorm, lm, scales, ids, q_lens,
+                       kv_lens, tables, *pool):
+            """ISSUE 10 single-launch step: decode rows, verify windows
+            and prefill chunks ride ONE ``mixed_paged_attention``
+            program — ids [B, T] LEFT-aligned with per-row q_lens,
+            kv_lens INCLUDING this launch's tokens. Returns the argmax
+            at every window position (the engine reads greedy chains /
+            chunk boundaries off it host-side)."""
+            stacked, lm = _llama._dequantize_weights(cfg, stacked, lm,
+                                                     scales)
+            if lm is None:
+                lm = embed.T
+            return _llama.mixed_paged_step(
+                cfg, stacked, embed, fnorm, lm, ids, q_lens, kv_lens,
+                tables, *pool, mp_axis=mp)
 
         def cow_copy(src, dst, *pool):
             """Copy-on-write: clone page ``src`` into the row's private
@@ -452,14 +511,73 @@ class DecodeEngine:
         self._make_verify_prefill = make_verify_prefill
         self._verify_progs = {}
         self._n_pool = 4 if self._kv_q else 2
+        if self.paged and self.mesh is not None:
+            # ISSUE 10: lower every paged program through shard_map
+            # over the kv-head axis. Weights shard Megatron column/row,
+            # pools shard on kv heads, host data (ids, tables, lens)
+            # replicates, and outputs replicate (the programs finish
+            # row-parallel matmuls with a psum, so every shard holds
+            # identical logits/tokens).
+            from jax.sharding import NamedSharding as _NS
+            from jax.sharding import PartitionSpec as _P
+
+            from ..utils.compat import shard_map as _shard_map
+            from .sharding import (pool_specs, quant_scale_specs,
+                                   stacked_weight_specs)
+            _R = _P()
+            ax = self.tp_axis
+            wsp = stacked_weight_specs(self._names, ax)
+            ssp = quant_scale_specs(self._scales, ax)
+            psp = pool_specs(self._n_pool, ax)
+
+            def _tp_wrap(fn, n_data):
+                """(weights..., scales, <n_data host args>, *pool) →
+                sharded program with replicated outputs. A ``P()``
+                prefix covers the tied-embedding case (lm=None has no
+                leaves to place)."""
+                return _shard_map(
+                    fn, mesh=self.mesh,
+                    in_specs=(wsp, _R, _R, _R, ssp,
+                              *([_R] * n_data), *psp),
+                    out_specs=(_R, *psp))
+
+            cow_wrapped = _shard_map(cow_copy, mesh=self.mesh,
+                                     in_specs=(_R, _R, *psp),
+                                     out_specs=psp)
+
+            def _placed_weights(_cache={}):
+                # device_put ONCE per engine: stacked weights land
+                # pre-sharded so each launch ships no weight bytes.
+                if "w" not in _cache:
+                    st, embed, fnorm, lm = _weights()
+                    put = lambda a, sp: jax.device_put(
+                        a, _NS(self.mesh, sp))
+                    st = {n: put(v, wsp[n]) for n, v in st.items()}
+                    _cache["w"] = (st, put(embed, _R), put(fnorm, _R),
+                                   None if lm is None else put(lm, _R))
+                return _cache["w"]
+
+            self._weights = _placed_weights
+            self._scales = {n: jax.device_put(
+                v, _NS(self.mesh, ssp[n]))
+                for n, v in self._scales.items()}
+        else:
+            def _tp_wrap(fn, n_data):
+                return fn
+
+            cow_wrapped = cow_copy
+        self._tp_wrap = _tp_wrap
         if self.paged:
-            self._prefill = jax.jit(prefill_paged)
+            self._prefill = jax.jit(_tp_wrap(prefill_paged, 3))
             self._decode = jax.jit(
-                decode_chunk_paged,
+                _tp_wrap(decode_chunk_paged, 3),
                 donate_argnums=tuple(range(8, 8 + self._n_pool)))
             self._cow = jax.jit(
-                cow_copy,
+                cow_wrapped,
                 donate_argnums=tuple(range(2, 2 + self._n_pool)))
+            self._mixed = jax.jit(
+                _tp_wrap(mixed_step, 4),
+                donate_argnums=tuple(range(9, 9 + self._n_pool)))
         else:
             self._prefill = jax.jit(prefill)
             self._decode = self._decode_for(self.chunk)
@@ -495,7 +613,8 @@ class DecodeEngine:
         import jax
         fn = self._prefix_progs.get(sc)
         if fn is None:
-            fn = jax.jit(self._make_prefix_prefill(sc),
+            fn = jax.jit(self._tp_wrap(self._make_prefix_prefill(sc),
+                                       4),
                          donate_argnums=tuple(
                              range(9, 9 + self._n_pool)))
             self._prefix_progs[sc] = fn
@@ -508,7 +627,8 @@ class DecodeEngine:
         import jax
         fn = self._verify_progs.get(sc)
         if fn is None:
-            fn = jax.jit(self._make_verify_prefill(sc),
+            fn = jax.jit(self._tp_wrap(self._make_verify_prefill(sc),
+                                       4),
                          donate_argnums=tuple(
                              range(9, 9 + self._n_pool)))
             self._verify_progs[sc] = fn
@@ -534,6 +654,21 @@ class DecodeEngine:
                     KV_SCALE_EPS, jnp.float32)
                 self._vscale = jnp.full_like(self._kscale,
                                              KV_SCALE_EPS)
+            if self.mesh is not None:
+                # ISSUE 10: the pools live pre-sharded over the kv-head
+                # axis — donated through every program, they stay
+                # sharded for the engine's lifetime.
+                import jax
+                from jax.sharding import NamedSharding
+                from .sharding import pool_specs
+                psp = pool_specs(4 if self._kv_q else 2, self.tp_axis)
+                put = lambda a, sp: jax.device_put(
+                    a, NamedSharding(self.mesh, sp))
+                self._kp = put(self._kp, psp[0])
+                self._vp = put(self._vp, psp[1])
+                if self._kv_q:
+                    self._kscale = put(self._kscale, psp[2])
+                    self._vscale = put(self._vscale, psp[3])
             self._alloc = BlockAllocator(self.n_blocks)
             # int8: recycled pages must drop the previous tenant's
             # running-max scale before their next write
@@ -634,8 +769,13 @@ class DecodeEngine:
              "preempted": int(self._c_preempted.value),
              "prefix_hit_tokens": int(self._c_prefix_hit.value),
              "device_steps": self.device_steps,
+             "device_calls": int(self._c_device_calls.value),
+             "tp_degree": self._tp,
              "prefills": self.prefills,
              "resets": self.resets}
+        if self.mesh is not None:
+            s["mesh_shape"] = {k: int(v)
+                               for k, v in self.mesh.shape.items()}
         if self.paged:
             s["pool"] = self._alloc.stats()
             s["backlog"] = self.backlog
@@ -763,6 +903,7 @@ class DecodeEngine:
                 continue
             self.prefills += 1
             self._c_prefills.inc()
+            self._c_device_calls.inc()
             self._c_admitted.inc()
             # insert this row's lane: [L, 1, sc, kvh, hd] -> slot
             self._ck = jax.lax.dynamic_update_slice(
@@ -1086,6 +1227,7 @@ class DecodeEngine:
                 jnp.asarray([pad], jnp.int32), jnp.asarray(table_row),
                 *self._pool())
             self._set_pool(pool)
+            self._c_device_calls.inc()
         else:
             if m.cow_src is not None:
                 # private copy of the partially-shared page: the tail's
@@ -1094,6 +1236,7 @@ class DecodeEngine:
                     jnp.asarray(m.cow_src, jnp.int32),
                     jnp.asarray(pages[0], jnp.int32), *self._pool()))
                 self._cache.release_cow(m)
+                self._c_device_calls.inc()
             tail = seq[cached:]
             sc = self._bucket_window(tail.size)
             ids = _np.full((1, sc), self.pad_id, _np.int32)
@@ -1105,6 +1248,7 @@ class DecodeEngine:
                 jnp.asarray([cached], jnp.int32),
                 jnp.asarray(table_row), *self._pool())
             self._set_pool(pool)
+            self._c_device_calls.inc()
         self._tables[slot] = table_row
         return int(first[0])
 
@@ -1130,6 +1274,7 @@ class DecodeEngine:
                     jnp.asarray(m.cow_src, jnp.int32),
                     jnp.asarray(pages[0], jnp.int32), *self._pool()))
             self._cache.release_cow(m)
+            self._c_device_calls.inc()
         all_pages = (m.pages if m is not None else []) + pages
         table_row = _np.zeros((self._max_blocks,), _np.int32)
         table_row[:len(all_pages)] = all_pages
@@ -1201,6 +1346,7 @@ class DecodeEngine:
                 jnp.asarray([pos], jnp.int32),
                 jnp.asarray(row["pf_table"]), *self._pool())
             self._set_pool(pool)
+        self._c_device_calls.inc()
         row["pf_pos"] = pos + tail.size
         self._c_prefill_chunks.inc()
         _tmark(req, "prefill_chunk", worker=self.worker_id)
@@ -1233,6 +1379,16 @@ class DecodeEngine:
         if self.idle():
             return 0
         if self.paged:
+            if self.mesh is not None and (
+                    self.spec_decode
+                    or (self.chunked_prefill
+                        and any(r is not None and "pf_seq" in r
+                                for r in self._rows))):
+                # ISSUE 10: sharded engines collapse verify windows and
+                # prefill chunks into ONE mixed launch per step. Plain
+                # decode with no mid-prefill rows keeps the chunk-scan
+                # program (chunk tokens per launch beats one).
+                return self._decode_once_mixed()
             if self.spec_decode:
                 return self._decode_once_spec()
             return self._decode_once_paged()
@@ -1270,6 +1426,7 @@ class DecodeEngine:
         self._g += steps
         self.device_steps += steps
         self._c_steps.inc(steps)
+        self._c_device_calls.inc()
         self._h_chunk.observe(wall)
         n_busy = sum(r is not None for r in self._rows)
         self._g_occupancy.set(n_busy)
@@ -1445,6 +1602,7 @@ class DecodeEngine:
         wall = _now() - t0
         self.device_steps += self.chunk
         self._c_steps.inc(self.chunk)
+        self._c_device_calls.inc()
         self._h_chunk.observe(wall)
         n_busy = sum(r is not None for r in self._rows)
         self._g_occupancy.set(n_busy)
@@ -1562,6 +1720,60 @@ class DecodeEngine:
                 alive += 1
         return alive
 
+    def _grow_decode_row(self, slot, row, n_new) -> bool:
+        """Grow ONE decode-ready row's page list to cover ``n_new`` new
+        KV writes. Returns True iff the row survived and its table
+        covers the writes; on failure the row was failed or losslessly
+        self-preempted (the caller must NOT launch for it). Growth may
+        preempt OTHER rows (``exclude=slot`` protects this one), with
+        the anti-livelock rule that a decode-complete row outranks
+        equal-or-lower-priority rows still MID-prefill — they lose the
+        least work and resume losslessly."""
+        bs = self.block_size
+        req = row["req"]
+        lens0 = int(self._lens[slot])
+        target = lens0 + n_new
+        if target > self.s_max:
+            self._fail_row_paged(slot, RuntimeError(
+                f"row exceeds engine s_max={self.s_max} at length "
+                f"{lens0}"))
+            return False
+        extra = -(-target // bs) - len(row["pages"])
+        if extra <= 0:
+            return True
+        pages = self._reclaim_allocate(extra, self._prio(req),
+                                       exclude=slot, claimant=req)
+        if pages is None and self.chunked_prefill:
+            my_p = self._prio(req)
+            pf = [i for i, r in enumerate(self._rows)
+                  if r is not None and i != slot and "pf_seq" in r
+                  and self._prio(r["req"]) <= my_p]
+            pf.sort(key=lambda i: -self._rows[i]["req"]._sched_seq)
+            while pages is None and pf:
+                v = pf.pop(0)
+                evicted = int(self._rows[v]["pf_pos"])
+                self._preempt_row(v)
+                self._qos_charge(req, evicted)
+                if self._cache is not None:
+                    self._evict_cached(extra - self._alloc.num_free)
+                pages = self._alloc.allocate(extra)
+        if pages is None:
+            others = any(r is not None and i != slot
+                         for i, r in enumerate(self._rows))
+            if others and self._cache is not None:
+                # lossless self-preemption (mirrors the plain path)
+                self._preempt_row(slot)
+                return False
+            self._fail_row_paged(slot, RuntimeError(
+                f"paged KV pool exhausted: needed {extra} more "
+                f"pages, {self._alloc.num_free} free "
+                f"(n_blocks={self.n_blocks}, bs={bs})"))
+            return False
+        start = len(row["pages"])
+        row["pages"] = row["pages"] + pages
+        self._tables[slot, start:start + extra] = pages
+        return True
+
     def _verify_row(self, slot, row, draft):
         """Grow, verify, and accept for ONE row (one device step).
 
@@ -1582,52 +1794,11 @@ class DecodeEngine:
         emitted history and resumes losslessly."""
         import jax.numpy as jnp
         import numpy as _np
-        bs = self.block_size
         req = row["req"]
         k = int(draft.size)
         lens0 = int(self._lens[slot])
-        target = lens0 + k + 1
-        if target > self.s_max:
-            self._fail_row_paged(slot, RuntimeError(
-                f"row exceeds engine s_max={self.s_max} at length "
-                f"{lens0}"))
+        if not self._grow_decode_row(slot, row, k + 1):
             return
-        extra = -(-target // bs) - len(row["pages"])
-        if extra > 0:
-            pages = self._reclaim_allocate(extra, self._prio(req),
-                                           exclude=slot, claimant=req)
-            if pages is None and self.chunked_prefill:
-                # decode-complete growth outranks equal-or-lower
-                # priority mid-prefill rows (same anti-livelock rule as
-                # the plain path)
-                my_p = self._prio(req)
-                pf = [i for i, r in enumerate(self._rows)
-                      if r is not None and i != slot and "pf_seq" in r
-                      and self._prio(r["req"]) <= my_p]
-                pf.sort(key=lambda i: -self._rows[i]["req"]._sched_seq)
-                while pages is None and pf:
-                    v = pf.pop(0)
-                    evicted = int(self._rows[v]["pf_pos"])
-                    self._preempt_row(v)
-                    self._qos_charge(req, evicted)
-                    if self._cache is not None:
-                        self._evict_cached(extra - self._alloc.num_free)
-                    pages = self._alloc.allocate(extra)
-            if pages is None:
-                others = any(r is not None and i != slot
-                             for i, r in enumerate(self._rows))
-                if others and self._cache is not None:
-                    # lossless self-preemption (mirrors the plain path)
-                    self._preempt_row(slot)
-                    return
-                self._fail_row_paged(slot, RuntimeError(
-                    f"paged KV pool exhausted: needed {extra} more "
-                    f"pages, {self._alloc.num_free} free "
-                    f"(n_blocks={self.n_blocks}, bs={bs})"))
-                return
-            start = len(row["pages"])
-            row["pages"] = row["pages"] + pages
-            self._tables[slot, start:start + extra] = pages
         st, embed, fnorm, lm = self._weights()
         self._drain_scale_resets()
         tail = _np.empty((k + 1,), _np.int32)
@@ -1650,6 +1821,7 @@ class DecodeEngine:
         wall = _now() - t0
         self.device_steps += 1
         self._c_steps.inc(1)
+        self._c_device_calls.inc()
         self._h_chunk.observe(wall)
         out = [int(preds[0])]
         for i in range(k):
@@ -1680,6 +1852,180 @@ class DecodeEngine:
                 self.qos.note_served(tenant_of(req), req.max_new)
         else:
             self._lens[slot] = lens0 + m_len
+
+    # -- single-launch mixed step (ISSUE 10 tentpole) -----------------------
+    def _decode_once_mixed(self):
+        """ONE device launch per engine step: every decode-ready row's
+        verify window (its pending token + k drafts; k=0 without spec
+        decode) and every budget-funded prefill chunk ride a single
+        ``mixed_paged_attention`` program with per-row ``q_lens`` —
+        the O(rows)→O(1) launch collapse the ragged kernel was built
+        for (the bench counts device calls to prove it). Token outputs
+        are bit-identical to the per-row paths: every emitted token is
+        the program's argmax at its position, and acceptance walks the
+        same greedy chain ``_verify_row`` does. Schedule differs (a row
+        finishing its last chunk decodes from the NEXT step, and plain
+        decode lanes advance one token per launch instead of a chunk)
+        but per-request greedy sequences cannot."""
+        import jax.numpy as jnp
+        import numpy as _np
+
+        def _draft(slot, row):
+            return self._draft_for(slot, row) if self.spec_decode \
+                else _np.zeros((0,), _np.int32)
+
+        # plan: decode lanes force-charge their verify tokens (the
+        # budget pays for PROPOSED work), prefill chunks are funded
+        # from the remainder — same accounting as the per-row paths
+        drafts = {}
+        chunk_plan = []
+        if self.chunked_prefill:
+            from .scheduler import StepBudget
+            budget = StepBudget(self.step_budget)
+            for slot, row in enumerate(self._rows):
+                if row is not None and "pf_seq" not in row:
+                    d = _draft(slot, row)
+                    drafts[slot] = d
+                    budget.take(d.size + 1, force=True)
+            slots = {}
+            cands = []
+            for slot, row in enumerate(self._rows):
+                if row is None or "pf_seq" not in row:
+                    continue
+                take = min(self.prefill_chunk,
+                           row["pf_seq"].size - row["pf_pos"])
+                cands.append((row["req"], take))
+                slots[id(row["req"])] = slot
+            for req, take in self._sched.plan_prefill(budget, cands):
+                chunk_plan.append((slots[id(req)], take))
+            self._h_budget.observe(budget.used)
+        for slot, row in enumerate(self._rows):
+            if row is not None and "pf_seq" not in row \
+                    and slot not in drafts:
+                drafts[slot] = _draft(slot, row)
+        # grow decode lanes to cover this step's writes (may preempt
+        # other rows — the window build below re-checks survivors)
+        for slot in sorted(drafts):
+            row = self._rows[slot]
+            if row is None or "pf_seq" in row:
+                continue
+            self._grow_decode_row(slot, row,
+                                  int(drafts[slot].size) + 1)
+        # build the ragged window batch: LEFT-aligned tails, kv_lens
+        # INCLUDING this launch's tokens (scatter-then-attend), chunk
+        # lanes through their PRIVATE tables, idle lanes q_len=0
+        windows = []
+        for slot, take in chunk_plan:
+            row = self._rows[slot]
+            if row is None or "pf_seq" not in row:
+                continue        # preempted by a decode lane's growth
+            pos0 = int(row["pf_pos"])
+            tail = _np.asarray(row["pf_seq"][pos0:pos0 + take],
+                               _np.int32)
+            windows.append((slot, row, "chunk", tail,
+                            pos0 + tail.size, row["pf_table"]))
+        for slot in sorted(drafts):
+            row = self._rows[slot]
+            if row is None or "pf_seq" in row:
+                continue        # preempted/failed during growth
+            d = drafts[slot]
+            tail = _np.empty((int(d.size) + 1,), _np.int32)
+            tail[0] = self._tok[slot]
+            tail[1:] = d
+            windows.append((slot, row, "decode", tail,
+                            int(self._lens[slot]) + tail.size,
+                            self._tables[slot]))
+        n_busy = sum(r is not None for r in self._rows)
+        self._g_occupancy.set(n_busy)
+        if not windows:
+            return n_busy
+        B = self.capacity
+        T = self._bucket_window(max(t[3].size for t in windows))
+        ids = _np.full((B, T), self.pad_id, _np.int32)
+        q_lens = _np.zeros((B,), _np.int32)
+        kv_lens = _np.zeros((B,), _np.int32)
+        tabs = _np.zeros((B, self._max_blocks), _np.int32)
+        for slot, row, kind, tail, kvl, table in windows:
+            ids[slot, :tail.size] = tail
+            q_lens[slot] = tail.size
+            kv_lens[slot] = kvl
+            tabs[slot] = table
+        st, embed, fnorm, lm = self._weights()
+        self._drain_scale_resets()
+        t0 = _now()
+        with RecordEvent("engine.mixed_step", "engine",
+                         worker=self.worker_id):
+            preds, *pool = self._mixed(
+                st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
+                jnp.asarray(q_lens), jnp.asarray(kv_lens),
+                jnp.asarray(tabs), *self._pool())
+            self._set_pool(pool)
+            preds = _np.asarray(preds)   # [B, T] argmax per position
+        wall = _now() - t0
+        self.device_steps += 1
+        self._c_steps.inc(1)
+        self._c_device_calls.inc()
+        self._h_chunk.observe(wall)
+        log_event("engine_mixed_step", rows=len(windows),
+                  window=T, wall_s=round(wall, 4),
+                  blocks_used=self._alloc.num_used,
+                  blocks_free=self._alloc.num_free)
+        for slot, row, kind, tail, kvl, table in windows:
+            if self._rows[slot] is not row:
+                continue
+            req = row["req"]
+            if kind == "chunk":
+                take = tail.size
+                row["pf_pos"] = int(row["pf_pos"]) + take
+                self._c_prefill_chunks.inc()
+                _tmark(req, "prefill_chunk", worker=self.worker_id)
+                self._qos_charge(req, take)
+                if row["pf_pos"] >= row["pf_seq"].size:
+                    # last chunk: its last-real-position argmax IS the
+                    # first token (mirrors _prefill_chunk_row)
+                    resume = row.pop("pf_resume")
+                    toks = list(resume) if resume \
+                        else [int(preds[slot, take - 1])]
+                    self._tables[slot] = row.pop("pf_table")
+                    self._lens[slot] = row["pf_seq"].size
+                    self._tok[slot] = toks[-1]
+                    row["toks"] = toks
+                    del row["pf_seq"], row["pf_pos"]
+                    self.prefills += 1
+                    self._c_prefills.inc()
+                    self._observe_first_token(req)
+                continue
+            # decode/verify lane: greedy accept chain off the window
+            k = tail.size - 1
+            out = [int(preds[slot, 0])]
+            for i in range(k):
+                if int(tail[i + 1]) != out[i]:
+                    break
+                out.append(int(preds[slot, i + 1]))
+            m_len = len(out)
+            if self.spec_decode:
+                self._c_spec_proposed.inc(k)
+                self._c_spec_accepted.inc(m_len - 1)
+                self._h_spec_accept.observe(m_len)
+                _tmark(req, "spec_verify", worker=self.worker_id)
+            row["toks"].extend(out)
+            self._tok[slot] = out[-1]
+            _tmark(req, "decode_chunk", worker=self.worker_id,
+                   n_tokens=m_len)
+            self._qos_charge(req, m_len)
+            if len(row["toks"]) >= req.max_new:
+                req.result = _np.concatenate(
+                    [row["prompt"],
+                     _np.asarray(row["toks"][:req.max_new],
+                                 _np.int32)])
+                self._retire_paged(slot)
+                req.event.set()
+                if self.qos is not None:
+                    from .qos import tenant_of
+                    self.qos.note_served(tenant_of(req), req.max_new)
+            else:
+                self._lens[slot] = kvl - tail.size + m_len
+        return sum(r is not None for r in self._rows)
 
 
 class GenerationPredictor:

@@ -1,0 +1,129 @@
+"""Tensor-parallel device wiring for the sharded DecodeEngine
+(ISSUE 10 tentpole; reference shape: GSPMD sharding annotations +
+shard_map-lowered programs, PAPERS.md, and the Megatron column/row
+pattern already manual-coded in ``models/llama.py``).
+
+Design (SURVEY §7.17):
+
+- What SHARDS: the paged KV block pools ``[L, N, bs, kvh, hd]`` carry a
+  ``PartitionSpec`` over the kv-head axis (axis 3), the int8 page
+  scales ``[L, N, kvh]`` shard alongside on their kvh axis, and the
+  attention/MLP weights shard column/row Megatron-style (head and ff
+  columns split, ``wo``/``w_down`` rows split and psum-finished inside
+  the program). Embedding, norms, router, and lm_head replicate.
+- What REPLICATES: block tables, lens, ids windows — host-side data.
+- Why the allocator stays HOST-SIDE: page ids index the pool's
+  *unsharded* N axis, so one allocation decision is valid on every
+  shard — allocation, COW, preemption, chunked prefill, and quarantine
+  semantics are device-count-independent and carry over from r7–r14
+  unchanged. Sharding the allocator would buy nothing (it holds no
+  tensor data) and cost a coherence protocol.
+
+The programs themselves lower through ``jit`` + ``shard_map`` (via
+``utils.compat.shard_map``, which maps to the experimental shard_map on
+older jax); this module only builds meshes and the PartitionSpec
+pytrees the engine feeds those calls.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["TP_AXIS", "make_tp_mesh", "validate_tp_config",
+           "stacked_weight_specs", "quant_scale_specs", "pool_specs"]
+
+TP_AXIS = "tp"
+
+# Megatron layout over the stacked [L, ...] parameter tree:
+# column-parallel weights split their OUTPUT features (heads / ff
+# columns), row-parallel weights split the matching CONTRACTION axis
+# and their matmuls finish with a psum inside the program.
+_COL_LAST = ("wq", "wk", "wv", "w_gate", "w_up", "ws_gate", "ws_up")
+_COL_BIAS = ("bq", "bk", "bv")
+_ROW_AXIS1 = ("wo", "w_down", "ws_down")
+_EXPERT_COL = ("we_gate", "we_up")      # [L, E, d, ff] — split ff
+_EXPERT_ROW = ("we_down",)              # [L, E, ff, d] — split ff
+
+
+def make_tp_mesh(tp_degree, devices=None, axis=TP_AXIS):
+    """A 1-D mesh of ``tp_degree`` devices for the sharded engine.
+    ``devices``: explicit device list (the fleet carves submeshes out
+    of ``jax.devices()`` this way); default takes the first
+    ``tp_degree`` global devices."""
+    import jax
+    import numpy as np
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < tp_degree:
+        raise ValueError(
+            f"tp_degree={tp_degree} needs {tp_degree} devices, have "
+            f"{len(devs)}")
+    return Mesh(np.asarray(devs[:tp_degree]), (axis,))
+
+
+def validate_tp_config(cfg, tp):
+    """Divisibility the kv-head sharding requires. Checked at engine
+    construction so a bad degree fails loudly instead of as a cryptic
+    shard_map shape error."""
+    if tp < 1:
+        raise ValueError(f"tp_degree={tp}")
+    if cfg.num_key_value_heads % tp:
+        raise ValueError(
+            f"num_key_value_heads={cfg.num_key_value_heads} not "
+            f"divisible by tp={tp} (the KV pool shards over kv heads)")
+    if cfg.num_attention_heads % tp:
+        raise ValueError(
+            f"num_attention_heads={cfg.num_attention_heads} not "
+            f"divisible by tp={tp}")
+    if cfg.intermediate_size % tp:
+        raise ValueError(
+            f"intermediate_size={cfg.intermediate_size} not divisible "
+            f"by tp={tp}")
+
+
+def stacked_weight_specs(names, axis=TP_AXIS):
+    """PartitionSpec per stacked-parameter name (Megatron column/row
+    table above; anything unlisted — norms, router — replicates)."""
+    specs = {}
+    for n in names:
+        if n in _COL_LAST:
+            specs[n] = P(None, None, axis)
+        elif n in _COL_BIAS:
+            specs[n] = P(None, axis)
+        elif n in _ROW_AXIS1:
+            specs[n] = P(None, axis, None)
+        elif n in _EXPERT_COL:
+            specs[n] = P(None, None, None, axis)
+        elif n in _EXPERT_ROW:
+            specs[n] = P(None, None, axis, None)
+        else:
+            specs[n] = P()
+    return specs
+
+
+def quant_scale_specs(scales, axis=TP_AXIS):
+    """Specs for the weight-only int8 scales (``quantize_weights_int8``
+    keeps one scale per OUTPUT channel, amax over the contraction axis
+    with keepdims): column-parallel weights shard their scale's output
+    axis alongside the weight; row-parallel weights keep per-d scales,
+    which replicate. ``lm_head`` replicates with its weight."""
+    specs = {}
+    for n, v in scales.items():
+        if n in _COL_LAST:
+            specs[n] = P(None, None, axis)
+        elif n in _EXPERT_COL:
+            specs[n] = P(None, None, None, axis)
+        else:
+            specs[n] = P()
+    return specs
+
+
+def pool_specs(n_pool, axis=TP_AXIS):
+    """Specs for the paged-program pool tail: kp/vp
+    ``[L, N, bs, kvh, hd]`` shard their kv-head axis; the int8 page
+    scales ``[L, N, kvh]`` shard alongside (a page's scale lives with
+    its codes — no cross-device scale lookup on the write path)."""
+    kv = P(None, None, None, axis, None)
+    if n_pool == 4:
+        sc = P(None, None, axis)
+        return (kv, kv, sc, sc)
+    return (kv, kv)

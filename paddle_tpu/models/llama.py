@@ -993,7 +993,7 @@ def _quantized_token_insert(pool, scales, page, off, tok):
 
 
 def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens,
-                             kscale=None, vscale=None):
+                             kscale=None, vscale=None, mp_axis=None):
     """One decoder layer for ONE token per row against the PAGED KV
     cache: kp/vp [N, bs, kvh, hd] block pool, tables [b, max_blocks]
     int32 page ids, lens [b] int32 = tokens already cached (the new
@@ -1001,7 +1001,10 @@ def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens,
     at its own position 0, so admission needs no global fill. With
     ``kscale``/``vscale`` ([N, kvh] f32) the pools are int8 codes:
     writes go through :func:`_quantized_token_insert` and the attention
-    dequantizes inside the paged program."""
+    dequantizes inside the paged program. ``mp_axis``: inside a
+    shard_map region the pool/weights are kv-head shards and the
+    wo/w_down matmuls finish with a psum (ISSUE 10, same Megatron
+    pattern as _decoder_layer)."""
     hd = cfg.head_dim
     h = lp["wq"].shape[-1] // hd
     kvh = lp["wk"].shape[-1] // hd
@@ -1009,6 +1012,9 @@ def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens,
     bs = kp.shape[1]
     g = h // kvh
     pos = lens[:, None]                      # per-row rope position
+
+    def _mp_sum(a):
+        return safe_psum(a, mp_axis) if mp_axis is not None else a
 
     y = _rms(x, lp["input_ln"], cfg.rms_norm_eps)
     q = y @ lp["wq"]
@@ -1041,22 +1047,23 @@ def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens,
     attn = paged_decode_attention(qg, kp, vp, tables, lens + 1,
                                   kv_scales=kv_scales)
     attn = attn.astype(x.dtype).reshape(b, 1, h * hd)
-    x = x + attn @ lp["wo"]
+    x = x + _mp_sum(attn @ lp["wo"])
 
     y = _rms(x, lp["post_ln"], cfg.rms_norm_eps)
     if cfg.num_experts > 0:
         mlp_out, _ = _moe_mlp(cfg, lp, y, lambda a, spec: a,
+                              mp_axis=mp_axis,
                               capacity_override=b * cfg.num_experts_per_tok)
         x = x + mlp_out
     else:
         gate = jax.nn.silu(y @ lp["w_gate"])
-        x = x + (gate * (y @ lp["w_up"])) @ lp["w_down"]
+        x = x + _mp_sum((gate * (y @ lp["w_up"])) @ lp["w_down"])
     return x, kp, vp, kscale, vscale
 
 
 def _paged_decode_step(cfg, stacked, embed, final_norm, lm_head, token,
                        pages_k, pages_v, tables, lens, kscales=None,
-                       vscales=None):
+                       vscales=None, mp_axis=None):
     """Jittable paged single-token step: [b] token ids +
     [L, N, bs, kvh, hd] block pools + [b, max_blocks] tables + [b] lens
     -> (logits [b, V], updated pools). The tables/lens are DATA, so one
@@ -1069,7 +1076,7 @@ def _paged_decode_step(cfg, stacked, embed, final_norm, lm_head, token,
         def layer_fn(carry, xs):
             lp, kp, vp = xs
             out, kp, vp, _, _ = _paged_decode_layer_step(
-                cfg, lp, carry, kp, vp, tables, lens)
+                cfg, lp, carry, kp, vp, tables, lens, mp_axis=mp_axis)
             return out, (kp, vp)
 
         x, (kps, vps) = jax.lax.scan(layer_fn, x,
@@ -1081,7 +1088,8 @@ def _paged_decode_step(cfg, stacked, embed, final_norm, lm_head, token,
     def layer_fn(carry, xs):
         lp, kp, vp, ksc, vsc = xs
         out, kp, vp, ksc, vsc = _paged_decode_layer_step(
-            cfg, lp, carry, kp, vp, tables, lens, ksc, vsc)
+            cfg, lp, carry, kp, vp, tables, lens, ksc, vsc,
+            mp_axis=mp_axis)
         return out, (kp, vp, ksc, vsc)
 
     x, (kps, vps, kscales, vscales) = jax.lax.scan(
@@ -1153,6 +1161,150 @@ def scatter_prefill_kv(kp, vp, ks, vs, table_row, pad, offset=0,
     return kp, vp
 
 
+def _quantized_mixed_scatter(pool, scales, toks, page, off, valid,
+                             tables):
+    """int8 write half of the MIXED step for ONE layer's pool (ISSUE
+    10): the [B, T] window generalization of
+    :func:`_quantized_prefill_scatter`. pool [N, bs, kvh, hd] int8;
+    scales [N, kvh] f32; toks [B, T, kvh, hd] f32; page/off/valid
+    [B, T]; tables [B, mb]. The scale update is the same
+    order-independent scatter-max, then every page any row references
+    is re-expressed in its grown scale — ratio exactly 1.0 (codes
+    bit-identical) for pages whose max didn't move, which includes
+    every SHARED prefix page: valid window writes only target the
+    row's private tail pages, so rows sharing a page re-express it to
+    identical values and the duplicate scatter is deterministic.
+    Padding slots (valid=False) contribute amax 0 and write the NULL
+    page, same as the per-row scatter."""
+    amax = jnp.where(valid[..., None],
+                     jnp.abs(toks).max(axis=-1), 0.0)    # [B, T, kvh]
+    old_all = scales
+    scales = scales.at[page].max(amax / 127.0)
+    codes = jnp.take(pool, tables, axis=0)   # [B, mb, bs, kvh, hd]
+    old = jnp.take(old_all, tables, axis=0)              # [B, mb, kvh]
+    new = jnp.take(scales, tables, axis=0)
+    ratio = (old / new)[:, :, None, :, None]
+    req = jnp.clip(jnp.round(codes.astype(jnp.float32) * ratio),
+                   -127, 127)
+    pool = pool.at[tables].set(req.astype(pool.dtype))
+    sc_tok = jnp.take(scales, page, axis=0)              # [B, T, kvh]
+    qt = jnp.clip(jnp.round(toks / sc_tok[..., None]), -127, 127)
+    pool = pool.at[page, off].set(qt.astype(pool.dtype))
+    return pool, scales
+
+
+def _mixed_decoder_layer(cfg, lp, x, positions, valid, page, off,
+                         tables, kv_lens, q_lens, kp, vp, kscale=None,
+                         vscale=None, mp_axis=None):
+    """One decoder layer for a MIXED window batch (ISSUE 10 tentpole):
+    row b carries q_lens[b] window tokens (LEFT-aligned — a prefill
+    chunk, a verify window, or a single decode token) ending at context
+    position kv_lens[b]-1. Scatter-then-attend, the mixed kernel's
+    contract: the window's K/V land in the pool first, then
+    ``mixed_paged_attention`` reads every position below kv_lens. With
+    ``mp_axis`` the wo/w_down matmuls finish with a psum (manual
+    Megatron TP inside shard_map, same pattern as _decoder_layer)."""
+    from ..kernels.paged_attention import mixed_paged_attention
+    hd = cfg.head_dim
+    h = lp["wq"].shape[-1] // hd
+    kvh = lp["wk"].shape[-1] // hd
+    b, t, d = x.shape
+    g = h // kvh
+
+    def _mp_sum(a):
+        return safe_psum(a, mp_axis) if mp_axis is not None else a
+
+    y = _rms(x, lp["input_ln"], cfg.rms_norm_eps)
+    q = y @ lp["wq"]
+    k = y @ lp["wk"]
+    v = y @ lp["wv"]
+    if "bq" in lp:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = _rope(q.reshape(b, t, h, hd), positions, cfg.rope_theta, hd)
+    k = _rope(k.reshape(b, t, kvh, hd), positions, cfg.rope_theta, hd)
+    v = v.reshape(b, t, kvh, hd)
+    if kscale is not None:
+        kp, kscale = _quantized_mixed_scatter(
+            kp, kscale, k.astype(jnp.float32), page, off, valid,
+            tables)
+        vp, vscale = _quantized_mixed_scatter(
+            vp, vscale, v.astype(jnp.float32), page, off, valid,
+            tables)
+        kv_scales = (kscale, vscale)
+    else:
+        kp = kp.at[page, off].set(k.astype(kp.dtype))
+        vp = vp.at[page, off].set(v.astype(vp.dtype))
+        kv_scales = None
+    qg = q.reshape(b, t, kvh, g, hd)
+    attn = mixed_paged_attention(qg, kp, vp, tables, kv_lens, q_lens,
+                                 kv_scales=kv_scales)
+    attn = attn.astype(x.dtype).reshape(b, t, h * hd)
+    x = x + _mp_sum(attn @ lp["wo"])
+
+    y = _rms(x, lp["post_ln"], cfg.rms_norm_eps)
+    if cfg.num_experts > 0:
+        mlp_out, _ = _moe_mlp(cfg, lp, y, lambda a, spec: a,
+                              mp_axis=mp_axis,
+                              capacity_override=max(
+                                  1, b * t * cfg.num_experts_per_tok))
+        x = x + mlp_out
+    else:
+        gate = jax.nn.silu(y @ lp["w_gate"])
+        x = x + _mp_sum((gate * (y @ lp["w_up"])) @ lp["w_down"])
+    return x, kp, vp, kscale, vscale
+
+
+def mixed_paged_step(cfg, stacked, embed, final_norm, lm_head, ids,
+                     q_lens, kv_lens, tables, pages_k, pages_v,
+                     kscales=None, vscales=None, mp_axis=None):
+    """Jittable SINGLE-LAUNCH mixed step (ISSUE 10 tentpole): every
+    decode-ready row's verify window and every funded prefill chunk
+    run in ONE program. ids [B, T] LEFT-aligned windows (slot
+    i >= q_lens[b] is padding), kv_lens [B] INCLUDE this launch's
+    windows (scatter-then-attend), tables [B, mb], block pools as in
+    :func:`_paged_decode_step`. Returns (argmax tokens [B, T] at every
+    window slot, updated pools) — the engine reads chunk first-tokens,
+    verify chains, and decode tokens off the per-row windows. Rows
+    with q_lens=0 are inactive: their writes route to the NULL page
+    and their logits come from exact-zero attention outputs (ignored
+    host-side)."""
+    B, T = ids.shape
+    bs = pages_k.shape[2]
+    j = jnp.arange(T)[None, :]
+    valid = j < q_lens[:, None]
+    pos = jnp.where(valid, kv_lens[:, None] - q_lens[:, None] + j, 0)
+    page = jnp.where(valid,
+                     jnp.take_along_axis(tables, pos // bs, axis=1), 0)
+    off = jnp.where(valid, pos % bs, 0)
+    x = jnp.take(embed, ids, axis=0)                     # [B, T, d]
+
+    if kscales is None:
+        def layer_fn(carry, xs):
+            lp, kp, vp = xs
+            out, kp, vp, _, _ = _mixed_decoder_layer(
+                cfg, lp, carry, pos, valid, page, off, tables, kv_lens,
+                q_lens, kp, vp, mp_axis=mp_axis)
+            return out, (kp, vp)
+
+        x, pools = jax.lax.scan(layer_fn, x,
+                                (stacked, pages_k, pages_v))
+    else:
+        def layer_fn(carry, xs):
+            lp, kp, vp, ksc, vsc = xs
+            out, kp, vp, ksc, vsc = _mixed_decoder_layer(
+                cfg, lp, carry, pos, valid, page, off, tables, kv_lens,
+                q_lens, kp, vp, ksc, vsc, mp_axis=mp_axis)
+            return out, (kp, vp, ksc, vsc)
+
+        x, pools = jax.lax.scan(
+            layer_fn, x, (stacked, pages_k, pages_v, kscales, vscales))
+    x = _rms(x, final_norm, cfg.rms_norm_eps)
+    logits = (x @ lm_head).astype(jnp.float32)           # [B, T, V]
+    return (jnp.argmax(logits, axis=-1).astype(jnp.int32), *pools)
+
+
 _GEN_CACHE: dict = {}
 
 
@@ -1195,20 +1347,23 @@ def _dequantize_weights(cfg, stacked, lm_head, scales):
 
 
 def masked_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
-                   pad_len, last_index=None):
+                   pad_len, last_index=None, mp_axis=None):
     """Masked serving prefill (shared by _generate_all and the
     continuous-batching DecodeEngine): left-padded ``ids`` with per-row
     ``pad_len`` -> (last-position logits [b, V], per-layer K/V stacks).
     ``last_index``: position of the final real token (default: the last
-    column, the right-aligned convention)."""
+    column, the right-aligned convention). ``mp_axis``: manual
+    Megatron TP inside a shard_map region (ISSUE 10) — the collected
+    K/V stacks come back as kv-head shards, matching the sharded
+    pool they scatter into."""
     b, s0 = ids.shape
     positions = jnp.maximum(
         jnp.arange(s0)[None, :] - pad_len[:, None], 0)
     key_mask = jnp.arange(s0)[None, :] >= pad_len[:, None]
     x = jnp.take(embed, ids, axis=0)
     x, _, ks, vs = _scan_layers(cfg, stacked, x, positions,
-                                lambda a, spec: a, collect_kv=True,
-                                key_mask=key_mask)
+                                lambda a, spec: a, mp_axis=mp_axis,
+                                collect_kv=True, key_mask=key_mask)
     x = _rms(x, final_norm, cfg.rms_norm_eps)
     last = x[:, -1] if last_index is None else \
         jax.lax.dynamic_index_in_dim(x, last_index, axis=1,
@@ -1254,16 +1409,20 @@ def _attention_prefix(q, k, v, key_mask, pk, pv, prefix_mask):
 
 
 def _prefix_decoder_layer(cfg, lp, x, positions, key_mask, pk, pv,
-                          prefix_mask):
+                          prefix_mask, mp_axis=None):
     """One decoder layer over an uncached TAIL window attending to a
     cached paged prefix (single-program GSPMD path, mirrors
     _decoder_layer's math with _attention_prefix in place of
-    _attention). Returns (x, k, v) — the tail's post-rope K/V, scattered
-    into the block pool by the caller."""
+    _attention; ``mp_axis`` adds the manual-TP psum finishers for
+    shard_map regions, ISSUE 10). Returns (x, k, v) — the tail's
+    post-rope K/V, scattered into the block pool by the caller."""
     hd = cfg.head_dim
     h = lp["wq"].shape[-1] // hd
     kvh = lp["wk"].shape[-1] // hd
     b, s, d = x.shape
+
+    def _mp_sum(a):
+        return safe_psum(a, mp_axis) if mp_axis is not None else a
 
     y = _rms(x, lp["input_ln"], cfg.rms_norm_eps)
     q = y @ lp["wq"]
@@ -1277,23 +1436,25 @@ def _prefix_decoder_layer(cfg, lp, x, positions, key_mask, pk, pv,
     k = _rope(k.reshape(b, s, kvh, hd), positions, cfg.rope_theta, hd)
     v = v.reshape(b, s, kvh, hd)
     attn = _attention_prefix(q, k, v, key_mask, pk, pv, prefix_mask)
-    x = x + attn.reshape(b, s, h * hd) @ lp["wo"]
+    x = x + _mp_sum(attn.reshape(b, s, h * hd) @ lp["wo"])
 
     y = _rms(x, lp["post_ln"], cfg.rms_norm_eps)
     if cfg.num_experts > 0:
         mlp_out, _ = _moe_mlp(cfg, lp, y, lambda a, spec: a,
+                              mp_axis=mp_axis,
                               capacity_override=max(
                                   1, b * s * cfg.num_experts_per_tok))
         x = x + mlp_out
     else:
         gate = jax.nn.silu(y @ lp["w_gate"])
-        x = x + (gate * (y @ lp["w_up"])) @ lp["w_down"]
+        x = x + _mp_sum((gate * (y @ lp["w_up"])) @ lp["w_down"])
     return x, k, v
 
 
 def prefix_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
                    pad_len, prefix_len, kp, vp, table_row,
-                   last_index=None, kv_scales=None, all_logits=False):
+                   last_index=None, kv_scales=None, all_logits=False,
+                   mp_axis=None):
     """Position-offset prefill of an UNCACHED TAIL over a prefix already
     resident in the paged pool (prefix-hit admission, ISSUE 2).
 
@@ -1332,7 +1493,7 @@ def prefix_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
             pv = gather_pages(vpl, table_row[None, :]).astype(x.dtype)
             out, k, v = _prefix_decoder_layer(
                 cfg, lp, carry, positions, key_mask, pk, pv,
-                prefix_mask)
+                prefix_mask, mp_axis=mp_axis)
             return out, (k, v)
 
         x, (ks, vs) = jax.lax.scan(layer_fn, x, (stacked, kp, vp))
@@ -1345,7 +1506,7 @@ def prefix_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
                 vpl, table_row[None, :], vscl).astype(x.dtype)
             out, k, v = _prefix_decoder_layer(
                 cfg, lp, carry, positions, key_mask, pk, pv,
-                prefix_mask)
+                prefix_mask, mp_axis=mp_axis)
             return out, (k, v)
 
         x, (ks, vs) = jax.lax.scan(

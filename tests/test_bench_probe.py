@@ -68,6 +68,10 @@ def test_infra_skip_metric_follows_preset(monkeypatch, capsys):
     bench._emit_infra_skip("tunnel down")
     out = json.loads(capsys.readouterr().out.strip())
     assert out["metric"] == "chaos_goodput_ratio"
+    monkeypatch.setenv("BENCH_PRESET", "tp")
+    bench._emit_infra_skip("tunnel down")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "tp_device_calls_per_step"
 
 
 @pytest.mark.slow
@@ -304,6 +308,45 @@ def test_spec_preset_cpu_smoke(tmp_path):
     assert snap["counters"]["engine_spec_accepted_total"] == \
         extra["accepted"]
     assert snap["histograms"]["engine_spec_accept_len"]["count"] > 0
+
+
+@pytest.mark.slow
+def test_tp_preset_cpu_smoke(tmp_path):
+    """End-to-end CPU run of BENCH_PRESET=tp (ISSUE 10 satellite): one
+    JSON line; sharded (tp=2 and tp=4) outputs bit-identical to the
+    unsharded engine on the same seeded arrivals; the tp=2 repeat is
+    bit-for-bit (same outputs AND same launch count); and the batched
+    verify + single-launch mixed step genuinely collapse per-step
+    device calls (sharded launches/step ~1, unsharded strictly
+    higher)."""
+    env = dict(os.environ, BENCH_PRESET="tp",
+               BENCH_ALLOW_CPU="1", BENCH_NO_WALL="1",
+               BENCH_SKIP_PROBE="1", BENCH_METRICS_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, bench.__file__], env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1                         # one-JSON-line contract
+    out = json.loads(lines[0])
+    assert out["metric"] == "tp_device_calls_per_step"
+    extra = out["extra"]
+    # the correctness oracle: sharding is device wiring, never a
+    # quality trade
+    assert extra["outputs_identical_tp2"] is True
+    assert extra["outputs_identical_tp4"] is True
+    assert extra["repeat_bit_identical"] is True
+    # the perf claim: O(rows) per-row verify launches collapse into
+    # O(1) mixed launches per engine step
+    assert out["vs_baseline"] > 1.0
+    assert extra["tp2_device_calls"] < extra["unsharded_device_calls"]
+    assert out["value"] < extra["unsharded_calls_per_step"]
+    snap_path = extra["metrics_snapshot"]
+    assert snap_path == str(tmp_path / "bench_metrics_tp.json")
+    snap = json.load(open(snap_path))
+    assert snap["counters"]["engine_device_calls_total"] > 0
+    assert snap["gauges"]["engine_tp_degree"] == 2
 
 
 @pytest.mark.slow

@@ -66,7 +66,9 @@ def test_lint_covers_fleet_modules():
     drafter must stay pure — a wall clock in the draft path would
     de-determinize the verify oracle), and ISSUE 9 added chaos.py
     (the fault schedule's clock is the fleet STEP INDEX — a wall
-    clock anywhere in it would break same-seed replay), so those
+    clock anywhere in it would break same-seed replay), and ISSUE 10
+    added sharding.py (mesh/spec construction is pure wiring — a
+    timer there would be a smell on its own), so those
     staying in the scan set keeps their timing under the lint too. The glob above must
     actually be scanning them
     (a rename or package move would silently shrink the lint's
@@ -75,7 +77,8 @@ def test_lint_covers_fleet_modules():
     scanned = {py.name for py in INFERENCE.glob("*.py")}
     for required in ("serving.py", "fleet.py", "fleet_metrics.py",
                      "prefix_cache.py", "scheduler.py", "qos.py",
-                     "traffic.py", "spec_decode.py", "chaos.py"):
+                     "traffic.py", "spec_decode.py", "chaos.py",
+                     "sharding.py"):
         assert required in scanned, (
             f"{required} missing from the timer-lint scan set "
             f"{sorted(scanned)}")

@@ -117,6 +117,10 @@ def test_lint_scan_is_meaningful():
         assert required in files, (
             f"{required} has no broad handlers in the scan — it "
             f"historically does; did the glob or the file move?")
+    scanned = {py.name for py in SCAN}
+    assert "sharding.py" in scanned, (
+        "ISSUE 10's sharding.py fell out of the no-silent-except scan "
+        "set — mesh/spec construction must stay under the lint")
 
 
 def test_narrow_handlers_are_exempt():
