@@ -175,7 +175,7 @@ class GlobalPrefixDirectory:
 
     def __init__(self, block_size: int):
         self._bs = int(block_size)
-        self._by_worker: dict[str, set[int]] = {}
+        self._by_worker: dict[str, set[int]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def listener(self, worker_id: str) -> _DirectoryListener:
@@ -366,7 +366,8 @@ class ServingFleet:
         self._stall_s = stall_s
         self.max_retries = int(max_retries)
         self.restart = restart          # RestartPolicy or None
-        self._parked: list = []         # unrouteable during failover;
+        self._parked: list = []         # guarded-by: _lock
+        #                                 unrouteable during failover;
         #                                 re-route on rejoin, never
         #                                 raise through step()
         self.chaos = None               # FaultInjector.install() hook
@@ -925,10 +926,15 @@ class ServingFleet:
         bucket's clock advances)."""
         gated = self._qos_gate.depth() if self._qos_gate is not None \
             else 0
+        # len() is a single atomic read and _parked only mutates on
+        # the step thread; pending_work runs both with the fleet lock
+        # held (_shed_locked) and without (run_until_drained), so it
+        # cannot take the non-reentrant lock itself.
+        parked = len(self._parked)  # staticcheck: disable=SC05
         return sum(w.load for w in self.workers if w.healthy) \
             + sum(len(w.pending) for w in self.workers
                   if not w.healthy) \
-            + len(self._parked) \
+            + parked \
             + gated
 
     def _stuck_report(self) -> str:
@@ -960,7 +966,9 @@ class ServingFleet:
                 if row is not None:
                     lines.append(line(f"{w.wid} running", row["req"],
                                       health))
-        for req in self._parked:
+        with self._lock:
+            parked = list(self._parked)
+        for req in parked:
             lines.append(line(
                 f"parked(from {getattr(req, '_parked_from', None)})",
                 req, "no_healthy_workers"))
@@ -1263,6 +1271,8 @@ class ServingFleet:
         return self._http
 
     def stats(self) -> dict:
+        with self._lock:
+            n_parked = len(self._parked)
         s = {
             "policy": self.policy,
             "submitted": int(self._c_submitted.value),
@@ -1271,7 +1281,7 @@ class ServingFleet:
             "rerouted": int(self._c_rerouted.value),
             "restarts": int(self._c_restarts.value),
             "poisoned": int(self._c_poisoned.value),
-            "parked": len(self._parked),
+            "parked": n_parked,
             "degradation": self._degradation,
             "healthy_workers": sum(1 for w in self.workers if w.healthy),
             "tp_degree": self.tp_degree or 1,

@@ -121,25 +121,28 @@ class TokenBucket:
         self.rate = float(rate)
         self.burst = float(burst)
         self._clock = _now if clock is None else clock
-        self.tokens = self.burst
-        self._t = float(self._clock() if t is None else t)
+        self.tokens = self.burst                # guarded-by: _lock
+        self._t = float(self._clock() if t is None else t)  # guarded-by: _lock
+        self._lock = threading.Lock()
 
-    def _refill(self, t: float) -> None:
+    def _refill(self, t: float) -> None:        # staticcheck: holds=_lock
         if t > self._t:
             self.tokens = min(self.burst,
                               self.tokens + (t - self._t) * self.rate)
             self._t = t
 
     def available(self, t=None) -> float:
-        self._refill(float(self._clock() if t is None else t))
-        return self.tokens
+        with self._lock:
+            self._refill(float(self._clock() if t is None else t))
+            return self.tokens
 
     def try_take(self, cost: float, t=None) -> bool:
-        self._refill(float(self._clock() if t is None else t))
-        if self.tokens >= cost:
-            self.tokens -= cost
-            return True
-        return False
+        with self._lock:
+            self._refill(float(self._clock() if t is None else t))
+            if self.tokens >= cost:
+                self.tokens -= cost
+                return True
+            return False
 
 
 class QoSPolicy:
@@ -163,7 +166,7 @@ class QoSPolicy:
                 raise ValueError(f"duplicate policy for tenant "
                                  f"{pol.tenant!r}")
             self._policies[pol.tenant] = pol
-        self._tenants: dict = {}          # tenant -> state dict
+        self._tenants: dict = {}  # tenant -> state dict  # guarded-by: _lock
         self._gates: list = []            # every AdmissionGate created
         self._lock = threading.Lock()
 
@@ -178,7 +181,11 @@ class QoSPolicy:
         return int(self.policy(tenant).tier)
 
     def _state(self, tenant: str) -> dict:
-        st = self._tenants.get(tenant)
+        # Double-checked fast path: tenant states are created once and
+        # never removed, so a racy miss just falls through to the
+        # locked re-check; a racy hit sees a fully-built dict because
+        # publication happens after construction under the lock.
+        st = self._tenants.get(tenant)  # staticcheck: disable=SC05
         if st is None:
             with self._lock:
                 st = self._tenants.get(tenant)
@@ -226,7 +233,9 @@ class QoSPolicy:
 
     def registries(self) -> dict:
         """tenant -> MetricsRegistry for every tenant seen so far."""
-        return {t: st["registry"] for t, st in self._tenants.items()}
+        with self._lock:
+            return {t: st["registry"]
+                    for t, st in self._tenants.items()}
 
     # -- gates ------------------------------------------------------------
     def gate(self) -> "AdmissionGate":
@@ -252,8 +261,14 @@ class QoSPolicy:
         self._state(tenant)["shed"].inc()
 
     def stats(self) -> dict:
+        # Snapshot tenants under the policy lock, then read gate
+        # depths OUTSIDE it: AdmissionGate methods hold the gate lock
+        # while calling _state() (gate -> policy ordering), so calling
+        # into a gate while holding this lock would invert it.
+        with self._lock:
+            items = sorted(self._tenants.items())
         out = {}
-        for t, st in sorted(self._tenants.items()):
+        for t, st in items:
             out[t] = {
                 "admitted": st["admitted"].value,
                 "throttled": st["throttled"].value,
@@ -310,65 +325,76 @@ class AdmissionGate:
 
     def __init__(self, qos: QoSPolicy):
         self._qos = qos
-        self._held: dict = {}             # tenant -> deque of requests
+        self._held: dict = {}  # tenant -> deque    # guarded-by: _lock
+        self._lock = threading.Lock()
 
     def decide(self, req, t=None):
         """(verdict, reason): ``("admit", None)``, ``("throttle",
         None)`` — the request is now held here — or ``("reject",
-        reason)`` with reason ``"zero_weight"`` or ``"rate_limited"``."""
+        reason)`` with reason ``"zero_weight"`` or ``"rate_limited"``.
+
+        Lock ordering: gate lock, then (via ``_state``) the policy
+        lock — never the reverse."""
         tenant = tenant_of(req)
-        st = self._qos._state(tenant)
-        pol = st["policy"]
-        if pol.weight <= 0:
-            st["rejected"].inc()
-            return "reject", "zero_weight"
-        q = self._held.get(tenant)
-        behind = bool(q)                   # FIFO: never jump the queue
-        if not behind and st["bucket"].try_take(request_cost(req), t):
-            st["admitted"].inc()
-            return "admit", None
-        if pol.on_limit == "reject":
-            st["rejected"].inc()
-            return "reject", "rate_limited"
-        if q is None:
-            q = self._held[tenant] = deque()
-        q.append(req)
-        st["throttled"].inc()
-        return "throttle", None
+        with self._lock:
+            st = self._qos._state(tenant)
+            pol = st["policy"]
+            if pol.weight <= 0:
+                st["rejected"].inc()
+                return "reject", "zero_weight"
+            q = self._held.get(tenant)
+            behind = bool(q)               # FIFO: never jump the queue
+            if not behind and st["bucket"].try_take(request_cost(req),
+                                                    t):
+                st["admitted"].inc()
+                return "admit", None
+            if pol.on_limit == "reject":
+                st["rejected"].inc()
+                return "reject", "rate_limited"
+            if q is None:
+                q = self._held[tenant] = deque()
+            q.append(req)
+            st["throttled"].inc()
+            return "throttle", None
 
     def release(self, t=None) -> list:
         """Requests whose bucket can now fund them, FIFO per tenant,
         ordered across tenants by arrival (``_sched_seq``)."""
         out = []
-        for tenant in sorted(self._held):
-            q = self._held[tenant]
-            st = self._qos._state(tenant)
-            while q and st["bucket"].try_take(request_cost(q[0]), t):
-                out.append(q.popleft())
-                st["admitted"].inc()
+        with self._lock:
+            for tenant in sorted(self._held):
+                q = self._held[tenant]
+                st = self._qos._state(tenant)
+                while q and st["bucket"].try_take(request_cost(q[0]),
+                                                  t):
+                    out.append(q.popleft())
+                    st["admitted"].inc()
         out.sort(key=lambda r: (getattr(r, "_sched_seq", None) is None,
                                 getattr(r, "_sched_seq", 0) or 0))
         return out
 
     def held(self) -> list:
-        return [r for q in self._held.values() for r in q]
+        with self._lock:
+            return [r for q in self._held.values() for r in q]
 
     def depth(self, tenant: str = None) -> int:
-        if tenant is not None:
-            return len(self._held.get(tenant, ()))
-        return sum(len(q) for q in self._held.values())
+        with self._lock:
+            if tenant is not None:
+                return len(self._held.get(tenant, ()))
+            return sum(len(q) for q in self._held.values())
 
     def remove(self, victims) -> int:
         """Drop shed victims still waiting behind the bucket."""
         vids = {id(v) for v in victims}
         dropped = 0
-        for tenant, q in list(self._held.items()):
-            kept = deque(r for r in q if id(r) not in vids)
-            dropped += len(q) - len(kept)
-            if kept:
-                self._held[tenant] = kept
-            else:
-                del self._held[tenant]
+        with self._lock:
+            for tenant, q in list(self._held.items()):
+                kept = deque(r for r in q if id(r) not in vids)
+                dropped += len(q) - len(kept)
+                if kept:
+                    self._held[tenant] = kept
+                else:
+                    del self._held[tenant]
         return dropped
 
 

@@ -243,7 +243,7 @@ class MetricsRegistry:
     cross-cutting consumers like the stall watchdog."""
 
     def __init__(self):
-        self._metrics: dict[str, object] = {}
+        self._metrics: dict[str, object] = {}   # guarded-by: _lock
         self._lock = threading.Lock()
 
     def _get_or_create(self, name, cls, *args, **kwargs):
@@ -272,10 +272,12 @@ class MetricsRegistry:
         return self._get_or_create(name, Histogram, help, buckets)
 
     def get(self, name: str):
-        return self._metrics.get(name)
+        with self._lock:
+            return self._metrics.get(name)
 
     def __contains__(self, name: str) -> bool:
-        return name in self._metrics
+        with self._lock:
+            return name in self._metrics
 
     def names(self) -> list[str]:
         with self._lock:
@@ -290,8 +292,9 @@ class MetricsRegistry:
         """JSON-able point-in-time view: scalar counters/gauges plus
         histogram summaries with cumulative bucket counts."""
         out = {"counters": {}, "gauges": {}, "histograms": {}}
-        for name in self.names():
-            m = self._metrics[name]
+        with self._lock:
+            items = sorted(self._metrics.items())
+        for name, m in items:
             if isinstance(m, Counter):
                 out["counters"][name] = m.value
             elif isinstance(m, Gauge):
@@ -319,8 +322,9 @@ class MetricsRegistry:
                 for k in sorted(labels))
         plain = f"{{{pairs}}}" if pairs else ""
         lines = []
-        for name in self.names():
-            m = self._metrics[name]
+        with self._lock:
+            items = sorted(self._metrics.items())
+        for name, m in items:
             if isinstance(m, Counter):
                 if m.help:
                     lines.append(f"# HELP {name} {escape_help(m.help)}")

@@ -1,0 +1,54 @@
+"""graftcheck (ISSUE 11): unified AST static-analysis framework
+enforcing the serving stack's determinism, host/device, and
+concurrency invariants.
+
+One parse per file, many checkers, structured findings, inline
+suppressions, deterministic reports. See SURVEY.md §7.18 for the
+checker catalog and how to add one.
+
+Checkers:
+
+======  =========================  ==========================================
+id      name                       invariant
+======  =========================  ==========================================
+SC00    unused-suppression         every ``# staticcheck: disable=`` must
+                                   still suppress something
+SC01    no-adhoc-timers            serving code stamps time through
+                                   ``observability.now`` only
+SC02    no-silent-except           broad exception handlers must be loud
+SC03    host-sync-in-traced-code   no device sync / retrace hazard inside
+                                   jit/shard_map/pallas-traced functions
+SC04    unseeded-nondeterminism    no global-RNG calls or set-order
+                                   iteration (seeded bit-for-bit replay)
+SC05    lock-discipline            ``# guarded-by:`` attributes only
+                                   touched under their lock
+======  =========================  ==========================================
+
+Stdlib-only on purpose: ``python -m paddle_tpu.staticcheck`` must run
+(and CI must gate on it) without importing jax or the serving stack.
+"""
+
+from .core import (Checker, Finding, RunResult,  # noqa: F401
+                   UNUSED_SUPPRESSION_ID, all_checker_classes,
+                   checker_by_id, register, run)
+from .core import SourceFile  # noqa: F401
+
+# importing the checker modules registers them
+from . import timers  # noqa: F401,E402
+from . import silent_except  # noqa: F401,E402
+from . import host_sync  # noqa: F401,E402
+from . import nondeterminism  # noqa: F401,E402
+from . import locks  # noqa: F401,E402
+
+from .timers import AdhocTimerChecker  # noqa: F401,E402
+from .silent_except import SilentExceptChecker  # noqa: F401,E402
+from .host_sync import HostSyncChecker  # noqa: F401,E402
+from .nondeterminism import UnseededRandomChecker  # noqa: F401,E402
+from .locks import LockDisciplineChecker  # noqa: F401,E402
+
+__all__ = ["Checker", "Finding", "RunResult", "SourceFile",
+           "UNUSED_SUPPRESSION_ID", "all_checker_classes",
+           "checker_by_id", "register", "run",
+           "AdhocTimerChecker", "SilentExceptChecker",
+           "HostSyncChecker", "UnseededRandomChecker",
+           "LockDisciplineChecker"]

@@ -1,0 +1,67 @@
+"""``python -m paddle_tpu.staticcheck`` — run graftcheck over the
+configured scan set (or explicit paths) and exit nonzero on findings.
+
+Output is deterministic: findings sort by (file, line, checker_id,
+message), so ``--json`` reports diff cleanly between runs and can be
+committed as a baseline.
+
+Usage::
+
+    python -m paddle_tpu.staticcheck                # human format
+    python -m paddle_tpu.staticcheck --json         # machine format
+    python -m paddle_tpu.staticcheck --checkers SC01,SC02
+    python -m paddle_tpu.staticcheck --list         # checker catalog
+    python -m paddle_tpu.staticcheck path/to/file.py ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import all_checker_classes, checker_by_id, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.staticcheck",
+        description="graftcheck: AST static analysis enforcing the "
+                    "serving stack's determinism, host/device, and "
+                    "concurrency invariants")
+    ap.add_argument("paths", nargs="*",
+                    help="files to scan (default: the configured "
+                         "scan set)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit a machine-readable JSON report")
+    ap.add_argument("--checkers", default=None,
+                    help="comma-separated checker ids (default: all)")
+    ap.add_argument("--list", action="store_true", dest="list_only",
+                    help="print the checker catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_only:
+        for cls in all_checker_classes():
+            print(f"{cls.id}  {cls.name:28s} {cls.description}")
+        return 0
+
+    checkers = None
+    if args.checkers:
+        checkers = [checker_by_id(c.strip())
+                    for c in args.checkers.split(",") if c.strip()]
+
+    result = run(sources=args.paths or None, checkers=checkers)
+
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(f.render())
+        n = len(result.findings)
+        print(f"graftcheck: {result.files_scanned} files, "
+              f"{n} finding{'s' if n != 1 else ''}")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+"""graftcheck scan-set configuration (ISSUE 11): the ONE place that
+says which files the serving stack's invariants are enforced on. The
+two pre-framework lints each carried a private copy of this list; the
+rewritten ``tests/test_no_adhoc_timers.py`` / ``test_no_silent_except.py``
+now import these groups instead of globbing on their own.
+
+Groups:
+
+- :func:`scan_paths` — the full shared scan set every SC03+ checker
+  sees: ``paddle_tpu/inference/``, ``paddle_tpu/observability/``,
+  ``paddle_tpu/distributed/watchdog.py``, ``paddle_tpu/models/llama.py``,
+  ``paddle_tpu/kernels/`` and ``bench.py``;
+- :func:`timer_inference_paths` / :func:`timer_shared_clock_paths` —
+  SC01's two historic tiers (inference/ bans ``time.perf_counter``;
+  the clock-owning observability/ + watchdog additionally ban
+  ``time.monotonic``, modulo the alias-definition line);
+- :func:`silent_except_paths` — SC02's tier (inference/ +
+  observability/, the packages whose broad handlers must be loud).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+__all__ = ["REPO_ROOT", "PKG", "scan_paths", "timer_inference_paths",
+           "timer_shared_clock_paths", "silent_except_paths",
+           "WATCHDOG", "TRACED_EXTRA_NAMES", "is_external",
+           "in_timer_inference", "in_timer_shared_clock",
+           "in_silent_except"]
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+PKG = REPO_ROOT / "paddle_tpu"
+WATCHDOG = PKG / "distributed" / "watchdog.py"
+
+#: SC03 fallback: functions the engine stores in its compiled-program
+#: caches whose jit wrapping the AST walk cannot see lexically (the
+#: factory call happens behind an attribute alias). The factory
+#: resolver in host_sync.py catches today's tree on its own; this list
+#: exists so a refactor that breaks the lexical chain can pin the
+#: traced names explicitly instead of silently dropping coverage.
+TRACED_EXTRA_NAMES: frozenset = frozenset()
+
+
+def _glob(d: pathlib.Path) -> list[pathlib.Path]:
+    return sorted(p for p in d.glob("*.py") if p.name != "__pycache__")
+
+
+def timer_inference_paths() -> list[pathlib.Path]:
+    return _glob(PKG / "inference")
+
+
+def timer_shared_clock_paths() -> list[pathlib.Path]:
+    return _glob(PKG / "observability") + [WATCHDOG]
+
+
+def silent_except_paths() -> list[pathlib.Path]:
+    return _glob(PKG / "inference") + _glob(PKG / "observability")
+
+
+def scan_paths() -> list[pathlib.Path]:
+    """The full shared scan set, deterministic order."""
+    return (
+        _glob(PKG / "inference")
+        + _glob(PKG / "observability")
+        + [WATCHDOG]
+        + [PKG / "models" / "llama.py"]
+        + _glob(PKG / "kernels")
+        + [REPO_ROOT / "bench.py"]
+    )
+
+
+def is_external(src) -> bool:
+    """True for an explicit CLI path OUTSIDE the repository (e.g. a
+    test fixture in a temp dir) — such files get every checker's
+    widest net, like virtual fixtures."""
+    if src.virtual or src.path is None:
+        return False
+    try:
+        src.path.resolve().relative_to(REPO_ROOT)
+        return False
+    except ValueError:
+        return True
+
+
+def _under(src, group) -> bool:
+    """True when ``src`` (a SourceFile) is one of ``group``'s paths —
+    virtual fixture sources and external CLI paths always match, so
+    tests can drive any checker with embedded snippets or temp
+    files."""
+    if src.virtual or is_external(src):
+        return True
+    return src.path is not None and src.path.resolve() in {
+        p.resolve() for p in group}
+
+
+def _in_repo_group(src, group) -> bool:
+    return (not src.virtual and not is_external(src)
+            and _under(src, group))
+
+
+def in_timer_inference(src) -> bool:
+    return _in_repo_group(src, timer_inference_paths())
+
+
+def in_timer_shared_clock(src) -> bool:
+    return _in_repo_group(src, timer_shared_clock_paths())
+
+
+def in_silent_except(src) -> bool:
+    return _under(src, silent_except_paths())

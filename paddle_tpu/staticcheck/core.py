@@ -1,0 +1,265 @@
+"""graftcheck core (ISSUE 11 tentpole): parse-once, multi-checker AST
+static analysis for the serving stack's invariants.
+
+The two r8/r9-era lints (``tests/test_no_adhoc_timers.py``,
+``tests/test_no_silent_except.py``) each carried a private scanner,
+scan-set list and exemption scheme; every new invariant cost a new
+bespoke walker. This module is the shared chassis they now ride on:
+
+- :class:`SourceFile` — one read + one ``ast.parse`` per file, with
+  inline comment directives (suppressions, lock annotations) extracted
+  up front, shared by every checker;
+- :class:`Checker` — registry-discovered checker classes with an
+  ``id`` (``SC01``…), a scan-set predicate (:meth:`Checker.applies_to`)
+  and a :meth:`Checker.check` generator of findings;
+- :class:`Finding` — structured ``(file, line, checker_id, message)``
+  results with a deterministic total order, so reports diff cleanly
+  between runs;
+- :func:`run` — the engine: load once, fan checkers out, apply inline
+  ``# staticcheck: disable=<id>`` suppressions and turn any UNUSED
+  suppression into an ``SC00`` finding (a stale suppression hides the
+  next real violation on that line, so it is itself a defect).
+
+Comment directives (see SURVEY.md §7.18):
+
+- ``# staticcheck: disable=SC03`` — suppress that checker on this
+  line (comma-separate several ids). Must actually suppress
+  something, or SC00 fires.
+- ``# guarded-by: _lock`` — on a ``self.attr = ...`` line: the
+  attribute is protected by ``self._lock`` (consumed by SC05).
+- ``# staticcheck: holds=_lock`` — on a ``def`` line: the method's
+  contract is that the CALLER holds ``self._lock`` (SC05 treats the
+  whole body as guarded, like the ``_locked`` name suffix).
+
+Everything here is stdlib-only — the CLI must stay runnable without
+importing jax or the serving stack.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass
+
+__all__ = ["Finding", "SourceFile", "Checker", "register",
+           "all_checker_classes", "checker_by_id", "run", "RunResult",
+           "UNUSED_SUPPRESSION_ID"]
+
+#: Pseudo-checker id for the unused-suppression warning itself. A
+#: suppression that no longer suppresses anything is dead weight that
+#: will silently swallow the NEXT finding on its line, so it gates the
+#: exit code like any other finding.
+UNUSED_SUPPRESSION_ID = "SC00"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*staticcheck:\s*disable=([A-Za-z0-9_,\s]+)")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+_HOLDS_RE = re.compile(r"#\s*staticcheck:\s*holds=([A-Za-z_]\w*)")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One structured verdict. Ordering is (file, line, checker_id,
+    message) — the report order and the JSON order are this sort, so
+    two runs over the same tree produce byte-identical output."""
+
+    file: str           # repo-relative posix path (or fixture name)
+    line: int
+    checker_id: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.checker_id} " \
+               f"{self.message}"
+
+    def to_json(self) -> dict:
+        return {"file": self.file, "line": self.line,
+                "checker_id": self.checker_id, "message": self.message}
+
+
+class SourceFile:
+    """One scanned file, parsed exactly once and shared by every
+    checker: source text, split lines, the AST, and the per-line
+    comment directives.
+
+    ``rel`` is the repo-relative posix path (stable across machines —
+    it is the ``Finding.file`` value); fixtures built with
+    :meth:`from_source` use their given name and set ``virtual`` so
+    group predicates (which reason about real paths) let them
+    through."""
+
+    def __init__(self, rel: str, text: str, path=None, virtual=False):
+        self.rel = rel
+        self.path = path
+        self.virtual = virtual
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        # line -> set of checker ids suppressed on that line
+        self.suppressions: dict[int, set[str]] = {}
+        # line -> lock attribute name (guarded-by annotations, SC05)
+        self.guarded_by: dict[int, str] = {}
+        # line -> lock attribute name (caller-holds contract, SC05)
+        self.holds: dict[int, str] = {}
+        for lineno, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                ids = {tok.strip() for tok in m.group(1).split(",")
+                       if tok.strip()}
+                self.suppressions[lineno] = ids
+            m = _GUARDED_RE.search(line)
+            if m:
+                self.guarded_by[lineno] = m.group(1)
+            m = _HOLDS_RE.search(line)
+            if m:
+                self.holds[lineno] = m.group(1)
+
+    @classmethod
+    def from_path(cls, path, root) -> "SourceFile":
+        path = pathlib.Path(path)
+        try:
+            rel = path.resolve().relative_to(
+                pathlib.Path(root).resolve()).as_posix()
+        except ValueError:
+            # explicit CLI path outside the repo (e.g. a test fixture
+            # in a temp dir): report it absolute rather than refusing
+            rel = path.resolve().as_posix()
+        return cls(rel, path.read_text(), path=path)
+
+    @classmethod
+    def from_source(cls, name: str, text: str) -> "SourceFile":
+        """In-memory fixture (tests embed source strings — no temp
+        files)."""
+        return cls(name, text, virtual=True)
+
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator: add a Checker subclass to the global registry
+    (keyed and ordered by ``id``)."""
+    if not getattr(cls, "id", None):
+        raise ValueError(f"checker {cls.__name__} has no id")
+    if cls.id in _REGISTRY and _REGISTRY[cls.id] is not cls:
+        raise ValueError(f"duplicate checker id {cls.id}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_checker_classes() -> list[type]:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def checker_by_id(cid: str) -> type:
+    try:
+        return _REGISTRY[cid]
+    except KeyError:
+        raise KeyError(
+            f"unknown checker id {cid!r}; known: {sorted(_REGISTRY)}")
+
+
+class Checker:
+    """Base class. Subclasses set ``id`` (``SCnn``), ``name`` (kebab
+    slug) and ``description``, and implement :meth:`check` yielding
+    :class:`Finding`s. :meth:`applies_to` narrows the shared scan set
+    per checker (SC01 only polices the clock-owning packages, SC03
+    polices everything that can hold a traced function); in-memory
+    fixtures (``src.virtual``) always pass so tests can drive any
+    checker with embedded snippets."""
+
+    id = ""
+    name = ""
+    description = ""
+
+    def applies_to(self, src: SourceFile) -> bool:
+        return True
+
+    def check(self, src: SourceFile):
+        raise NotImplementedError
+
+    # helper: uniform finding construction
+    def finding(self, src: SourceFile, line: int, message: str) -> Finding:
+        return Finding(src.rel, int(line), self.id, message)
+
+
+@dataclass
+class RunResult:
+    findings: list
+    files_scanned: int
+    checkers: list          # checker INSTANCES that ran (stats live here)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "files_scanned": self.files_scanned,
+            "checkers": [{"id": c.id, "name": c.name} for c in
+                         sorted(self.checkers, key=lambda c: c.id)],
+            "findings": [f.to_json() for f in self.findings],
+        }
+
+
+def run(sources=None, checkers=None, respect_groups=True) -> RunResult:
+    """Run ``checkers`` (instances or classes; default: the full
+    registry) over ``sources`` (SourceFiles, paths, or None for the
+    configured scan set). Applies suppressions, emits SC00 for unused
+    ones, and returns findings in deterministic sorted order."""
+    from . import config
+
+    if sources is None:
+        sources = config.scan_paths()
+    srcs = []
+    for s in sources:
+        if isinstance(s, SourceFile):
+            srcs.append(s)
+        else:
+            srcs.append(SourceFile.from_path(s, config.REPO_ROOT))
+
+    if checkers is None:
+        checkers = all_checker_classes()
+    insts = [c() if isinstance(c, type) else c for c in checkers]
+
+    findings: list[Finding] = []
+    used: dict[tuple, set] = {}      # (rel, line) -> ids that fired
+    for src in srcs:
+        for chk in insts:
+            if respect_groups and not chk.applies_to(src):
+                continue
+            for f in chk.check(src):
+                sup = src.suppressions.get(f.line, ())
+                if f.checker_id in sup:
+                    used.setdefault((src.rel, f.line), set()).add(
+                        f.checker_id)
+                    continue
+                findings.append(f)
+        # unused-suppression warnings — per file, after every checker
+        # that scans it has run
+        active = {c.id for c in insts
+                  if not respect_groups or c.applies_to(src)}
+        for line, ids in src.suppressions.items():
+            for cid in sorted(ids):
+                if cid == UNUSED_SUPPRESSION_ID:
+                    findings.append(Finding(
+                        src.rel, line, UNUSED_SUPPRESSION_ID,
+                        "SC00 cannot be suppressed — remove the "
+                        "suppression instead"))
+                    continue
+                if cid not in active:
+                    # the checker didn't scan this file this run (e.g.
+                    # a narrowed --checkers invocation): not evidence
+                    # the suppression is stale, so stay quiet
+                    continue
+                if cid not in used.get((src.rel, line), ()):
+                    findings.append(Finding(
+                        src.rel, line, UNUSED_SUPPRESSION_ID,
+                        f"unused suppression: {cid} reports no finding "
+                        f"on this line — remove the stale "
+                        f"'# staticcheck: disable={cid}'"))
+    findings.sort()
+    return RunResult(findings=findings, files_scanned=len(srcs),
+                     checkers=insts)

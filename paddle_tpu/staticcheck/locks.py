@@ -1,0 +1,183 @@
+"""SC05 lock-discipline: a real race detector for the host-side
+concurrency surface. The serving stack crosses threads in exactly
+three places — the metrics registry (scrape threads read while engine
+threads write), the QoS buckets/gates (fn-gauges read from the
+exposition thread), and the fleet's worker-state maps (the HTTP
+aggregator walks them mid-step) — and each guards its state with a
+``threading.Lock``. This checker makes the guard CHECKABLE:
+
+Annotate the attribute where it is initialized::
+
+    self._metrics = {}          # guarded-by: _lock
+
+Every subsequent read or write of ``self._metrics`` in that class must
+then sit inside a ``with self._lock:`` block, except:
+
+- ``__init__`` (the object is not published to other threads yet);
+- methods named ``*_locked`` (the repo's caller-holds-the-lock
+  convention — ``_failover_locked``, ``_park_locked`` …);
+- methods whose ``def`` line carries ``# staticcheck: holds=_lock``
+  (same contract, for names that predate the convention);
+- intentional unguarded reads, suppressed inline with
+  ``# staticcheck: disable=SC05`` plus a justification comment.
+
+The analysis is lexical and class-local: it sees ``self.attr``
+accesses (including through subscripts: ``self._metrics[name]``) and
+``with self.<lock>:`` regions, in source order, including nested
+functions and lambdas — a gauge callback capturing ``self`` runs later
+on the scrape thread with NO lock held, which is precisely the bug
+class this exists to catch.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, register
+
+__all__ = ["LockDisciplineChecker"]
+
+EXEMPT_METHODS = frozenset({"__init__", "__new__", "__del__",
+                            "__init_subclass__"})
+
+
+def _self_attr(node, selfname):
+    """attr name for ``<selfname>.X`` nodes, else None."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == selfname:
+        return node.attr
+    return None
+
+
+@register
+class LockDisciplineChecker(Checker):
+    id = "SC05"
+    name = "lock-discipline"
+    description = ("read/write of a `# guarded-by:` annotated "
+                   "attribute outside its `with self._lock` block")
+
+    def check(self, src):
+        for cls in (n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)):
+            yield from self._check_class(src, cls)
+
+    def _collect_guarded(self, src, cls) -> dict:
+        """attr -> lock-attr from ``# guarded-by:`` comment lines on
+        ``self.X = ...`` / ``self.X: T = ...`` statements in any
+        method of the class."""
+        guarded = {}
+        for m in cls.body:
+            if not isinstance(m, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                continue
+            selfname = m.args.args[0].arg if m.args.args else None
+            if selfname is None:
+                continue
+            for node in ast.walk(m):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                for t in targets:
+                    attr = _self_attr(t, selfname)
+                    if attr is None:
+                        continue
+                    lock = src.guarded_by.get(node.lineno)
+                    if lock is not None:
+                        guarded[attr] = lock
+        return guarded
+
+    def _check_class(self, src, cls):
+        guarded = self._collect_guarded(src, cls)
+        if not guarded:
+            return
+        locks = set(guarded.values())
+        for m in cls.body:
+            if not isinstance(m, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                continue
+            if m.name in EXEMPT_METHODS:
+                continue
+            selfname = m.args.args[0].arg if m.args.args else None
+            if selfname is None:
+                continue
+            held = set()
+            if m.name.endswith("_locked"):
+                held = set(locks)
+            hold = src.holds.get(m.lineno)
+            if hold is not None:
+                held = held | {hold}
+            yield from self._walk(src, m.body, m.name, selfname,
+                                  guarded, held)
+
+    def _with_locks(self, node, selfname, guarded):
+        """Lock attrs acquired by a With statement's items."""
+        out = set()
+        for item in node.items:
+            attr = _self_attr(item.context_expr, selfname)
+            if attr is not None and attr in set(guarded.values()):
+                out.add(attr)
+        return out
+
+    def _walk(self, src, stmts, mname, selfname, guarded, held):
+        for stmt in stmts:
+            yield from self._visit(src, stmt, mname, selfname,
+                                   guarded, held)
+
+    def _visit(self, src, node, mname, selfname, guarded, held):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = self._with_locks(node, selfname, guarded)
+            # the lock attribute itself is exempt in the with-items
+            for item in node.items:
+                yield from self._visit_expr(src, item.context_expr,
+                                            mname, selfname, guarded,
+                                            held, skip_lock=True)
+            inner = held | acquired
+            for s in node.body:
+                yield from self._visit(src, s, mname, selfname,
+                                       guarded, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # deferred execution: a nested function or lambda runs
+            # later (gauge callbacks run on the SCRAPE thread) — the
+            # enclosing lock is NOT held then
+            body = node.body if isinstance(node.body, list) \
+                else [node.body]
+            inner_self = selfname
+            params = {a.arg for a in node.args.args}
+            if inner_self in params:
+                inner_self = None       # shadowed; cannot track
+            if inner_self is not None:
+                for s in body:
+                    yield from self._visit(src, s, mname, inner_self,
+                                           guarded, set())
+            return
+        yield from self._visit_expr(src, node, mname, selfname,
+                                    guarded, held)
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(src, child, mname, selfname,
+                                   guarded, held)
+
+    def _visit_expr(self, src, node, mname, selfname, guarded, held,
+                    skip_lock=False):
+        """Flag the node itself if it is a guarded self-attr access
+        outside its lock (children are visited by the caller)."""
+        attr = _self_attr(node, selfname)
+        if attr is None:
+            return
+        if skip_lock and attr in set(guarded.values()):
+            return
+        lock = guarded.get(attr)
+        if lock is None or lock in held:
+            return
+        access = "write" if isinstance(node.ctx,
+                                       (ast.Store, ast.Del)) else "read"
+        yield self.finding(
+            src, node.lineno,
+            f"{access} of {attr!r} (guarded-by {lock}) in "
+            f"{mname}() without holding self.{lock} — wrap in "
+            f"`with self.{lock}:` or mark the method "
+            f"`# staticcheck: holds={lock}`")

@@ -1,0 +1,122 @@
+"""SC04 unseeded-nondeterminism: the serving stack's contract since r6
+is seeded bit-for-bit replay — same seed, same arrivals, same fault
+schedule, same tokens (the chaos and overload benches assert it).
+Global-state RNG and unordered-container iteration are exactly the two
+ways that contract silently breaks, so both are findings:
+
+- ``random.random()`` / ``random.shuffle()`` / … — module-level calls
+  on the PROCESS-global Mersenne twister. Any other import that also
+  touches it perturbs the stream. The sanctioned spelling is an owned
+  ``random.Random(seed)`` instance (``self._rng.random()`` is clean —
+  the base is an instance, not the module).
+- ``np.random.rand()`` / ``np.random.randint()`` / … — NumPy's legacy
+  global RNG, same failure mode. Sanctioned: a
+  ``np.random.default_rng(seed)`` / ``np.random.RandomState(seed)``
+  generator. The CONSTRUCTORS are allowed **only when given an
+  explicit seed argument** — ``default_rng()`` with no seed is entropy
+  from the OS and is flagged.
+- iterating a ``set`` (literal, comprehension, or ``set(...)`` /
+  ``frozenset(...)`` call) in a ``for`` loop, a comprehension, or a
+  ``list()``/``tuple()``/``sorted(key=...)-free`` materialization —
+  set order is hash-seed-dependent across processes, so any routing,
+  scheduling or victim-selection decision fed by it diverges between
+  replicas. Sanctioned: ``sorted(...)`` the set first (the fleet's
+  deterministic tie-break discipline).
+
+``jax.random`` is key-based and exempt by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, register
+from .util import call_target, name_parts
+
+__all__ = ["UnseededRandomChecker"]
+
+#: constructors that are fine WITH an explicit seed argument
+SEEDED_CONSTRUCTORS = frozenset({
+    "Random", "default_rng", "RandomState", "Generator",
+    "SeedSequence", "PRNGKey", "key"})
+RANDOM_MODULE_BASES = frozenset({"random"})
+NP_NAMES = frozenset({"np", "numpy", "onp", "_np"})
+SET_CALLS = frozenset({"set", "frozenset"})
+MATERIALIZERS = frozenset({"list", "tuple", "enumerate", "iter"})
+
+
+def _rng_module(call: ast.Call):
+    """("random", fn) for ``random.X(...)``, ("np.random", fn) for
+    ``np.random.X(...)`` / ``numpy.random.X(...)``; None otherwise.
+    ``jax.random.X`` returns None (key-based, deterministic)."""
+    parts = name_parts(call.func)
+    if len(parts) == 2 and parts[0] in RANDOM_MODULE_BASES:
+        return "random", parts[1]
+    if len(parts) == 3 and parts[0] in NP_NAMES \
+            and parts[1] == "random":
+        return "np.random", parts[2]
+    return None
+
+
+def _is_set_expr(node) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and call_target(node) in SET_CALLS \
+            and isinstance(node.func, ast.Name):
+        return True
+    return False
+
+
+@register
+class UnseededRandomChecker(Checker):
+    id = "SC04"
+    name = "unseeded-nondeterminism"
+    description = ("global-RNG call or set-order-dependent iteration "
+                   "breaking seeded bit-for-bit replay")
+
+    def check(self, src):
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                mod = _rng_module(node)
+                if mod is not None:
+                    yield from self._check_rng(src, node, *mod)
+                elif call_target(node) in MATERIALIZERS \
+                        and isinstance(node.func, ast.Name) \
+                        and node.args \
+                        and _is_set_expr(node.args[0]):
+                    yield self.finding(
+                        src, node.lineno,
+                        f"{node.func.id}() over a set materializes "
+                        f"hash-seed-dependent order — sorted(...) it "
+                        f"for deterministic replay")
+            elif isinstance(node, ast.For) \
+                    and _is_set_expr(node.iter):
+                yield self.finding(
+                    src, node.lineno,
+                    "iterating a set directly — order is hash-seed-"
+                    "dependent across processes; sorted(...) it for "
+                    "deterministic replay")
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                for gen in node.generators:
+                    if _is_set_expr(gen.iter):
+                        yield self.finding(
+                            src, gen.iter.lineno,
+                            "comprehension over a set — order is "
+                            "hash-seed-dependent across processes; "
+                            "sorted(...) it for deterministic replay")
+
+    def _check_rng(self, src, call, module, fn):
+        if fn in SEEDED_CONSTRUCTORS:
+            if not call.args and not call.keywords:
+                yield self.finding(
+                    src, call.lineno,
+                    f"{module}.{fn}() without an explicit seed draws "
+                    f"OS entropy — pass a seed to keep bit-for-bit "
+                    f"replay")
+            return
+        yield self.finding(
+            src, call.lineno,
+            f"{module}.{fn}() uses the process-global RNG — use an "
+            f"owned, explicitly seeded generator "
+            f"({module}.{'Random(seed)' if module == 'random' else 'default_rng(seed)'})")

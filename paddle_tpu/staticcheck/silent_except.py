@@ -1,0 +1,59 @@
+"""SC02 no-silent-except: a self-healing fleet is only debuggable if
+every swallowed fault leaves a trace. Every BROAD exception handler
+(bare ``except:``, ``except Exception``, ``except BaseException`` —
+alone or in a tuple) in ``paddle_tpu/inference/`` and
+``paddle_tpu/observability/`` must be LOUD in at least one sanctioned
+way (the re-raise taxonomy lives in :mod:`..staticcheck.util` —
+re-raise, structured log, fail the work, flag the worker, bump an
+error counter, or surface ``.error`` on the request).
+
+NARROW handlers (``except queue.Empty`` …) are exempt — catching a
+specific type is already a statement about what can happen there. The
+check is deliberately syntactic: it cannot prove the log line is
+*useful*, only that the failure isn't silently discarded, which is the
+failure mode chaos testing keeps finding in real fleets.
+
+Byte-equivalent to the pre-framework lint
+(tests/test_no_silent_except.py before ISSUE 11).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import config
+from .core import Checker, register
+from .util import is_broad_handler, is_loud_handler
+
+__all__ = ["SilentExceptChecker"]
+
+
+@register
+class SilentExceptChecker(Checker):
+    id = "SC02"
+    name = "no-silent-except"
+    description = ("broad exception handler that swallows the fault "
+                   "silently")
+
+    def __init__(self):
+        # (file, lineno) of every broad handler examined — the
+        # scan-is-meaningful test reads this to prove the scan set
+        # still reaches the handlers it polices.
+        self.broad_handlers: list[tuple] = []
+
+    def applies_to(self, src) -> bool:
+        return config.in_silent_except(src)
+
+    def check(self, src):
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not is_broad_handler(node):
+                continue
+            self.broad_handlers.append((src.rel, node.lineno))
+            if not is_loud_handler(node):
+                yield self.finding(
+                    src, node.lineno,
+                    "silent broad exception handler — re-raise, log "
+                    "via log_kv/log_event, fail the request, mark the "
+                    "worker unhealthy, or bump an error counter")

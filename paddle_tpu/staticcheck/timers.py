@@ -1,0 +1,67 @@
+"""SC01 no-adhoc-timers: serving code stamps time through
+``paddle_tpu.observability.now`` — the one clock the metrics registry,
+request traces and engine spans share — never via raw
+``time.perf_counter()`` pairs. A raw call sneaking back in would let a
+hand-rolled latency number disagree with the trace-derived histograms,
+which is exactly the drift the observability layer exists to end.
+
+Two tiers, byte-equivalent to the pre-framework lint
+(tests/test_no_adhoc_timers.py before ISSUE 11):
+
+- ``paddle_tpu/inference/``: ``time.perf_counter`` banned;
+- ``paddle_tpu/observability/`` + ``distributed/watchdog.py`` (the
+  modules that DEFINE and CONSUME the shared clock): additionally
+  banned from ``time.monotonic`` (the watchdog's old clock), modulo
+  the alias-definition line ``now = time.perf_counter`` in
+  ``observability/metrics.py`` — the one place the raw spelling is
+  the point.
+
+Deliberately a TEXT scan (substring per line), like its predecessor:
+the banned spelling in a comment or docstring is still a smell worth a
+finding, and byte-equivalence with the historic verdicts is an
+acceptance criterion.
+"""
+
+from __future__ import annotations
+
+from . import config
+from .core import Checker, register
+from .util import is_alias_def_line
+
+__all__ = ["AdhocTimerChecker", "BANNED_INFERENCE", "BANNED_SHARED"]
+
+BANNED_INFERENCE = ("time.perf_counter",)
+BANNED_SHARED = ("time.perf_counter", "time.monotonic")
+
+
+@register
+class AdhocTimerChecker(Checker):
+    id = "SC01"
+    name = "no-adhoc-timers"
+    description = ("raw time.perf_counter/time.monotonic in serving "
+                   "code — use paddle_tpu.observability.now")
+
+    def applies_to(self, src) -> bool:
+        return (src.virtual or config.is_external(src)
+                or config.in_timer_inference(src)
+                or config.in_timer_shared_clock(src))
+
+    def _banned(self, src):
+        """(tokens, alias-exempt) for this file's tier. Virtual
+        fixtures get the widest net so tests can exercise both
+        spellings and the exemption."""
+        if config.in_timer_inference(src):
+            return BANNED_INFERENCE, False
+        return BANNED_SHARED, True
+
+    def check(self, src):
+        banned, allow_alias = self._banned(src)
+        for lineno, line in enumerate(src.lines, 1):
+            if allow_alias and is_alias_def_line(line):
+                continue
+            for token in banned:
+                if token in line:
+                    yield self.finding(
+                        src, lineno,
+                        f"raw {token} — route timing through "
+                        f"paddle_tpu.observability.now")

@@ -1,0 +1,119 @@
+"""Shared AST helpers for graftcheck checkers (ISSUE 11 satellite:
+the two pre-framework lints each owned a private copy of its exemption
+logic — the timer lint's alias-definition exemption and the
+silent-except lint's re-raise/loudness taxonomy. Both live here now,
+unit-tested directly, and the checkers import them).
+"""
+
+from __future__ import annotations
+
+import ast
+
+__all__ = ["is_alias_def_line", "ALIAS_DEF", "BROAD_EXCEPTION_NAMES",
+           "LOUD_CALLS", "COUNTER_HINTS", "exception_names",
+           "is_broad_handler", "call_target", "is_loud_handler",
+           "name_parts", "dotted_name"]
+
+# -- timer-lint exemption ---------------------------------------------------
+
+#: The one line where the raw spelling IS the point: the shared-clock
+#: alias definition in observability/metrics.py.
+ALIAS_DEF = "now = time.perf_counter"
+
+
+def is_alias_def_line(line: str) -> bool:
+    """True for the alias-definition line itself (modulo whitespace) —
+    the single exemption the timer lint has carried since ISSUE 5."""
+    return line.strip() == ALIAS_DEF
+
+
+# -- silent-except taxonomy -------------------------------------------------
+
+BROAD_EXCEPTION_NAMES = frozenset({"Exception", "BaseException"})
+
+#: Sanctioned ways a broad handler may be LOUD (ISSUE 9): structured
+#: logging, failing the work, flagging the worker. ``raise`` and
+#: error-counter ``.inc()`` are recognized structurally below.
+LOUD_CALLS = frozenset({
+    "log_kv", "log_event", "_fail_request", "_fail_row_paged",
+    "_mark_unhealthy", "_shed_request", "_poison_request",
+    "_park_locked"})
+
+COUNTER_HINTS = ("error", "drop", "fail")
+
+
+def exception_names(node) -> list[str]:
+    """Exception-type names in a handler's ``type`` expression."""
+    if node is None:
+        return []
+    elts = node.elts if isinstance(node, ast.Tuple) else [node]
+    out = []
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.append(e.attr)
+    return out
+
+
+def is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    """Bare ``except:``, or any ``Exception``/``BaseException`` in the
+    type (alone or in a tuple)."""
+    if handler.type is None:
+        return True
+    return any(n in BROAD_EXCEPTION_NAMES
+               for n in exception_names(handler.type))
+
+
+def call_target(call: ast.Call):
+    """Last name component of a call's callee (``f()`` -> ``f``,
+    ``a.b.f()`` -> ``f``), or None for computed callees."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def is_loud_handler(handler: ast.ExceptHandler) -> bool:
+    """The re-raise taxonomy: a broad handler is loud when it
+    re-raises, routes through a structured logger, fails the work,
+    flags the worker, bumps an error/drop/fail counter, or surfaces
+    the fault on the request's ``.error`` attribute."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = call_target(node)
+            if name in LOUD_CALLS:
+                return True
+            if name == "inc" and isinstance(node.func, ast.Attribute):
+                base = node.func.value
+                attr = base.attr if isinstance(base, ast.Attribute) \
+                    else (base.id if isinstance(base, ast.Name) else "")
+                if any(h in attr for h in COUNTER_HINTS):
+                    return True
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and tgt.attr == "error":
+                    return True
+    return False
+
+
+# -- generic expression helpers --------------------------------------------
+
+def name_parts(node) -> list[str]:
+    """``a.b.c`` -> ["a", "b", "c"]; ``a`` -> ["a"]; [] otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def dotted_name(node) -> str:
+    return ".".join(name_parts(node))

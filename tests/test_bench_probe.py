@@ -386,6 +386,15 @@ def test_chaos_preset_cpu_smoke(tmp_path):
     assert snap["fleet"]["counters"]["engine_retired_total"] > 0
 
 
+def test_staticcheck_cli_clean_in_process(capsys):
+    """graftcheck (ISSUE 11) gates the tree this bench drives —
+    bench.py itself is in the scan set. In-process like the probe
+    tests above (no subprocess spawn): the CLI must exit 0 at HEAD."""
+    from paddle_tpu.staticcheck.__main__ import main
+    assert main([]) == 0
+    assert "0 findings" in capsys.readouterr().out
+
+
 def test_env_flag_tolerant(monkeypatch):
     for v, want in [("1", True), ("true", True), ("YES", True),
                     ("0", False), ("", False), ("false", False)]:

@@ -1,48 +1,41 @@
-"""Timer-discipline lint (ISSUE 3 satellite, extended by ISSUE 5):
-serving code must stamp time through ``paddle_tpu.observability.now``
-— the one clock the metrics registry, request traces, and engine spans
-share — never via ad-hoc ``time.perf_counter()`` pairs. A raw call
-sneaking back into the inference package would let a hand-rolled
-latency number disagree with the trace-derived histograms, which is
-exactly the drift the observability layer exists to end.
+"""Timer-discipline lint (ISSUE 3 satellite, extended by ISSUE 5,
+ported to graftcheck by ISSUE 11): serving code must stamp time through
+``paddle_tpu.observability.now`` — the one clock the metrics registry,
+request traces, and engine spans share — never via ad-hoc
+``time.perf_counter()`` pairs. A raw call sneaking back into the
+inference package would let a hand-rolled latency number disagree with
+the trace-derived histograms, which is exactly the drift the
+observability layer exists to end.
 
-ISSUE 5 widens the net to the observability package itself and the
+ISSUE 5 widened the net to the observability package itself and the
 stall watchdog: those modules DEFINE and CONSUME the shared clock, so
 they are additionally banned from ``time.monotonic`` (the watchdog's
 old clock) — everything goes through ``observability.now``. The single
 exemption is the alias-definition line in ``observability/metrics.py``
 (``now = time.perf_counter``), which is the one place the raw spelling
-is the point."""
+is the point.
 
-import pathlib
+ISSUE 11: the scan logic lives in
+:class:`paddle_tpu.staticcheck.timers.AdhocTimerChecker` (SC01) and
+the scan-set lists in :mod:`paddle_tpu.staticcheck.config`; this file
+is a thin wrapper that keeps the historic test names (and therefore
+the historic CI gate) alive. Byte-equivalence of the verdicts against
+the pre-port lint is asserted in ``tests/test_staticcheck.py``.
+"""
 
-_ROOT = pathlib.Path(__file__).resolve().parent.parent / "paddle_tpu"
-INFERENCE = _ROOT / "inference"
-OBSERVABILITY = _ROOT / "observability"
-WATCHDOG = _ROOT / "distributed" / "watchdog.py"
-
-BANNED = "time.perf_counter"
-_ALIAS_DEF = "now = time.perf_counter"
-
-
-def _offenders(paths, banned, allow_alias_def=False):
-    out = []
-    for py in paths:
-        for lineno, line in enumerate(py.read_text().splitlines(), 1):
-            if allow_alias_def and line.strip() == _ALIAS_DEF:
-                continue            # the alias definition itself
-            for token in banned:
-                if token in line:
-                    out.append(f"{py.name}:{lineno}: {line.strip()}")
-    return out
+from paddle_tpu.staticcheck import AdhocTimerChecker, run
+from paddle_tpu.staticcheck.config import (WATCHDOG,
+                                           timer_inference_paths,
+                                           timer_shared_clock_paths)
 
 
 def test_inference_package_has_no_raw_perf_counter():
-    offenders = _offenders(sorted(INFERENCE.glob("*.py")), (BANNED,))
-    assert not offenders, (
+    res = run(sources=timer_inference_paths(),
+              checkers=[AdhocTimerChecker])
+    assert res.ok, (
         "raw time.perf_counter() in paddle_tpu/inference/ — use "
         "`from ..observability import now` instead:\n"
-        + "\n".join(offenders))
+        + "\n".join(f.render() for f in res.findings))
 
 
 def test_observability_and_watchdog_use_shared_clock():
@@ -50,31 +43,28 @@ def test_observability_and_watchdog_use_shared_clock():
     — observability/ and the stall watchdog are banned from BOTH raw
     spellings (perf_counter AND the watchdog's old monotonic), modulo
     the alias-definition line in metrics.py."""
-    paths = sorted(OBSERVABILITY.glob("*.py")) + [WATCHDOG]
-    offenders = _offenders(paths, (BANNED, "time.monotonic"),
-                           allow_alias_def=True)
-    assert not offenders, (
+    res = run(sources=timer_shared_clock_paths(),
+              checkers=[AdhocTimerChecker])
+    assert res.ok, (
         "raw timer call in observability/ or distributed/watchdog.py "
-        "— use `observability.now`:\n" + "\n".join(offenders))
+        "— use `observability.now`:\n"
+        + "\n".join(f.render() for f in res.findings))
 
 
 def test_lint_covers_fleet_modules():
     """ISSUE 4 grew the package by fleet.py/fleet_metrics.py and
     ISSUE 6 by qos.py/traffic.py; ISSUE 7's chunked prefill rides
-    inside serving.py/scheduler.py/qos.py (StepBudget, plan_prefill,
-    the chunk loop), ISSUE 8 added spec_decode.py (the n-gram
-    drafter must stay pure — a wall clock in the draft path would
-    de-determinize the verify oracle), and ISSUE 9 added chaos.py
-    (the fault schedule's clock is the fleet STEP INDEX — a wall
-    clock anywhere in it would break same-seed replay), and ISSUE 10
-    added sharding.py (mesh/spec construction is pure wiring — a
-    timer there would be a smell on its own), so those
-    staying in the scan set keeps their timing under the lint too. The glob above must
-    actually be scanning them
-    (a rename or package move would silently shrink the lint's
-    coverage). QoS/traffic in particular must never grow a wall clock —
-    their determinism contract is injected clocks only."""
-    scanned = {py.name for py in INFERENCE.glob("*.py")}
+    inside serving.py/scheduler.py/qos.py, ISSUE 8 added spec_decode.py
+    (the n-gram drafter must stay pure — a wall clock in the draft path
+    would de-determinize the verify oracle), ISSUE 9 added chaos.py
+    (the fault schedule's clock is the fleet STEP INDEX), and ISSUE 10
+    added sharding.py (mesh/spec construction is pure wiring), so those
+    staying in the scan set keeps their timing under the lint too. The
+    config group must actually be scanning them (a rename or package
+    move would silently shrink the lint's coverage). QoS/traffic in
+    particular must never grow a wall clock — their determinism
+    contract is injected clocks only."""
+    scanned = {p.name for p in timer_inference_paths()}
     for required in ("serving.py", "fleet.py", "fleet_metrics.py",
                      "prefix_cache.py", "scheduler.py", "qos.py",
                      "traffic.py", "spec_decode.py", "chaos.py",
@@ -87,7 +77,7 @@ def test_lint_covers_fleet_modules():
 def test_lint_covers_observability_modules():
     """ISSUE 5 grew observability/ by slo.py/export.py; the widened
     scan set must include them and the watchdog."""
-    scanned = {py.name for py in OBSERVABILITY.glob("*.py")}
+    scanned = {p.name for p in timer_shared_clock_paths()}
     for required in ("metrics.py", "tracing.py", "slo.py", "export.py"):
         assert required in scanned, (
             f"{required} missing from the observability lint scan set "
