@@ -1,10 +1,13 @@
-"""graftcheck (ISSUE 11): unified AST static-analysis framework
-enforcing the serving stack's determinism, host/device, and
-concurrency invariants.
+"""graftcheck (ISSUE 11 + 12): unified AST static-analysis framework
+enforcing the serving stack's determinism, host/device, concurrency
+and — since the ISSUE 12 call-graph layer — interprocedural
+invariants.
 
 One parse per file, many checkers, structured findings, inline
-suppressions, deterministic reports. See SURVEY.md §7.18 for the
-checker catalog and how to add one.
+suppressions, deterministic reports. SC01–SC05 are per-file; SC06–SC09
+ride the project-wide symbol table + call graph in
+:mod:`~paddle_tpu.staticcheck.callgraph` (built once per run). See
+SURVEY.md §7.18/§7.19 for the catalog and how to add a checker.
 
 Checkers:
 
@@ -22,6 +25,18 @@ SC04    unseeded-nondeterminism    no global-RNG calls or set-order
                                    iteration (seeded bit-for-bit replay)
 SC05    lock-discipline            ``# guarded-by:`` attributes only
                                    touched under their lock
+SC06    recompile-hazard           jit compile-cache keys drawn from the
+                                   bucketed finite domain only
+SC07    blocking-call-on-step-path no sleep/open/socket/subprocess/
+                                   json.dump reachable from the serving
+                                   step (``# staticcheck: io-boundary``
+                                   marks the sanctioned egress)
+SC08    metrics-schema             one (name -> kind, help) registry-wide;
+                                   counters end ``_total``; asserted names
+                                   resolve; label keys valid
+SC09    donation-discipline        donate_argnums match the pool closure's
+                                   arity; no donated buffer read after the
+                                   donating call
 ======  =========================  ==========================================
 
 Stdlib-only on purpose: ``python -m paddle_tpu.staticcheck`` must run
@@ -32,6 +47,7 @@ from .core import (Checker, Finding, RunResult,  # noqa: F401
                    UNUSED_SUPPRESSION_ID, all_checker_classes,
                    checker_by_id, register, run)
 from .core import SourceFile  # noqa: F401
+from .callgraph import CallGraph, FunctionInfo  # noqa: F401
 
 # importing the checker modules registers them
 from . import timers  # noqa: F401,E402
@@ -39,16 +55,27 @@ from . import silent_except  # noqa: F401,E402
 from . import host_sync  # noqa: F401,E402
 from . import nondeterminism  # noqa: F401,E402
 from . import locks  # noqa: F401,E402
+from . import recompile  # noqa: F401,E402
+from . import steppath  # noqa: F401,E402
+from . import metrics_schema  # noqa: F401,E402
+from . import donation  # noqa: F401,E402
 
 from .timers import AdhocTimerChecker  # noqa: F401,E402
 from .silent_except import SilentExceptChecker  # noqa: F401,E402
 from .host_sync import HostSyncChecker  # noqa: F401,E402
 from .nondeterminism import UnseededRandomChecker  # noqa: F401,E402
 from .locks import LockDisciplineChecker  # noqa: F401,E402
+from .recompile import RecompileHazardChecker  # noqa: F401,E402
+from .steppath import StepPathBlockingChecker  # noqa: F401,E402
+from .metrics_schema import MetricsSchemaChecker  # noqa: F401,E402
+from .donation import DonationDisciplineChecker  # noqa: F401,E402
 
 __all__ = ["Checker", "Finding", "RunResult", "SourceFile",
+           "CallGraph", "FunctionInfo",
            "UNUSED_SUPPRESSION_ID", "all_checker_classes",
            "checker_by_id", "register", "run",
            "AdhocTimerChecker", "SilentExceptChecker",
            "HostSyncChecker", "UnseededRandomChecker",
-           "LockDisciplineChecker"]
+           "LockDisciplineChecker", "RecompileHazardChecker",
+           "StepPathBlockingChecker", "MetricsSchemaChecker",
+           "DonationDisciplineChecker"]

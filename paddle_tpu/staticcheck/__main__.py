@@ -2,15 +2,16 @@
 configured scan set (or explicit paths) and exit nonzero on findings.
 
 Output is deterministic: findings sort by (file, line, checker_id,
-message), so ``--json`` reports diff cleanly between runs and can be
-committed as a baseline.
+message), so ``--json`` / ``--format=github`` reports diff cleanly
+between runs and can be committed as a baseline.
 
 Usage::
 
-    python -m paddle_tpu.staticcheck                # human format
-    python -m paddle_tpu.staticcheck --json         # machine format
-    python -m paddle_tpu.staticcheck --checkers SC01,SC02
-    python -m paddle_tpu.staticcheck --list         # checker catalog
+    python -m paddle_tpu.staticcheck                  # human format
+    python -m paddle_tpu.staticcheck --json           # machine format
+    python -m paddle_tpu.staticcheck --format=github  # CI annotations
+    python -m paddle_tpu.staticcheck --checkers SC01,SC06-SC09
+    python -m paddle_tpu.staticcheck --list           # checker catalog
     python -m paddle_tpu.staticcheck path/to/file.py ...
 """
 
@@ -18,27 +19,58 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 
 from . import all_checker_classes, checker_by_id, run
+
+_RANGE_RE = re.compile(r"^(SC)(\d+)-(?:SC)?(\d+)$")
+
+
+def expand_checker_ids(spec: str) -> list[str]:
+    """``"SC01,SC06-SC09"`` -> ["SC01", "SC06", "SC07", "SC08",
+    "SC09"] (range syntax is inclusive; width follows the left id)."""
+    out: list[str] = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        m = _RANGE_RE.match(tok)
+        if m:
+            prefix, lo, hi = m.group(1), int(m.group(2)), int(m.group(3))
+            if hi < lo:
+                raise ValueError(f"empty checker range {tok!r}")
+            width = len(m.group(2))
+            out.extend(f"{prefix}{i:0{width}d}"
+                       for i in range(lo, hi + 1))
+        else:
+            out.append(tok)
+    return out
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.staticcheck",
         description="graftcheck: AST static analysis enforcing the "
-                    "serving stack's determinism, host/device, and "
-                    "concurrency invariants")
+                    "serving stack's determinism, host/device, "
+                    "concurrency and interprocedural invariants")
     ap.add_argument("paths", nargs="*",
                     help="files to scan (default: the configured "
                          "scan set)")
+    ap.add_argument("--format", default=None, dest="fmt",
+                    choices=["human", "json", "github"],
+                    help="report format (github: ::error annotation "
+                         "lines for CI)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit a machine-readable JSON report")
+                    help="emit a machine-readable JSON report "
+                         "(alias for --format=json)")
     ap.add_argument("--checkers", default=None,
-                    help="comma-separated checker ids (default: all)")
+                    help="comma-separated checker ids; SC06-SC09 "
+                         "range syntax accepted (default: all)")
     ap.add_argument("--list", action="store_true", dest="list_only",
                     help="print the checker catalog and exit")
     args = ap.parse_args(argv)
+    fmt = args.fmt or ("json" if args.as_json else "human")
 
     if args.list_only:
         for cls in all_checker_classes():
@@ -47,13 +79,17 @@ def main(argv=None) -> int:
 
     checkers = None
     if args.checkers:
-        checkers = [checker_by_id(c.strip())
-                    for c in args.checkers.split(",") if c.strip()]
+        checkers = [checker_by_id(c)
+                    for c in expand_checker_ids(args.checkers)]
 
     result = run(sources=args.paths or None, checkers=checkers)
 
-    if args.as_json:
+    if fmt == "json":
         print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+    elif fmt == "github":
+        for f in result.findings:
+            print(f"::error file={f.file},line={f.line}::"
+                  f"{f.checker_id} {f.message}")
     else:
         for f in result.findings:
             print(f.render())

@@ -1,0 +1,531 @@
+"""graftcheck callgraph (ISSUE 12 tentpole): the parse-once,
+project-wide symbol table + call graph the interprocedural checkers
+(SC06-SC09) ride on.
+
+Two layers:
+
+- the **resolver** — the alias-aware machinery SC03 grew in
+  ``host_sync.py`` (lexical :class:`Scope` chains, ``self.X = fn``
+  attribute aliases, ``functools.partial`` bindings, trace wrappers,
+  and the program-factory shape ``make_decode -> decode_chunk``),
+  hoisted here so every checker shares one copy. ``host_sync.py`` is
+  now a client: :func:`resolve_callables` is its old ``resolve()``
+  verbatim, parameterized by a ``mark`` callback, and
+  :class:`FileIndex` is its old per-file scope/alias build, cached per
+  :class:`~paddle_tpu.staticcheck.core.SourceFile` so SC03, SC06 and
+  SC09 parse each file's scopes once per run.
+
+- the **graph** — :class:`CallGraph` builds one symbol table over the
+  whole scan set (module functions, class methods, nested defs) and
+  resolves intra-project call edges: lexical calls through the
+  resolver, ``self.m()`` to the enclosing class's methods,
+  ``obj.m()`` to every project function named ``m`` (deliberate
+  over-approximation — reachability checkers like SC07 must not lose
+  an edge to dynamic dispatch), bare-name calls through ``from x
+  import y`` imports, and ``Cls(...)`` to ``Cls.__init__``. Edge lists
+  are sorted, so BFS order — and every report built on it — is
+  byte-deterministic.
+
+Reachability API::
+
+    g = CallGraph(sources)
+    g.reachable_from("DecodeEngine.decode_once")   # [FunctionInfo]
+    g.callers_of("flush")                          # [FunctionInfo]
+    g.paths_from("ServingFleet.step")              # info -> call chain
+
+Functions whose ``def`` line carries ``# staticcheck: io-boundary``
+are sanctioned egress points: :meth:`CallGraph.is_io_boundary` is the
+traversal cut SC07 uses (the function is neither scanned nor
+expanded). Stdlib-only, like everything under staticcheck/.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+
+from . import config
+from .util import call_target
+from .core import all_nodes
+
+__all__ = [
+    "TRACE_WRAPPERS", "CONTROL_HOFS", "PARTIAL_NAMES", "STATIC_ATTRS",
+    "STATIC_CALLS", "HOST_CASTS", "ITEM_METHODS", "NP_BASES",
+    "NP_MATERIALIZERS", "last_name", "param_names", "positional_params",
+    "Statics", "jit_statics", "Scope", "FileIndex", "file_index",
+    "resolve_callables", "returned_defs", "FunctionInfo", "CallGraph"]
+
+# -- hoisted resolver tables (SC03's, shared by SC06/SC09) ------------------
+
+#: wrappers whose FIRST positional argument is traced
+TRACE_WRAPPERS = frozenset({
+    "jit", "pallas_call", "shard_map", "grad", "value_and_grad",
+    "vmap", "pmap", "checkpoint", "remat"})
+#: lax control-flow HOFs — every positional argument that resolves to
+#: a function is traced (scan/cond/while_loop/fori_loop/switch/map)
+CONTROL_HOFS = frozenset({
+    "scan", "cond", "while_loop", "fori_loop", "switch", "map",
+    "associative_scan"})
+PARTIAL_NAMES = frozenset({"partial"})
+
+#: attribute reads on a tracer that are resolved at TRACE time
+STATIC_ATTRS = frozenset({
+    "shape", "ndim", "dtype", "size", "weak_type", "sharding", "aval",
+    "itemsize", "nbytes"})
+#: builtin calls whose ARGUMENTS are trace-static queries
+STATIC_CALLS = frozenset({"len", "isinstance", "type", "getattr",
+                          "hasattr", "id"})
+HOST_CASTS = frozenset({"float", "int", "bool", "complex"})
+ITEM_METHODS = frozenset({"item", "tolist", "tobytes"})
+NP_BASES = frozenset({"np", "numpy", "onp", "_np"})
+NP_MATERIALIZERS = frozenset({"asarray", "array"})
+
+
+def last_name(node) -> str:
+    """``jax.jit`` -> "jit", ``jit`` -> "jit", else ""."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def param_names(fn) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def positional_params(fn) -> list[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+class Statics:
+    """Which parameters of a traced function are STATIC (trace-time
+    python values): ``n_pos`` leading positionals (partial-bound) plus
+    explicit names (partial kwargs, static_argnums/argnames)."""
+
+    __slots__ = ("n_pos", "names", "indices")
+
+    def __init__(self, n_pos=0, names=(), indices=()):
+        self.n_pos = n_pos
+        self.names = frozenset(names)
+        self.indices = frozenset(indices)
+
+    def resolve(self, fn) -> frozenset:
+        pos = positional_params(fn)
+        out = set(self.names)
+        out.update(pos[:self.n_pos])
+        for i in self.indices:
+            if 0 <= i < len(pos):
+                out.add(pos[i])
+        return frozenset(out)
+
+
+def jit_statics(call: ast.Call) -> Statics:
+    """static_argnums/static_argnames from a jit(...) call."""
+    idx, names = [], []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value,
+                                                              int):
+                    idx.append(c.value)
+        elif kw.arg == "static_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value,
+                                                              str):
+                    names.append(c.value)
+    return Statics(names=names, indices=idx)
+
+
+class Scope:
+    """Lexical scope node: local function defs and simple ``name =
+    expr`` assignments, with a parent chain for outward lookup."""
+
+    def __init__(self, parent=None):
+        self.parent = parent
+        self.defs: dict[str, list] = {}        # name -> FunctionDefs
+        self.assigns: dict[str, list] = {}     # name -> value exprs
+
+    def lookup_defs(self, name):
+        s = self
+        while s is not None:
+            if name in s.defs:
+                return s.defs[name]
+            s = s.parent
+        return []
+
+    def lookup_assigns(self, name):
+        s = self
+        while s is not None:
+            if name in s.assigns:
+                return s.assigns[name]
+            s = s.parent
+        return []
+
+
+class FileIndex:
+    """One file's lexical index, built once and shared by SC03, SC06,
+    SC09 and the graph: a :class:`Scope` per def (keyed by node id),
+    the module root scope, and the file's ``self.X = expr`` attribute
+    aliases (keyed by attribute name — same granularity SC03 has
+    always used)."""
+
+    def __init__(self, src):
+        self.src = src
+        self.scopes: dict[int, Scope] = {}
+        self.attr_aliases: dict[str, list] = {}
+        self.root = Scope()
+        self.scopes[id(src.tree)] = self.root
+        self._build(src.tree, self.root)
+
+    def _build(self, node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                scope.defs.setdefault(child.name, []).append(child)
+                inner = Scope(scope)
+                self.scopes[id(child)] = inner
+                self._build(child, inner)
+            elif isinstance(child, ast.Lambda):
+                inner = Scope(scope)
+                self.scopes[id(child)] = inner
+                self._build(child, inner)
+            elif isinstance(child, ast.ClassDef):
+                # class body is not an enclosing scope for its
+                # methods' name lookups; keep the outer scope
+                self._build(child, scope)
+            else:
+                if isinstance(child, ast.Assign) \
+                        and len(child.targets) == 1:
+                    t = child.targets[0]
+                    if isinstance(t, ast.Name):
+                        scope.assigns.setdefault(
+                            t.id, []).append(child.value)
+                    elif isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name):
+                        self.attr_aliases.setdefault(
+                            t.attr, []).append(child.value)
+                self._build(child, scope)
+
+    def scope_of(self, node) -> Scope:
+        return self.scopes.get(id(node), self.root)
+
+
+def file_index(src) -> FileIndex:
+    """Per-SourceFile :class:`FileIndex`, memoized on the source object
+    so every checker in a run shares one scope build per file."""
+    idx = getattr(src, "_callgraph_index", None)
+    if idx is None:
+        idx = FileIndex(src)
+        src._callgraph_index = idx
+    return idx
+
+
+def resolve_callables(expr, scope, index: FileIndex, statics: Statics,
+                      mark, seen, depth=0):
+    """Mark every function ``expr`` can denote (SC03's ``resolve()``,
+    hoisted verbatim): follows local/module assignments, ``self.X``
+    attribute aliases, ``functools.partial``, trace wrappers, and
+    factory calls whose return value is a nested def. ``mark(fn,
+    statics)`` is called for each resolved FunctionDef/Lambda;
+    ``seen`` is the caller-owned recursion guard (SC03 shares one per
+    file scan; edge building uses a fresh set per call site)."""
+    if expr is None or depth > 8 or id(expr) in seen:
+        return
+    seen.add(id(expr))
+    if isinstance(expr, ast.Lambda):
+        mark(expr, statics)
+        return
+    if isinstance(expr, ast.Name):
+        for fn in scope.lookup_defs(expr.id):
+            mark(fn, statics)
+        for val in scope.lookup_assigns(expr.id):
+            resolve_callables(val, scope, index, statics, mark, seen,
+                              depth + 1)
+        if expr.id in config.TRACED_EXTRA_NAMES:
+            for fn in scope.lookup_defs(expr.id):
+                mark(fn, statics)
+        return
+    if isinstance(expr, ast.Attribute):
+        # self._make_decode -> whatever was assigned to it
+        name = expr.attr
+        for fn in index.root.lookup_defs(name) or []:
+            mark(fn, statics)
+        for val in index.attr_aliases.get(name, ()):
+            resolve_callables(val, scope, index, statics, mark, seen,
+                              depth + 1)
+        return
+    if isinstance(expr, ast.Call):
+        target = call_target(expr)
+        if target in PARTIAL_NAMES and expr.args:
+            bound_kw = [kw.arg for kw in expr.keywords if kw.arg]
+            inner = Statics(
+                n_pos=statics.n_pos + len(expr.args) - 1,
+                names=set(statics.names) | set(bound_kw),
+                indices=statics.indices)
+            resolve_callables(expr.args[0], scope, index, inner, mark,
+                              seen, depth + 1)
+            return
+        if target in TRACE_WRAPPERS and expr.args:
+            st = jit_statics(expr) if target == "jit" else Statics()
+            resolve_callables(expr.args[0], scope, index, st, mark,
+                              seen, depth + 1)
+            return
+        # factory call (`self._make_decode(n)`) or local wrapper
+        # (`_tp_wrap(prefill_paged, 3)`): mark what the callee
+        # RETURNS, and look for function-valued args
+        callee_defs = []
+        if isinstance(expr.func, ast.Name):
+            callee_defs = scope.lookup_defs(expr.func.id)
+        elif isinstance(expr.func, ast.Attribute):
+            name = expr.func.attr
+            callee_defs = list(index.root.lookup_defs(name))
+            for val in index.attr_aliases.get(name, ()):
+                if isinstance(val, ast.Name):
+                    callee_defs += scope.lookup_defs(val.id)
+        for fd in callee_defs:
+            for inner_fn in returned_defs(fd):
+                mark(inner_fn, Statics())
+        for a in expr.args:
+            resolve_callables(a, scope, index, statics, mark, seen,
+                              depth + 1)
+        return
+
+
+def returned_defs(fd):
+    """Nested defs that ``fd`` returns — the program-factory shape
+    (make_decode -> decode_chunk)."""
+    nested = {n.name: n for n in ast.walk(fd)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and n is not fd}
+    out = []
+    for n in ast.walk(fd):
+        if isinstance(n, ast.Return) \
+                and isinstance(n.value, ast.Name) \
+                and n.value.id in nested:
+            out.append(nested[n.value.id])
+    return out
+
+
+# -- the project graph ------------------------------------------------------
+
+class FunctionInfo:
+    """One function/method in the project symbol table."""
+
+    __slots__ = ("qualname", "display", "name", "cls", "node", "src")
+
+    def __init__(self, qualname, display, name, cls, node, src):
+        self.qualname = qualname    # "<rel>::<display>" — unique
+        self.display = display      # "Cls.method" / "fn" / "fn.inner"
+        self.name = name            # bare name
+        self.cls = cls              # enclosing class name or None
+        self.node = node            # the ast.FunctionDef
+        self.src = src              # the SourceFile
+
+    def __repr__(self):
+        return f"FunctionInfo({self.qualname})"
+
+
+class CallGraph:
+    """Project-wide symbol table + call graph over ``sources`` (a list
+    of already-parsed SourceFiles). Built once per :func:`run`
+    invocation and handed to every graph-based checker."""
+
+    def __init__(self, sources):
+        self.sources = list(sources)
+        self.functions: dict[str, FunctionInfo] = {}
+        self._by_node: dict[int, str] = {}
+        self._by_name: dict[str, list[str]] = {}
+        self._by_display: dict[str, list[str]] = {}
+        self._imports: dict[str, dict[str, tuple]] = {}
+        for src in self.sources:
+            self._collect(src)
+        self.edges: dict[str, tuple] = {}
+        for qual in sorted(self.functions):
+            self.edges[qual] = self._edges_for(self.functions[qual])
+        self._rev: dict[str, list[str]] | None = None
+
+    # -- symbol table --------------------------------------------------------
+
+    def _collect(self, src):
+        imports: dict[str, tuple] = {}
+        for node in all_nodes(src):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    imports[a.asname or a.name] = (node.module, a.name)
+        self._imports[src.rel] = imports
+
+        def add(child, cls, prefix):
+            display = f"{prefix}.{child.name}" if prefix else child.name
+            qual = f"{src.rel}::{display}"
+            if qual in self.functions:      # branch-duplicated defs
+                qual = f"{src.rel}::{display}@{child.lineno}"
+            info = FunctionInfo(qual, display, child.name, cls, child,
+                                src)
+            self.functions[qual] = info
+            self._by_node[id(child)] = qual
+            self._by_name.setdefault(child.name, []).append(qual)
+            self._by_display.setdefault(display, []).append(qual)
+            return display
+
+        def walk(node, cls, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    disp = add(child, cls, prefix)
+                    walk(child, cls, disp)
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, child.name, child.name)
+                else:
+                    walk(child, cls, prefix)
+
+        walk(src.tree, None, "")
+
+    # -- edges ---------------------------------------------------------------
+
+    def _calls_in(self, fn):
+        """Call nodes lexically inside ``fn``, excluding nested
+        def/lambda bodies (those are their own graph nodes)."""
+        out = []
+
+        def visit(n):
+            for c in ast.iter_child_nodes(n):
+                if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                if isinstance(c, ast.Call):
+                    out.append(c)
+                visit(c)
+
+        visit(fn)
+        return out
+
+    def _edges_for(self, info) -> tuple:
+        src = info.src
+        index = file_index(src)
+        scope = index.scope_of(info.node)
+        targets: set[str] = set()
+
+        def add_marked(fn, _statics):
+            qual = self._by_node.get(id(fn))
+            if qual and qual != info.qualname:
+                targets.add(qual)
+
+        for call in self._calls_in(info.node):
+            func = call.func
+            # file-local resolution (aliases, partials, factories)
+            resolve_callables(func, scope, index, Statics(),
+                              add_marked, set())
+            # and the call EXPRESSION itself: the resolver's Call
+            # branch follows wrapper/factory shapes (jit(make(n)) ->
+            # the def make returns) and function-valued arguments
+            # (callbacks handed to HOFs) that func alone can't see
+            resolve_callables(call, scope, index, Statics(),
+                              add_marked, set())
+            if isinstance(func, ast.Attribute):
+                attr = func.attr
+                if isinstance(func.value, ast.Name) \
+                        and func.value.id == "self" and info.cls:
+                    own = self._by_display.get(f"{info.cls}.{attr}")
+                    if own:
+                        targets.update(q for q in own
+                                       if q != info.qualname)
+                        continue
+                # obj.m(): every project function named m — losing an
+                # edge to dynamic dispatch is worse than a spurious one
+                for qual in self._by_name.get(attr, ()):
+                    if qual != info.qualname:
+                        targets.add(qual)
+            elif isinstance(func, ast.Name):
+                imp = self._imports.get(src.rel, {}).get(func.id)
+                if imp:
+                    mod_base = imp[0].rsplit(".", 1)[-1]
+                    for qual in self._by_name.get(imp[1], ()):
+                        t = self.functions[qual]
+                        if t.cls is None and "." not in t.display \
+                                and t.src.rel.endswith(mod_base + ".py"):
+                            targets.add(qual)
+                # Cls(...) -> Cls.__init__
+                targets.update(
+                    self._by_display.get(f"{func.id}.__init__", ()))
+        return tuple(sorted(targets))
+
+    # -- queries -------------------------------------------------------------
+
+    def lookup(self, name: str) -> list:
+        """FunctionInfos matching ``name``: exact display match
+        ("DecodeEngine.step"), falling back to bare-name match for a
+        plain identifier."""
+        quals = self._by_display.get(name)
+        if not quals and "." not in name:
+            quals = self._by_name.get(name)
+        return [self.functions[q] for q in sorted(quals or ())]
+
+    def callers_of(self, name: str) -> list:
+        want = {i.qualname for i in self.lookup(name)}
+        if self._rev is None:
+            rev: dict[str, list[str]] = {}
+            for qual, ts in self.edges.items():
+                for t in ts:
+                    rev.setdefault(t, []).append(qual)
+            self._rev = rev
+        quals = set()
+        for w in want:
+            quals.update(self._rev.get(w, ()))
+        return [self.functions[q] for q in sorted(quals)]
+
+    def _bfs(self, name: str, cut=None):
+        roots = self.lookup(name)
+        order, parent = [], {}
+        queue = deque()
+        for info in roots:
+            if cut is not None and cut(info):
+                continue
+            if info.qualname not in parent:
+                parent[info.qualname] = None
+                queue.append(info.qualname)
+        while queue:
+            qual = queue.popleft()
+            order.append(qual)
+            for t in self.edges.get(qual, ()):
+                if t in parent:
+                    continue
+                if cut is not None and cut(self.functions[t]):
+                    continue
+                parent[t] = qual
+                queue.append(t)
+        return order, parent
+
+    def reachable_from(self, name: str, cut=None) -> list:
+        """Every FunctionInfo reachable from ``name`` (inclusive), in
+        deterministic BFS order. ``cut(info) -> bool`` prunes a node
+        AND its out-edges (the io-boundary semantics)."""
+        order, _ = self._bfs(name, cut)
+        return [self.functions[q] for q in order]
+
+    def paths_from(self, name: str, cut=None) -> list:
+        """``[(FunctionInfo, chain)]`` in BFS order, where ``chain`` is
+        the display-name call path from the root to that function."""
+        order, parent = self._bfs(name, cut)
+        out = []
+        for qual in order:
+            chain, q = [], qual
+            while q is not None:
+                chain.append(self.functions[q].display)
+                q = parent[q]
+            out.append((self.functions[qual], tuple(reversed(chain))))
+        return out
+
+    def is_io_boundary(self, info) -> bool:
+        """True when the function's ``def`` line carries the
+        ``# staticcheck: io-boundary`` directive — the sanctioned
+        egress annotation SC07 cuts traversal at."""
+        return info.node.lineno in info.src.io_boundaries

@@ -15,7 +15,17 @@ Groups:
   the clock-owning observability/ + watchdog additionally ban
   ``time.monotonic``, modulo the alias-definition line);
 - :func:`silent_except_paths` — SC02's tier (inference/ +
-  observability/, the packages whose broad handlers must be loud).
+  observability/, the packages whose broad handlers must be loud);
+- :func:`nondet_extra_paths` — the serving TEST harnesses (ISSUE 12
+  satellite): conftest/launch_worker and the serving-stack test files
+  whose seeded-replay discipline SC04 now also enforces (and whose
+  metric-name assertions SC08 resolves against the registrations);
+- :func:`run_paths` — the default CLI run set: scan set + the SC04
+  test group.
+
+ISSUE 12 also parks the interprocedural checkers' tables here:
+:data:`BUCKET_HELPERS` (SC06's sanctioned bucketing functions) and
+:data:`STEP_PATH_ROOTS` (SC07's reachability roots).
 """
 
 from __future__ import annotations
@@ -24,9 +34,11 @@ import pathlib
 
 __all__ = ["REPO_ROOT", "PKG", "scan_paths", "timer_inference_paths",
            "timer_shared_clock_paths", "silent_except_paths",
-           "WATCHDOG", "TRACED_EXTRA_NAMES", "is_external",
+           "nondet_extra_paths", "run_paths",
+           "WATCHDOG", "TRACED_EXTRA_NAMES", "BUCKET_HELPERS",
+           "STEP_PATH_ROOTS", "is_external",
            "in_timer_inference", "in_timer_shared_clock",
-           "in_silent_except"]
+           "in_silent_except", "in_nondet_extra", "in_scan_set"]
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
 PKG = REPO_ROOT / "paddle_tpu"
@@ -39,6 +51,19 @@ WATCHDOG = PKG / "distributed" / "watchdog.py"
 #: exists so a refactor that breaks the lexical chain can pin the
 #: traced names explicitly instead of silently dropping coverage.
 TRACED_EXTRA_NAMES: frozenset = frozenset()
+
+#: SC06: functions that map a request-derived Python int into the
+#: finite bucket domain the compiled-program caches are keyed on (the
+#: engine's windows/bucket table). A value that passed through one of
+#: these is sanctioned as a jit cache key.
+BUCKET_HELPERS: frozenset = frozenset({"_bucket_window", "_bucket_len"})
+
+#: SC07: reachability roots of the serving hot path. Resolved against
+#: the call graph by display name; roots that resolve to nothing are
+#: skipped (``DecodeEngine.step`` is listed for the RPC-fleet arc even
+#: though today's engine only has ``decode_once``).
+STEP_PATH_ROOTS: tuple = ("ServingFleet.step", "DecodeEngine.step",
+                          "DecodeEngine.decode_once")
 
 
 def _glob(d: pathlib.Path) -> list[pathlib.Path]:
@@ -69,17 +94,69 @@ def scan_paths() -> list[pathlib.Path]:
     )
 
 
+#: The serving-stack test harnesses SC04 (and SC08's asserted-name
+#: resolution) additionally cover. test_staticcheck.py is deliberately
+#: absent: its embedded fixture STRINGS contain suppression directives
+#: that the raw-line directive scan would misread as the file's own.
+_NONDET_EXTRA = (
+    "conftest.py", "launch_worker.py", "test_fleet.py", "test_qos.py",
+    "test_chaos.py", "test_slo.py", "test_spec_decode.py",
+    "test_chunked_prefill.py", "test_prefix_scheduler.py",
+    "test_observability.py", "test_paged_attention.py",
+    "test_tp_sharding.py", "test_bench_probe.py")
+
+
+def nondet_extra_paths() -> list[pathlib.Path]:
+    """The seeded-replay test group (ISSUE 12 satellite), deterministic
+    order."""
+    return [REPO_ROOT / "tests" / n for n in _NONDET_EXTRA]
+
+
+def run_paths() -> list[pathlib.Path]:
+    """Everything the default CLI invocation scans."""
+    return scan_paths() + nondet_extra_paths()
+
+
+def _src_rpath(src):
+    """``src.path.resolve()`` memoized on the SourceFile — group
+    predicates run once per (checker, file) and pathlib resolution
+    dominated the 9-checker CLI profile before this cache."""
+    rp = getattr(src, "_rpath", None)
+    if rp is None and src.path is not None:
+        rp = src.path.resolve()
+        src._rpath = rp
+    return rp
+
+
 def is_external(src) -> bool:
     """True for an explicit CLI path OUTSIDE the repository (e.g. a
     test fixture in a temp dir) — such files get every checker's
     widest net, like virtual fixtures."""
     if src.virtual or src.path is None:
         return False
-    try:
-        src.path.resolve().relative_to(REPO_ROOT)
-        return False
-    except ValueError:
-        return True
+    ext = getattr(src, "_external", None)
+    if ext is None:
+        try:
+            _src_rpath(src).relative_to(REPO_ROOT)
+            ext = False
+        except ValueError:
+            ext = True
+        src._external = ext
+    return ext
+
+
+#: key -> frozenset of resolved group paths (the groups are static
+#: per process; re-globbing + re-resolving per predicate call was the
+#: CLI's hottest path)
+_GROUP_CACHE: dict = {}
+
+
+def _group_set(key, paths_fn):
+    got = _GROUP_CACHE.get(key)
+    if got is None:
+        got = frozenset(p.resolve() for p in paths_fn())
+        _GROUP_CACHE[key] = got
+    return got
 
 
 def _under(src, group) -> bool:
@@ -89,22 +166,42 @@ def _under(src, group) -> bool:
     files."""
     if src.virtual or is_external(src):
         return True
-    return src.path is not None and src.path.resolve() in {
-        p.resolve() for p in group}
+    rp = _src_rpath(src)
+    return rp is not None and rp in {p.resolve() for p in group}
 
 
-def _in_repo_group(src, group) -> bool:
+def _under_key(src, key, paths_fn) -> bool:
+    if src.virtual or is_external(src):
+        return True
+    rp = _src_rpath(src)
+    return rp is not None and rp in _group_set(key, paths_fn)
+
+
+def _in_repo_key(src, key, paths_fn) -> bool:
     return (not src.virtual and not is_external(src)
-            and _under(src, group))
+            and _under_key(src, key, paths_fn))
 
 
 def in_timer_inference(src) -> bool:
-    return _in_repo_group(src, timer_inference_paths())
+    return _in_repo_key(src, "timer_inf", timer_inference_paths)
 
 
 def in_timer_shared_clock(src) -> bool:
-    return _in_repo_group(src, timer_shared_clock_paths())
+    return _in_repo_key(src, "timer_clock", timer_shared_clock_paths)
 
 
 def in_silent_except(src) -> bool:
-    return _under(src, silent_except_paths())
+    return _under_key(src, "silent_except", silent_except_paths)
+
+
+def in_scan_set(src) -> bool:
+    """The default checker group: the shared scan set (virtual
+    fixtures and external CLI paths always pass)."""
+    return _under_key(src, "scan", scan_paths)
+
+
+def in_nondet_extra(src) -> bool:
+    """True only for REAL files of the test-harness group — virtual/
+    external fixtures already pass every group via
+    :func:`in_scan_set`."""
+    return _in_repo_key(src, "nondet_extra", nondet_extra_paths)

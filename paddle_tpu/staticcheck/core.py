@@ -30,6 +30,9 @@ Comment directives (see SURVEY.md §7.18):
 - ``# staticcheck: holds=_lock`` — on a ``def`` line: the method's
   contract is that the CALLER holds ``self._lock`` (SC05 treats the
   whole body as guarded, like the ``_locked`` name suffix).
+- ``# staticcheck: io-boundary`` — on a ``def`` line: the function is
+  a sanctioned IO egress (telemetry sink ``emit``); SC07's step-path
+  reachability walk neither scans nor traverses it.
 
 Everything here is stdlib-only — the CLI must stay runnable without
 importing jax or the serving stack.
@@ -44,7 +47,7 @@ from dataclasses import dataclass
 
 __all__ = ["Finding", "SourceFile", "Checker", "register",
            "all_checker_classes", "checker_by_id", "run", "RunResult",
-           "UNUSED_SUPPRESSION_ID"]
+           "UNUSED_SUPPRESSION_ID", "all_nodes"]
 
 #: Pseudo-checker id for the unused-suppression warning itself. A
 #: suppression that no longer suppresses anything is dead weight that
@@ -56,6 +59,7 @@ _SUPPRESS_RE = re.compile(
     r"#\s*staticcheck:\s*disable=([A-Za-z0-9_,\s]+)")
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
 _HOLDS_RE = re.compile(r"#\s*staticcheck:\s*holds=([A-Za-z_]\w*)")
+_IO_BOUNDARY_RE = re.compile(r"#\s*staticcheck:\s*io-boundary\b")
 
 
 @dataclass(frozen=True, order=True)
@@ -102,6 +106,8 @@ class SourceFile:
         self.guarded_by: dict[int, str] = {}
         # line -> lock attribute name (caller-holds contract, SC05)
         self.holds: dict[int, str] = {}
+        # def lines annotated as sanctioned IO egress (SC07)
+        self.io_boundaries: set[int] = set()
         for lineno, line in enumerate(self.lines, 1):
             m = _SUPPRESS_RE.search(line)
             if m:
@@ -114,6 +120,8 @@ class SourceFile:
             m = _HOLDS_RE.search(line)
             if m:
                 self.holds[lineno] = m.group(1)
+            if _IO_BOUNDARY_RE.search(line):
+                self.io_boundaries.add(lineno)
 
     @classmethod
     def from_path(cls, path, root) -> "SourceFile":
@@ -132,6 +140,18 @@ class SourceFile:
         """In-memory fixture (tests embed source strings — no temp
         files)."""
         return cls(name, text, virtual=True)
+
+
+def all_nodes(src: "SourceFile") -> list:
+    """Flat list of every AST node in ``src``, walked once and
+    memoized on the SourceFile — checkers that filter the whole tree
+    (registrations, RNG calls, jit bindings) share it instead of each
+    re-running ``ast.walk``."""
+    nodes = getattr(src, "_all_nodes", None)
+    if nodes is None:
+        nodes = list(ast.walk(src.tree))
+        src._all_nodes = nodes
+    return nodes
 
 
 _REGISTRY: dict[str, type] = {}
@@ -164,19 +184,36 @@ class Checker:
     """Base class. Subclasses set ``id`` (``SCnn``), ``name`` (kebab
     slug) and ``description``, and implement :meth:`check` yielding
     :class:`Finding`s. :meth:`applies_to` narrows the shared scan set
-    per checker (SC01 only polices the clock-owning packages, SC03
-    polices everything that can hold a traced function); in-memory
-    fixtures (``src.virtual``) always pass so tests can drive any
-    checker with embedded snippets."""
+    per checker (SC01 only polices the clock-owning packages, SC04
+    additionally covers the serving test harnesses); the default is
+    the full shared scan set. In-memory fixtures (``src.virtual``) and
+    explicit out-of-repo CLI paths always pass so tests can drive any
+    checker with embedded snippets or temp files.
+
+    Checkers with ``project = True`` are INTERPROCEDURAL: instead of
+    per-file :meth:`check` calls they get one :meth:`check_project`
+    call with the run's shared :class:`~paddle_tpu.staticcheck
+    .callgraph.CallGraph` (built once per :func:`run` — the parse/
+    graph cache that keeps the 9-checker CLI fast) plus every scanned
+    source."""
 
     id = ""
     name = ""
     description = ""
+    #: True for call-graph checkers driven via :meth:`check_project`
+    project = False
 
     def applies_to(self, src: SourceFile) -> bool:
-        return True
+        from . import config
+        return config.in_scan_set(src)
 
     def check(self, src: SourceFile):
+        raise NotImplementedError
+
+    def check_project(self, graph, sources):
+        """Project-wide pass for ``project = True`` checkers: yield
+        findings over the shared call graph (``graph.sources`` is the
+        scan-set slice; ``sources`` is everything scanned)."""
         raise NotImplementedError
 
     # helper: uniform finding construction
@@ -207,12 +244,16 @@ class RunResult:
 def run(sources=None, checkers=None, respect_groups=True) -> RunResult:
     """Run ``checkers`` (instances or classes; default: the full
     registry) over ``sources`` (SourceFiles, paths, or None for the
-    configured scan set). Applies suppressions, emits SC00 for unused
-    ones, and returns findings in deterministic sorted order."""
+    configured scan set plus the SC04/SC08 test-harness group).
+    Per-file checkers fan out first; project (call-graph) checkers
+    then share ONE :class:`callgraph.CallGraph` built over the run's
+    scan-set slice — the parse-once cache that keeps the nine-checker
+    CLI inside its ~2 s budget. Applies suppressions, emits SC00 for
+    unused ones, and returns findings in deterministic sorted order."""
     from . import config
 
     if sources is None:
-        sources = config.scan_paths()
+        sources = config.run_paths()
     srcs = []
     for s in sources:
         if isinstance(s, SourceFile):
@@ -226,19 +267,36 @@ def run(sources=None, checkers=None, respect_groups=True) -> RunResult:
 
     findings: list[Finding] = []
     used: dict[tuple, set] = {}      # (rel, line) -> ids that fired
+    by_rel = {s.rel: s for s in srcs}
+
+    def record(f: Finding):
+        src = by_rel.get(f.file)
+        sup = src.suppressions.get(f.line, ()) if src else ()
+        if f.checker_id in sup:
+            used.setdefault((f.file, f.line), set()).add(f.checker_id)
+            return
+        findings.append(f)
+
     for src in srcs:
         for chk in insts:
+            if chk.project:
+                continue
             if respect_groups and not chk.applies_to(src):
                 continue
             for f in chk.check(src):
-                sup = src.suppressions.get(f.line, ())
-                if f.checker_id in sup:
-                    used.setdefault((src.rel, f.line), set()).add(
-                        f.checker_id)
-                    continue
-                findings.append(f)
-        # unused-suppression warnings — per file, after every checker
-        # that scans it has run
+                record(f)
+
+    proj = [c for c in insts if c.project]
+    if proj:
+        from .callgraph import CallGraph
+        gsrcs = [s for s in srcs if config.in_scan_set(s)]
+        graph = CallGraph(gsrcs)
+        for chk in proj:
+            for f in chk.check_project(graph, srcs):
+                record(f)
+
+    # unused-suppression warnings — after every checker has run
+    for src in srcs:
         active = {c.id for c in insts
                   if not respect_groups or c.applies_to(src)}
         for line, ids in src.suppressions.items():

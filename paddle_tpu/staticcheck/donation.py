@@ -1,0 +1,277 @@
+"""SC09 donation-discipline: ``donate_argnums`` is the r15 arc's
+memory headroom — the decode/mixed/COW programs donate their KV pool
+buffers so XLA reuses the pages in place. Two ways it silently rots:
+
+1. **arity drift** — the donation spec is an index tuple written
+   against the pool closure's signature (``tuple(range(8, 8 +
+   n_pool))`` against ``decode_chunk_paged(..., *pool)``); refactor
+   the closure's parameter list and the indices now donate the WRONG
+   arguments (or none), which either throws at trace time on the
+   device or quietly stops donating and doubles peak HBM. The checker
+   resolves the jitted callee through the shared
+   :mod:`~paddle_tpu.staticcheck.callgraph` resolver (aliases,
+   ``partial``, ``_tp_wrap``-style wrappers, factory returns) and
+   flags a spec that matches NO candidate: explicit indices must fall
+   inside the positional list (a ``*args`` catch-all accepts any),
+   and the ``tuple(range(A, ...))`` pool form must start exactly at
+   the vararg position.
+2. **use-after-donate** — a donated buffer is dead the moment the
+   donating call is issued; reading the local afterwards returns
+   garbage (or a deleted-buffer error on TPU). For every name bound
+   to a donating jit, call sites are scanned statement-linearly:
+   positional args (and ``*pool`` stars) landing at donated indices
+   become watched names, a Load before a rebind is a finding, a Store
+   kills the watch (the engine's own idiom ``out, *pool =
+   self._decode(..., *pool)`` rebinds in the same statement and is
+   clean by construction).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import (Statics, file_index, positional_params,
+                        resolve_callables)
+from .core import Checker, all_nodes, register
+from .util import call_target
+
+__all__ = ["DonationDisciplineChecker"]
+
+
+def _parse_spec(val):
+    """A donate_argnums value -> ("explicit", [ints]) |
+    ("range", A, B_or_None) | None (unparseable: stay silent)."""
+    if isinstance(val, ast.Constant) and isinstance(val.value, int):
+        return ("explicit", [val.value])
+    if isinstance(val, (ast.Tuple, ast.List)):
+        idxs = []
+        for e in val.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            idxs.append(e.value)
+        return ("explicit", idxs)
+    if isinstance(val, ast.Call) and call_target(val) == "tuple" \
+            and val.args and isinstance(val.args[0], ast.Call) \
+            and call_target(val.args[0]) == "range":
+        rng = val.args[0]
+        if not rng.args:
+            return None
+        a = rng.args[0]
+        if not (isinstance(a, ast.Constant)
+                and isinstance(a.value, int)):
+            return None
+        if len(rng.args) < 2:
+            return ("range", 0, a.value)
+        b = rng.args[1]
+        if isinstance(b, ast.Constant) and isinstance(b.value, int):
+            return ("range", a.value, b.value)
+        return ("range", a.value, None)     # 8 + n_pool: open length
+    return None
+
+
+def _spec_str(spec) -> str:
+    if spec[0] == "explicit":
+        return f"({', '.join(str(i) for i in spec[1])})"
+    b = "…" if spec[2] is None else str(spec[2])
+    return f"range({spec[1]}, {b})"
+
+
+def _spec_fits(spec, fn) -> bool:
+    pos = len(positional_params(fn))
+    var = fn.args.vararg is not None
+    if spec[0] == "explicit":
+        return var or all(i < pos for i in spec[1])
+    _tag, a, b = spec
+    if var:
+        # the pool form: the donated range must START at the vararg
+        return a == pos
+    if b is None:
+        return False        # open-length range vs fixed arity
+    return b <= pos
+
+
+def _idx_donated(i, spec) -> bool:
+    if spec[0] == "explicit":
+        return i in spec[1]
+    return spec[1] <= i and (spec[2] is None or i < spec[2])
+
+
+def _star_donated(i, spec) -> bool:
+    """Does the donation spec reach a ``*name`` starting at positional
+    index ``i``?"""
+    if spec[0] == "explicit":
+        return any(idx >= i for idx in spec[1])
+    return spec[2] is None or spec[2] > i
+
+
+@register
+class DonationDisciplineChecker(Checker):
+    id = "SC09"
+    name = "donation-discipline"
+    description = ("donate_argnums indices off the callee's arity, or "
+                   "a donated buffer read after the donating call")
+
+    def check(self, src):
+        index = file_index(src)
+        donating: dict = {}     # bound name -> spec
+
+        # part 1: every jit(..., donate_argnums=...) site
+        def scan(node, scope):
+            for child in ast.iter_child_nodes(node):
+                inner = index.scopes.get(id(child))
+                nscope = inner if inner is not None else scope
+                if isinstance(child, ast.Call) \
+                        and call_target(child) == "jit" and child.args:
+                    kw = next((k for k in child.keywords
+                               if k.arg == "donate_argnums"), None)
+                    if kw is not None:
+                        yield from self._check_site(
+                            src, child, kw, nscope, index)
+                yield from scan(child, nscope)
+
+        yield from scan(src.tree, index.root)
+
+        # part 2: use-after-donate at call sites of donating bindings
+        for node in all_nodes(src):
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call) \
+                    and call_target(node.value) == "jit":
+                kw = next((k for k in node.value.keywords
+                           if k.arg == "donate_argnums"), None)
+                spec = _parse_spec(kw.value) if kw is not None else None
+                if spec is None:
+                    continue
+                t = node.targets[0]
+                name = t.attr if isinstance(t, ast.Attribute) else (
+                    t.id if isinstance(t, ast.Name) else None)
+                if name:
+                    donating[name] = spec
+        if donating:
+            for owner in [src.tree] + [
+                    n for n in all_nodes(src)
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))]:
+                yield from self._scan_uses(src, owner.body, donating,
+                                           {})
+
+    # -- part 1: spec vs callee arity ----------------------------------------
+
+    def _check_site(self, src, call, kw, scope, index):
+        spec = _parse_spec(kw.value)
+        if spec is None:
+            return
+        cands = []
+
+        def mark(fn, _st):
+            if fn not in cands:
+                cands.append(fn)
+
+        resolve_callables(call.args[0], scope, index, Statics(), mark,
+                          set())
+        cands = [c for c in cands
+                 if isinstance(c, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.Lambda))]
+        if not cands or any(_spec_fits(spec, c) for c in cands):
+            return
+        names = ", ".join(sorted(
+            getattr(c, "name", "<lambda>") for c in cands))
+        yield self.finding(
+            src, call.lineno,
+            f"donate_argnums {_spec_str(spec)} matches no resolved "
+            f"callee ({names}) — explicit indices must fall inside "
+            f"the positional list and a range donation must start at "
+            f"the *pool vararg; stale indices silently stop donating "
+            f"(or donate the wrong buffers)")
+
+    # -- part 2: use-after-donate --------------------------------------------
+
+    _COMPOUND = (ast.If, ast.While, ast.For, ast.AsyncFor, ast.With,
+                 ast.AsyncWith, ast.Try)
+
+    def _scan_uses(self, src, body, donating, watches):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue        # separate scopes, scanned on their own
+            if isinstance(stmt, self._COMPOUND):
+                # headers first, then bodies in order — never the
+                # whole subtree at once (a load BEFORE a donation
+                # deeper in the same compound must stay clean)
+                for h in self._headers(stmt):
+                    yield from self._unit(src, h, donating, watches)
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        yield from self._scan_uses(src, sub, donating,
+                                                   watches)
+                for h in getattr(stmt, "handlers", ()):
+                    yield from self._scan_uses(src, h.body, donating,
+                                               watches)
+            else:
+                yield from self._unit(src, stmt, donating, watches)
+
+    def _headers(self, stmt):
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return [stmt.iter, stmt.target]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return list(stmt.items)
+        return []
+
+    def _unit(self, src, node, donating, watches):
+        if watches:
+            yield from self._loads(src, node, watches)
+        for call in self._calls_in(node):
+            tgt = call_target(call)
+            spec = donating.get(tgt)
+            if spec is None:
+                continue
+            for name in self._donated_args(call, spec):
+                watches[name] = (call.lineno, tgt)
+        self._stores(node, watches)
+
+    def _calls_in(self, stmt):
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                yield n
+
+    def _donated_args(self, call, spec):
+        names, i = [], 0
+        for a in call.args:
+            if isinstance(a, ast.Starred):
+                if isinstance(a.value, ast.Name) \
+                        and _star_donated(i, spec):
+                    names.append(a.value.id)
+                break       # positions after a star are unknowable
+            if isinstance(a, ast.Name) and _idx_donated(i, spec):
+                names.append(a.id)
+            i += 1
+        return names
+
+    def _loads(self, src, stmt, watches):
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in watches:
+                line, callee = watches.pop(n.id)
+                yield self.finding(
+                    src, n.lineno,
+                    f"donated buffer {n.id!r} read after being "
+                    f"donated to {callee!r} at line {line} — donation "
+                    f"invalidates the argument; rebind it from the "
+                    f"call's result instead")
+
+    def _stores(self, stmt, watches):
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Name) \
+                    and isinstance(n.ctx, (ast.Store, ast.Del)) \
+                    and n.id in watches:
+                del watches[n.id]

@@ -25,16 +25,18 @@ positional/keyword arguments pre-bound by ``functools.partial`` inside
 a trace wrapper (``jax.jit(partial(_generate_all, cfg, n, ...))``) and
 ``static_argnums``/``static_argnames`` of ``jit``.
 
-Traced-function discovery is lexical but alias-aware: it follows
-simple local/module assignments (``kernel = partial(_paged_kernel,
-bs=bs)`` … ``pl.pallas_call(kernel, …)``), ``self.X = fn`` attribute
-aliases (``self._make_decode = make_decode`` …
-``jax.jit(self._make_decode(n))``), and FACTORIES — a function whose
-``return`` value is one of its own nested ``def``s is treated as a
-program factory, and the returned function is traced (this is exactly
-the engine's ``_decode_progs``/``_prefix_progs``/``_verify_progs``
-compiled-program-cache shape). ``config.TRACED_EXTRA_NAMES`` can pin
-names the lexical chain cannot reach.
+Traced-function discovery is lexical but alias-aware. The resolver it
+grew for that — scope chains, ``self.X = fn`` attribute aliases,
+``functools.partial`` bindings, and the FACTORY shape (a function
+whose ``return`` value is one of its own nested ``def``s, exactly the
+engine's ``_decode_progs``/``_prefix_progs``/``_verify_progs``
+compiled-program-cache pattern) — was hoisted into
+:mod:`~paddle_tpu.staticcheck.callgraph` (ISSUE 12), and this checker
+is now a client: :func:`callgraph.resolve_callables` with a
+``mark``-as-traced callback is the old ``resolve()`` verbatim, and
+:func:`callgraph.file_index` shares the per-file scope build with
+SC06/SC09 and the project graph. ``config.TRACED_EXTRA_NAMES`` can
+still pin names the lexical chain cannot reach.
 """
 
 from __future__ import annotations
@@ -42,123 +44,22 @@ from __future__ import annotations
 import ast
 
 from . import config
+from .callgraph import (CONTROL_HOFS, HOST_CASTS, ITEM_METHODS,
+                        NP_BASES, NP_MATERIALIZERS, PARTIAL_NAMES,
+                        STATIC_ATTRS, STATIC_CALLS, TRACE_WRAPPERS,
+                        Statics, file_index, jit_statics, last_name,
+                        param_names, resolve_callables)
 from .core import Checker, register
 from .util import call_target
 
 __all__ = ["HostSyncChecker"]
 
-#: wrappers whose FIRST positional argument is traced
-TRACE_WRAPPERS = frozenset({
-    "jit", "pallas_call", "shard_map", "grad", "value_and_grad",
-    "vmap", "pmap", "checkpoint", "remat"})
-#: lax control-flow HOFs — every positional argument that resolves to
-#: a function is traced (scan/cond/while_loop/fori_loop/switch/map)
-CONTROL_HOFS = frozenset({
-    "scan", "cond", "while_loop", "fori_loop", "switch", "map",
-    "associative_scan"})
-PARTIAL_NAMES = frozenset({"partial"})
-
-#: attribute reads on a tracer that are resolved at TRACE time
-STATIC_ATTRS = frozenset({
-    "shape", "ndim", "dtype", "size", "weak_type", "sharding", "aval",
-    "itemsize", "nbytes"})
-#: builtin calls whose ARGUMENTS are trace-static queries
-STATIC_CALLS = frozenset({"len", "isinstance", "type", "getattr",
-                          "hasattr", "id"})
-HOST_CASTS = frozenset({"float", "int", "bool", "complex"})
-ITEM_METHODS = frozenset({"item", "tolist", "tobytes"})
-NP_BASES = frozenset({"np", "numpy", "onp", "_np"})
-NP_MATERIALIZERS = frozenset({"asarray", "array"})
-
-
-def _last_name(node) -> str:
-    """``jax.jit`` -> "jit", ``jit`` -> "jit", else ""."""
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return ""
-
-
-def _param_names(fn) -> list[str]:
-    a = fn.args
-    names = [p.arg for p in a.posonlyargs + a.args]
-    if a.vararg:
-        names.append(a.vararg.arg)
-    names += [p.arg for p in a.kwonlyargs]
-    if a.kwarg:
-        names.append(a.kwarg.arg)
-    return names
-
-
-def _positional_params(fn) -> list[str]:
-    a = fn.args
-    return [p.arg for p in a.posonlyargs + a.args]
-
-
-class _Statics:
-    """Which parameters of a traced function are STATIC (trace-time
-    python values): ``n_pos`` leading positionals (partial-bound) plus
-    explicit names (partial kwargs, static_argnums/argnames)."""
-
-    __slots__ = ("n_pos", "names", "indices")
-
-    def __init__(self, n_pos=0, names=(), indices=()):
-        self.n_pos = n_pos
-        self.names = frozenset(names)
-        self.indices = frozenset(indices)
-
-    def resolve(self, fn) -> frozenset:
-        pos = _positional_params(fn)
-        out = set(self.names)
-        out.update(pos[:self.n_pos])
-        for i in self.indices:
-            if 0 <= i < len(pos):
-                out.add(pos[i])
-        return frozenset(out)
-
-
-def _jit_statics(call: ast.Call) -> _Statics:
-    """static_argnums/static_argnames from a jit(...) call."""
-    idx, names = [], []
-    for kw in call.keywords:
-        if kw.arg == "static_argnums":
-            for c in ast.walk(kw.value):
-                if isinstance(c, ast.Constant) and isinstance(c.value,
-                                                              int):
-                    idx.append(c.value)
-        elif kw.arg == "static_argnames":
-            for c in ast.walk(kw.value):
-                if isinstance(c, ast.Constant) and isinstance(c.value,
-                                                              str):
-                    names.append(c.value)
-    return _Statics(names=names, indices=idx)
-
-
-class _Scope:
-    """Lexical scope node: local function defs and simple ``name =
-    expr`` assignments, with a parent chain for outward lookup."""
-
-    def __init__(self, parent=None):
-        self.parent = parent
-        self.defs: dict[str, list] = {}        # name -> FunctionDefs
-        self.assigns: dict[str, list] = {}     # name -> value exprs
-
-    def lookup_defs(self, name):
-        s = self
-        while s is not None:
-            if name in s.defs:
-                return s.defs[name]
-            s = s.parent
-        return []
-
-    def lookup_assigns(self, name):
-        s = self
-        while s is not None:
-            if name in s.assigns:
-                return s.assigns[name]
-            s = s.parent
-        return []
+# Backward-compatible private aliases (the resolver lived here before
+# the ISSUE 12 hoist).
+_Statics = Statics
+_jit_statics = jit_statics
+_last_name = last_name
+_param_names = param_names
 
 
 @register
@@ -169,45 +70,12 @@ class HostSyncChecker(Checker):
                    "shard_map-ed or pallas traced function")
 
     def check(self, src):
-        scopes: dict[int, _Scope] = {}
-        attr_aliases: dict[str, list] = {}     # self.X = expr
-
-        def build(node, scope):
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef)):
-                    scope.defs.setdefault(child.name, []).append(child)
-                    inner = _Scope(scope)
-                    scopes[id(child)] = inner
-                    build(child, inner)
-                elif isinstance(child, ast.Lambda):
-                    inner = _Scope(scope)
-                    scopes[id(child)] = inner
-                    build(child, inner)
-                elif isinstance(child, ast.ClassDef):
-                    # class body is not an enclosing scope for its
-                    # methods' name lookups; keep the outer scope
-                    build(child, scope)
-                else:
-                    if isinstance(child, ast.Assign) \
-                            and len(child.targets) == 1:
-                        t = child.targets[0]
-                        if isinstance(t, ast.Name):
-                            scope.assigns.setdefault(
-                                t.id, []).append(child.value)
-                        elif isinstance(t, ast.Attribute) \
-                                and isinstance(t.value, ast.Name):
-                            attr_aliases.setdefault(
-                                t.attr, []).append(child.value)
-                    build(child, scope)
-
-        root = _Scope()
-        scopes[id(src.tree)] = root
-        build(src.tree, root)
+        index = file_index(src)
+        root = index.root
 
         traced: dict[int, tuple] = {}   # id(fn) -> (fn, static names)
 
-        def mark(fn, statics: _Statics):
+        def mark(fn, statics: Statics):
             names = statics.resolve(fn)
             cur = traced.get(id(fn))
             if cur is None:
@@ -217,85 +85,14 @@ class HostSyncChecker(Checker):
 
         seen_resolving: set = set()
 
-        def resolve(expr, scope, statics: _Statics, depth=0):
-            """Mark every function ``expr`` can denote as traced."""
-            if expr is None or depth > 8 or id(expr) in seen_resolving:
-                return
-            seen_resolving.add(id(expr))
-            if isinstance(expr, ast.Lambda):
-                mark(expr, statics)
-                return
-            if isinstance(expr, ast.Name):
-                for fn in scope.lookup_defs(expr.id):
-                    mark(fn, statics)
-                for val in scope.lookup_assigns(expr.id):
-                    resolve(val, scope, statics, depth + 1)
-                if expr.id in config.TRACED_EXTRA_NAMES:
-                    for fn in scope.lookup_defs(expr.id):
-                        mark(fn, statics)
-                return
-            if isinstance(expr, ast.Attribute):
-                # self._make_decode -> whatever was assigned to it
-                name = expr.attr
-                for fn in root.lookup_defs(name) or []:
-                    mark(fn, statics)
-                for val in attr_aliases.get(name, ()):
-                    resolve(val, scope, statics, depth + 1)
-                return
-            if isinstance(expr, ast.Call):
-                target = call_target(expr)
-                if target in PARTIAL_NAMES and expr.args:
-                    bound_kw = [kw.arg for kw in expr.keywords
-                                if kw.arg]
-                    inner = _Statics(
-                        n_pos=statics.n_pos + len(expr.args) - 1,
-                        names=set(statics.names) | set(bound_kw),
-                        indices=statics.indices)
-                    resolve(expr.args[0], scope, inner, depth + 1)
-                    return
-                if target in TRACE_WRAPPERS and expr.args:
-                    st = _jit_statics(expr) if target == "jit" \
-                        else _Statics()
-                    resolve(expr.args[0], scope, st, depth + 1)
-                    return
-                # factory call (`self._make_decode(n)`) or local
-                # wrapper (`_tp_wrap(prefill_paged, 3)`): mark what the
-                # callee RETURNS, and look for function-valued args
-                callee_defs = []
-                if isinstance(expr.func, ast.Name):
-                    callee_defs = scope.lookup_defs(expr.func.id)
-                elif isinstance(expr.func, ast.Attribute):
-                    name = expr.func.attr
-                    callee_defs = list(root.lookup_defs(name))
-                    for val in attr_aliases.get(name, ()):
-                        if isinstance(val, ast.Name):
-                            callee_defs += scope.lookup_defs(val.id)
-                for fd in callee_defs:
-                    for inner_fn in _returned_defs(fd):
-                        mark(inner_fn, _Statics())
-                for a in expr.args:
-                    resolve(a, scope, statics, depth + 1)
-                return
+        def resolve(expr, scope, statics: Statics):
+            resolve_callables(expr, scope, index, statics, mark,
+                              seen_resolving)
 
-        def _returned_defs(fd):
-            """Nested defs that ``fd`` returns — the program-factory
-            shape (make_decode -> decode_chunk)."""
-            nested = {n.name: n for n in ast.walk(fd)
-                      if isinstance(n, (ast.FunctionDef,
-                                        ast.AsyncFunctionDef))
-                      and n is not fd}
-            out = []
-            for n in ast.walk(fd):
-                if isinstance(n, ast.Return) \
-                        and isinstance(n.value, ast.Name) \
-                        and n.value.id in nested:
-                    out.append(nested[n.value.id])
-            return out
-
-        # pass 2: find tracing call sites + decorated defs
+        # find tracing call sites + decorated defs
         def scan_sites(node, scope):
             for child in ast.iter_child_nodes(node):
-                inner = scopes.get(id(child))
+                inner = index.scopes.get(id(child))
                 nscope = inner if inner is not None else scope
                 if isinstance(child, (ast.FunctionDef,
                                       ast.AsyncFunctionDef)):
@@ -304,27 +101,27 @@ class HostSyncChecker(Checker):
                             t = call_target(d)
                             if t in TRACE_WRAPPERS:
                                 # @jit(static_argnums=...) / @shard_map(...)
-                                mark(child, _jit_statics(d))
+                                mark(child, jit_statics(d))
                             elif t in PARTIAL_NAMES and d.args and (
-                                    _last_name(d.args[0])
+                                    last_name(d.args[0])
                                     in TRACE_WRAPPERS):
                                 # @partial(jax.jit, static_argnums=...)
-                                mark(child, _jit_statics(d))
-                        elif _last_name(d) in TRACE_WRAPPERS:
+                                mark(child, jit_statics(d))
+                        elif last_name(d) in TRACE_WRAPPERS:
                             # bare @jit / @jax.jit
-                            mark(child, _Statics())
+                            mark(child, Statics())
                     if child.name in config.TRACED_EXTRA_NAMES:
-                        mark(child, _Statics())
+                        mark(child, Statics())
                 if isinstance(child, ast.Call):
                     target = call_target(child)
                     if target in TRACE_WRAPPERS and child.args:
-                        st = _jit_statics(child) if target == "jit" \
-                            else _Statics()
+                        st = jit_statics(child) if target == "jit" \
+                            else Statics()
                         resolve(child.args[0], nscope, st)
                     elif target in CONTROL_HOFS:
                         for a in child.args:
                             if isinstance(a, (ast.Name, ast.Lambda)):
-                                resolve(a, nscope, _Statics())
+                                resolve(a, nscope, Statics())
                 scan_sites(child, nscope)
 
         scan_sites(src.tree, root)
@@ -338,10 +135,10 @@ class HostSyncChecker(Checker):
                         and id(n) not in traced:
                     traced[id(n)] = (n, set())
 
-        # pass 3: scan each traced function body for host syncs
+        # scan each traced function body for host syncs
         reported: set = set()
         for fn, statics in traced.values():
-            dyn = set(_param_names(fn)) - set(statics)
+            dyn = set(param_names(fn)) - set(statics)
             if not dyn:
                 continue
             fname = fn.name if not isinstance(fn, ast.Lambda) \

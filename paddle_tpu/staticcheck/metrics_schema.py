@@ -1,0 +1,256 @@
+"""SC08 metrics-schema: one registry-wide contract for every metric
+the stack exports. The fleet aggregates per-worker registries into one
+Prometheus exposition (``fleet_metrics.py``), which turns naming
+drift into RUNTIME failures: two modules registering the same name as
+different kinds makes ``prometheus_text`` raise; a counter without the
+``_total`` suffix breaks every downstream ``rate()`` query; a test
+asserting a metric name that no module registers passes vacuously
+forever once the metric is renamed.
+
+Project-wide checks (the registration inventory spans the whole scan
+set, which is why this is a call-graph-layer checker even though it
+never walks an edge):
+
+- **kind**: one ``name -> kind`` mapping across all modules
+  (registration sites are ``reg.counter/gauge/histogram("name", ...)``
+  and ``Counter/Gauge/Histogram("name")`` constructors);
+- **help drift**: one help string per name;
+- **suffix**: counters end ``_total``; non-counters must not;
+- **resolution**: every metric name ASSERTED in tests/bench — a
+  ``snap["counters"]["x_total"]`` kind-subscript, or ``metrics.get
+  ("x")`` on a registry-ish base — resolves to a real registration
+  (histogram aggregates ``_bucket``/``_count``/``_sum`` resolve to
+  their base histogram), and its asserted kind matches the registered
+  kind;
+- **labels**: label dicts (``labels=`` kwargs, ``add_labels({...})``)
+  use valid Prometheus label keys, never the reserved ``le``, and
+  ``add_labels`` never uses ``worker`` — the MetricsAggregator injects
+  that key per worker and collides with a user copy.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from . import config
+from .core import Checker, all_nodes, register
+from .util import call_target
+
+__all__ = ["MetricsSchemaChecker"]
+
+KIND_KEYS = {"counters": "counter", "gauges": "gauge",
+             "histograms": "histogram"}
+REG_METHODS = frozenset({"counter", "gauge", "histogram"})
+REG_CLASSES = {"Counter": "counter", "Gauge": "gauge",
+               "Histogram": "histogram"}
+#: bases whose ``.get("name")`` is a metric lookup (keeps
+#: ``event.get("cat")``-style dict reads out of the net)
+GET_BASES = frozenset({"metrics", "registry", "reg", "r"})
+HIST_SUFFIXES = ("_bucket", "_count", "_sum")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _sub_key(sub: ast.Subscript):
+    return _const_str(sub.slice)
+
+
+@register
+class MetricsSchemaChecker(Checker):
+    id = "SC08"
+    name = "metrics-schema"
+    description = ("metric kind/help/_total-suffix drift across "
+                   "modules, unresolvable asserted names, bad label "
+                   "keys")
+    project = True
+
+    def applies_to(self, src):
+        # asserted-name/label scanning also covers the serving test
+        # harnesses (the group SC04 gained in this PR)
+        return super().applies_to(src) or config.in_nondet_extra(src)
+
+    def check_project(self, graph, sources):
+        regs = []       # (name, kind, help, src, lineno)
+        for src in graph.sources:
+            regs.extend(self._registrations(src))
+
+        # schema discipline (kind conflicts, help drift, _total
+        # suffix) binds the SCAN SET — the registries the fleet
+        # aggregates. Tests may register throwaway local metrics, so
+        # their registrations only widen the RESOLUTION set below.
+        yield from self._schema_findings(regs)
+
+        all_regs = list(regs)
+        in_graph = {id(s) for s in graph.sources}
+        for src in sources:
+            if id(src) not in in_graph and self.applies_to(src):
+                all_regs.extend(self._registrations(src))
+        reg_names = {r[0] for r in all_regs}
+        hist_names = {r[0] for r in all_regs if r[1] == "histogram"}
+        kinds = {}
+        for name, kind, _h, _s, _l in all_regs:
+            kinds.setdefault(name, kind)
+
+        seen_labels: set = set()
+        for src in sources:
+            if not self.applies_to(src):
+                continue
+            for name, want, asrc, line in self._asserted(src):
+                if name in reg_names:
+                    got = kinds[name]
+                    if want is not None and want != got:
+                        yield self.finding(
+                            asrc, line,
+                            f"metric {name!r} asserted as {want} but "
+                            f"registered as {got}")
+                    continue
+                base = next(
+                    (name[:-len(sfx)] for sfx in HIST_SUFFIXES
+                     if name.endswith(sfx)
+                     and name[:-len(sfx)] in hist_names), None)
+                if base is not None:
+                    continue        # histogram aggregate series
+                yield self.finding(
+                    asrc, line,
+                    f"asserted metric name {name!r} resolves to no "
+                    f"registration in the scan set — the assertion "
+                    f"is (or will become) vacuous")
+            yield from self._label_findings(src, seen_labels)
+
+    # -- registrations -------------------------------------------------------
+
+    def _registrations(self, src):
+        out = []
+        for node in all_nodes(src):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = None
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in REG_METHODS:
+                kind = node.func.attr
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in REG_CLASSES:
+                kind = REG_CLASSES[node.func.id]
+            if kind is None or not node.args:
+                continue
+            name = _const_str(node.args[0])
+            if name is None:
+                continue
+            help_ = _const_str(node.args[1]) if len(node.args) > 1 \
+                else None
+            out.append((name, kind, help_, src, node.lineno))
+        return out
+
+    def _schema_findings(self, regs):
+        by_name: dict = {}
+        for reg in sorted(regs, key=lambda r: (r[3].rel, r[4])):
+            by_name.setdefault(reg[0], []).append(reg)
+        for name in sorted(by_name):
+            sites = by_name[name]
+            first = sites[0]
+            for nm, kind, help_, src, line in sites:
+                if kind == "counter" and not nm.endswith("_total"):
+                    yield self.finding(
+                        src, line,
+                        f"counter {nm!r} must end '_total' "
+                        f"(prometheus counter convention — rate() "
+                        f"queries key on the suffix)")
+                if kind != "counter" and nm.endswith("_total"):
+                    yield self.finding(
+                        src, line,
+                        f"{kind} {nm!r} must not end '_total' — the "
+                        f"suffix marks counters")
+                if kind != first[1]:
+                    yield self.finding(
+                        src, line,
+                        f"metric {nm!r} registered as {kind} here but "
+                        f"as {first[1]} at {first[3].rel}:{first[4]} — "
+                        f"the fleet aggregator raises on kind "
+                        f"conflicts")
+                if help_ is not None and first[2] is not None \
+                        and help_ != first[2]:
+                    yield self.finding(
+                        src, line,
+                        f"metric {nm!r} help text drifts from "
+                        f"{first[3].rel}:{first[4]} "
+                        f"({help_!r} != {first[2]!r})")
+
+    # -- asserted names ------------------------------------------------------
+
+    def _asserted(self, src):
+        """(name, expected_kind_or_None, src, line) for every metric
+        name a test/bench reads out of a snapshot or registry."""
+        for node in all_nodes(src):
+            if isinstance(node, ast.Subscript):
+                name = _sub_key(node)
+                if name is None or name in KIND_KEYS:
+                    continue
+                inner = node.value
+                if isinstance(inner, ast.Subscript):
+                    key = _sub_key(inner)
+                    if key in KIND_KEYS:
+                        yield name, KIND_KEYS[key], src, node.lineno
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get" and node.args:
+                name = _const_str(node.args[0])
+                if name is None:
+                    continue
+                base = node.func.value
+                if isinstance(base, ast.Subscript) \
+                        and _sub_key(base) in KIND_KEYS:
+                    yield (name, KIND_KEYS[_sub_key(base)], src,
+                           node.lineno)
+                elif isinstance(base, ast.Name) \
+                        and base.id in GET_BASES:
+                    yield name, None, src, node.lineno
+                elif isinstance(base, ast.Attribute) \
+                        and base.attr in GET_BASES:
+                    yield name, None, src, node.lineno
+
+    # -- labels --------------------------------------------------------------
+
+    def _label_findings(self, src, seen):
+        for node in all_nodes(src):
+            if not isinstance(node, ast.Call):
+                continue
+            dicts = []
+            for kw in node.keywords:
+                if kw.arg == "labels" and isinstance(kw.value, ast.Dict):
+                    dicts.append((kw.value, False))
+            if call_target(node) == "add_labels" and node.args \
+                    and isinstance(node.args[0], ast.Dict):
+                dicts.append((node.args[0], True))
+            for d, is_add in dicts:
+                for k in d.keys:
+                    key = _const_str(k)
+                    if key is None:
+                        continue
+                    dedup = (src.rel, k.lineno, key)
+                    if dedup in seen:
+                        continue
+                    if not _LABEL_RE.match(key):
+                        seen.add(dedup)
+                        yield self.finding(
+                            src, k.lineno,
+                            f"label key {key!r} is not a valid "
+                            f"prometheus label name")
+                    elif key == "le":
+                        seen.add(dedup)
+                        yield self.finding(
+                            src, k.lineno,
+                            f"label key 'le' is reserved for "
+                            f"histogram buckets")
+                    elif is_add and key == "worker":
+                        seen.add(dedup)
+                        yield self.finding(
+                            src, k.lineno,
+                            f"add_labels must not set 'worker' — the "
+                            f"fleet aggregator injects it per worker "
+                            f"and collides with a user copy")

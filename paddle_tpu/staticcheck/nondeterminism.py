@@ -30,7 +30,8 @@ from __future__ import annotations
 
 import ast
 
-from .core import Checker, register
+from . import config
+from .core import Checker, all_nodes, register
 from .util import call_target, name_parts
 
 __all__ = ["UnseededRandomChecker"]
@@ -74,8 +75,13 @@ class UnseededRandomChecker(Checker):
     description = ("global-RNG call or set-order-dependent iteration "
                    "breaking seeded bit-for-bit replay")
 
+    def applies_to(self, src):
+        # ISSUE 12 satellite: the serving test harnesses promise the
+        # same seeded bit-for-bit replay the stack does
+        return super().applies_to(src) or config.in_nondet_extra(src)
+
     def check(self, src):
-        for node in ast.walk(src.tree):
+        for node in all_nodes(src):
             if isinstance(node, ast.Call):
                 mod = _rng_module(node)
                 if mod is not None:

@@ -1,0 +1,343 @@
+"""SC06 recompile-hazard: compiled-program cache keys must be drawn
+from a FINITE domain. The serving engine keys its program caches
+(``_decode_progs``/``_prefix_progs``/``_verify_progs``) and its jit
+shapes on bucketed sizes — ``_bucket_window``/``_bucket_len`` map an
+arbitrary request-derived int onto powers-of-two — so the number of
+distinct compilations is bounded. An UNbucketed request-derived int
+(``len(tokens)``, ``.shape`` unpacking, arithmetic on either) that
+reaches a compile-relevant position recompiles once per distinct
+value: the classic silent TPU serving regression, ~seconds of XLA
+compile on the hot path per new length.
+
+Three sink shapes, found by per-function taint tracking:
+
+1. a tainted int passed to a **program factory** — a file-local
+   function whose body both calls a trace wrapper (``jit`` /
+   ``pallas_call`` / ``shard_map``) and returns a value (the
+   ``_decode_for(n)`` shape). The factory's argument IS the cache key.
+2. a tainted int passed at a ``static_argnums`` index (or as a
+   ``static_argnames`` keyword) of a name bound to ``jit(...,
+   static_*)`` — static args are hashed into the compile cache key.
+3. an array whose CONSTRUCTOR SHAPE was tainted (``np.zeros((n, k))``)
+   passed to a jit-bound name or factory product — every distinct
+   shape is a distinct compilation.
+
+Taint sources are ``len(...)`` calls and ``.size``/``.shape``
+attribute reads; a value that passed through a
+:data:`~paddle_tpu.staticcheck.config.BUCKET_HELPERS` call is
+sanctioned (the helpers' whole point is collapsing the domain). The
+walk is statement-linear per function with strong updates — an
+assignment of a clean value un-taints the name — which is the same
+over/under-approximation trade SC03 makes: fixtures define the
+contract, the scan set stays clean by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import config
+from .callgraph import TRACE_WRAPPERS, jit_statics
+from .core import Checker, all_nodes, register
+from .util import call_target, name_parts
+
+__all__ = ["RecompileHazardChecker"]
+
+#: array constructors whose first argument is a SHAPE
+ARRAY_CTORS = frozenset({"zeros", "ones", "full", "empty"})
+ARRAY_BASES = frozenset({"np", "numpy", "onp", "_np", "jnp", "jax"})
+#: wrappers that preserve the wrapped array's shape
+SHAPE_WRAPPERS = frozenset({"asarray", "array"})
+
+
+def _is_source(n) -> bool:
+    """``len(...)`` / ``x.size`` / ``x.shape`` — a request-derived
+    Python int (or tuple of them) materializing."""
+    if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+            and n.func.id == "len":
+        return True
+    return isinstance(n, ast.Attribute) and n.attr in ("size", "shape")
+
+
+def _tainted(expr, tainted) -> bool:
+    """True when ``expr`` carries request-derived size information:
+    it contains a source, or a Load of a tainted name — except inside
+    a bucket-helper call, which sanitizes its whole subtree."""
+    found = False
+
+    def visit(n):
+        nonlocal found
+        if found:
+            return
+        if isinstance(n, ast.Call) \
+                and call_target(n) in config.BUCKET_HELPERS:
+            return                  # sanitized: do not descend
+        if isinstance(n, ast.Call):
+            parts = name_parts(n.func)
+            if len(parts) > 1 and parts[0] in ARRAY_BASES | {"lax"}:
+                # np./jnp./lax. ops RETURN ARRAYS — an array built
+                # from a tainted int is not itself a Python-int cache
+                # key (array-shape hazards are tracked separately via
+                # _shaped_line)
+                return
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return                  # closures are scanned on their own
+        if _is_source(n):
+            found = True
+            return
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id in tainted:
+            found = True
+            return
+        for c in ast.iter_child_nodes(n):
+            visit(c)
+
+    visit(expr)
+    return found
+
+
+@register
+class RecompileHazardChecker(Checker):
+    id = "SC06"
+    name = "recompile-hazard"
+    description = ("unbucketed request-derived int reaches a jit "
+                   "compile-cache key (factory arg, static_argnums, "
+                   "or array shape)")
+
+    def check(self, src):
+        factories = self._factories(src)
+        bound, statics = self._jit_bindings(src, factories)
+        owners = [src.tree] + [
+            n for n in all_nodes(src)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        reported: set = set()
+        for owner in owners:
+            body = owner.body
+            yield from self._scan_body(
+                src, body, set(), {}, factories, bound, statics,
+                reported)
+
+    # -- file pre-pass -------------------------------------------------------
+
+    def _factories(self, src) -> set:
+        """Names of file-local program factories: a def whose body
+        calls a trace wrapper AND returns a value (``_decode_for``,
+        ``_make_decode``). Single pass — a trace call/return marks
+        every enclosing def, matching the old per-def ``ast.walk``
+        semantics without the O(n²) rescans."""
+        has_trace: set = set()
+        has_ret: set = set()
+        out: set = set()
+
+        def visit(node, stack):
+            for c in ast.iter_child_nodes(node):
+                if isinstance(c, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    visit(c, stack + [c])
+                    continue
+                if isinstance(c, ast.Call) \
+                        and call_target(c) in TRACE_WRAPPERS:
+                    has_trace.update(id(f) for f in stack)
+                elif isinstance(c, ast.Return) and c.value is not None:
+                    has_ret.update(id(f) for f in stack)
+                visit(c, stack)
+
+        visit(src.tree, [])
+        for node in all_nodes(src):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                    and id(node) in has_trace and id(node) in has_ret:
+                out.add(node.name)
+        return out
+
+    def _jit_bindings(self, src, factories):
+        """Names (locals or ``self.X`` attrs) bound to a trace-wrapped
+        callable or a factory product, plus the Statics of any
+        ``jit(..., static_*)`` binding."""
+        bound: set = set()
+        statics: dict = {}
+        for node in all_nodes(src):
+            if not isinstance(node, ast.Assign) \
+                    or len(node.targets) != 1 \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            t = node.targets[0]
+            name = t.attr if isinstance(t, ast.Attribute) else (
+                t.id if isinstance(t, ast.Name) else None)
+            if name is None:
+                continue
+            tgt = call_target(node.value)
+            if tgt in TRACE_WRAPPERS:
+                bound.add(name)
+                if tgt == "jit":
+                    st = jit_statics(node.value)
+                    if st.indices or st.names:
+                        statics[name] = st
+            elif tgt in factories:
+                bound.add(name)
+        return bound, statics
+
+    # -- per-function linear walk --------------------------------------------
+
+    _COMPOUND = (ast.If, ast.While, ast.For, ast.AsyncFor, ast.With,
+                 ast.AsyncWith, ast.Try)
+
+    def _scan_body(self, src, body, tainted, tshape, factories, bound,
+                   statics, reported):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue        # scanned as their own owner
+            if isinstance(stmt, self._COMPOUND):
+                # headers only, then bodies in order — walking the
+                # whole compound subtree here would check nested sinks
+                # against the PRE-branch taint state
+                for h in self._headers(stmt):
+                    yield from self._sinks_in(
+                        src, h, tainted, tshape, factories, bound,
+                        statics, reported)
+                if isinstance(stmt, (ast.For, ast.AsyncFor)) \
+                        and isinstance(stmt.target, ast.Name) \
+                        and _tainted(stmt.iter, tainted):
+                    # `for n in lens:` — iterating a tainted
+                    # collection taints the loop variable
+                    tainted.add(stmt.target.id)
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        yield from self._scan_body(
+                            src, sub, tainted, tshape, factories,
+                            bound, statics, reported)
+                for h in getattr(stmt, "handlers", ()):
+                    yield from self._scan_body(
+                        src, h.body, tainted, tshape, factories,
+                        bound, statics, reported)
+                continue
+            yield from self._sinks_in(
+                src, stmt, tainted, tshape, factories, bound, statics,
+                reported)
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                self._assign(stmt, tainted, tshape)
+            elif isinstance(stmt, ast.AugAssign):
+                t = stmt.target
+                if isinstance(t, ast.Name) \
+                        and _tainted(stmt.value, tainted):
+                    tainted.add(t.id)
+
+    def _headers(self, stmt):
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return [stmt.iter]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return list(stmt.items)
+        return []
+
+    def _assign(self, stmt, tainted, tshape):
+        val = stmt.value
+        if val is None:
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        names = []
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    e = e.value if isinstance(e, ast.Starred) else e
+                    if isinstance(e, ast.Name):
+                        names.append(e.id)
+        is_t = _tainted(val, tainted)
+        for name in names:
+            if is_t:
+                tainted.add(name)
+            else:
+                tainted.discard(name)       # strong update
+            tshape.pop(name, None)
+        if len(names) == 1:
+            line = self._shaped_line(val, tainted, tshape)
+            if line:
+                tshape[names[0]] = line
+                tainted.discard(names[0])   # the ARRAY is not an int
+
+    def _shaped_line(self, val, tainted, tshape):
+        """Construction line when ``val`` builds an array whose SHAPE
+        is tainted, else None."""
+        if not isinstance(val, ast.Call):
+            return None
+        tgt = call_target(val)
+        if tgt in ARRAY_CTORS and isinstance(val.func, ast.Attribute) \
+                and isinstance(val.func.value, ast.Name) \
+                and val.func.value.id in ARRAY_BASES:
+            if val.args and _tainted(val.args[0], tainted):
+                return val.lineno
+        if tgt in SHAPE_WRAPPERS and val.args \
+                and isinstance(val.args[0], ast.Name) \
+                and val.args[0].id in tshape:
+            return tshape[val.args[0].id]
+        return None
+
+    # -- sinks ---------------------------------------------------------------
+
+    def _sinks_in(self, src, stmt, tainted, tshape, factories, bound,
+                  statics, reported):
+        helpers = ", ".join(sorted(config.BUCKET_HELPERS))
+        for call in ast.walk(stmt):
+            if not isinstance(call, ast.Call):
+                continue
+            name = call_target(call)
+            if name is None:
+                continue
+            if name in factories:
+                for a in list(call.args) + [kw.value for kw in
+                                            call.keywords]:
+                    if _tainted(a, tainted):
+                        key = (call.lineno, "factory")
+                        if key not in reported:
+                            reported.add(key)
+                            yield self.finding(
+                                src, call.lineno,
+                                f"unbucketed request-derived int "
+                                f"reaches program factory {name!r} — "
+                                f"the compiled-program cache is keyed "
+                                f"on an unbounded domain; pass it "
+                                f"through {helpers} first")
+                        break
+            if name in statics:
+                st = statics[name]
+                hit = any(
+                    i in st.indices and _tainted(a, tainted)
+                    for i, a in enumerate(call.args)) or any(
+                    kw.arg in st.names and _tainted(kw.value, tainted)
+                    for kw in call.keywords)
+                if hit:
+                    key = (call.lineno, "static")
+                    if key not in reported:
+                        reported.add(key)
+                        yield self.finding(
+                            src, call.lineno,
+                            f"unbucketed request-derived int at a "
+                            f"static_argnums/static_argnames position "
+                            f"of jitted {name!r} — each distinct "
+                            f"value recompiles; bucket it with "
+                            f"{helpers}")
+            if name in bound:
+                for a in call.args:
+                    a = a.value if isinstance(a, ast.Starred) else a
+                    shaped = (isinstance(a, ast.Name)
+                              and a.id in tshape) or \
+                        self._shaped_line(a, tainted, tshape)
+                    if shaped:
+                        key = (call.lineno, "shape")
+                        if key not in reported:
+                            reported.add(key)
+                            yield self.finding(
+                                src, call.lineno,
+                                f"array shaped by an unbucketed "
+                                f"request-derived int reaches jitted "
+                                f"{name!r} — every distinct shape is "
+                                f"a fresh XLA compile; bucket the dim "
+                                f"with {helpers}")
+                        break

@@ -1,0 +1,105 @@
+"""SC07 blocking-call-on-step-path: the serving step
+(``ServingFleet.step`` -> admit/decode_once -> telemetry tick) is the
+latency budget every SLO in bench.py is written against; one blocking
+primitive anywhere in its call graph — a ``time.sleep``, a file
+``open``, a socket/HTTP round trip, ``subprocess``, a ``json.dump`` —
+stalls EVERY in-flight request for the duration, and none of it shows
+up in a per-file lint because the call is always three frames away.
+
+This is the first checker on the ISSUE 12 call-graph layer: walk
+every function reachable from :data:`~paddle_tpu.staticcheck.config
+.STEP_PATH_ROOTS` (BFS over :class:`~paddle_tpu.staticcheck.callgraph
+.CallGraph`, deliberately over-approximated so dynamic dispatch can't
+hide an edge) and flag blocking primitives lexically inside them.
+
+The ONE sanctioned egress is the annotated io-boundary: a ``def`` line
+carrying ``# staticcheck: io-boundary`` (the telemetry sinks' ``emit``
+— batched, bounded, and explicitly the place where bytes leave the
+process). The traversal CUTS there: the function is neither scanned
+nor expanded, so IO behind the boundary stays invisible by contract
+rather than by luck. Findings carry the root-to-function call chain so
+the report reads as the path a request would actually take.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import config
+from .core import Checker, register
+from .util import name_parts
+
+__all__ = ["StepPathBlockingChecker"]
+
+#: module roots any dotted call into which blocks on the network
+_NET_ROOTS = frozenset({"subprocess", "socket", "requests", "httpx",
+                        "urllib"})
+
+
+def _classify(call: ast.Call, imports: dict):
+    """The blocking primitive a call is, or None. ``imports`` is the
+    file's ``from x import y`` map (bare ``sleep`` only counts when it
+    came from ``time``)."""
+    parts = name_parts(call.func)
+    if not parts:
+        return None
+    if parts == ["open"]:
+        return "open"
+    if parts == ["time", "sleep"]:
+        return "time.sleep"
+    if parts == ["sleep"] and imports.get("sleep", ("",))[0] == "time":
+        return "time.sleep"
+    if parts[0] == "json" and parts[-1] == "dump":
+        return "json.dump"
+    if parts[0] == "os" and parts[-1] in ("system", "popen"):
+        return ".".join(parts)
+    if parts[0] in _NET_ROOTS:
+        return ".".join(parts)
+    if parts == ["urlopen"] and imports.get(
+            "urlopen", ("",))[0].startswith("urllib"):
+        return "urlopen"
+    return None
+
+
+@register
+class StepPathBlockingChecker(Checker):
+    id = "SC07"
+    name = "blocking-call-on-step-path"
+    description = ("blocking primitive (sleep/open/socket/subprocess/"
+                   "json.dump) reachable from the serving step")
+    project = True
+
+    def check_project(self, graph, sources):
+        reported: set = set()
+        for root in config.STEP_PATH_ROOTS:
+            for info, chain in graph.paths_from(
+                    root, cut=graph.is_io_boundary):
+                for line, prim in self._blocking_calls(graph, info):
+                    key = (info.src.rel, line, prim)
+                    if key in reported:
+                        continue            # first root's chain wins
+                    reported.add(key)
+                    yield self.finding(
+                        info.src, line,
+                        f"blocking `{prim}` on the serving step path "
+                        f"({' -> '.join(chain)}) — move it off-path or "
+                        f"annotate the sanctioned egress def with "
+                        f"'# staticcheck: io-boundary'")
+
+    def _blocking_calls(self, graph, info):
+        imports = graph._imports.get(info.src.rel, {})
+        out = []
+
+        def visit(n):
+            for c in ast.iter_child_nodes(n):
+                if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue    # nested defs are their own graph nodes
+                if isinstance(c, ast.Call):
+                    prim = _classify(c, imports)
+                    if prim:
+                        out.append((c.lineno, prim))
+                visit(c)
+
+        visit(info.node)
+        return out

@@ -99,8 +99,9 @@ def main():
     model = dist.DataParallel(nn.Linear(4, 2))
     opt = paddle.optimizer.SGD(learning_rate=0.1,
                                parameters=model.parameters())
-    np.random.seed(100 + rank)  # different data per rank
-    x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    np.random.seed(100 + rank)  # different data per rank  # staticcheck: disable=SC04
+    x = paddle.to_tensor(  # stream seeded above
+        np.random.randn(8, 4).astype(np.float32))  # staticcheck: disable=SC04
     loss = (model(x) ** 2).mean()
     loss.backward()
     opt.step()
@@ -118,9 +119,11 @@ def main():
     m2 = dist.DataParallel(nn.Linear(4, 2))
     opt2 = paddle.optimizer.SGD(learning_rate=0.1,
                                 parameters=m2.parameters())
-    np.random.seed(200 + rank)
-    xa = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
-    xb = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+    np.random.seed(200 + rank)  # staticcheck: disable=SC04 — per-rank fixture data
+    xa = paddle.to_tensor(  # stream seeded above
+        np.random.randn(4, 4).astype(np.float32))  # staticcheck: disable=SC04
+    xb = paddle.to_tensor(  # stream seeded above
+        np.random.randn(4, 4).astype(np.float32))  # staticcheck: disable=SC04
     with m2.no_sync():
         (m2(xa) ** 2).mean().backward()
     (m2(xb) ** 2).mean().backward()

@@ -8,6 +8,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -387,11 +388,26 @@ def test_chaos_preset_cpu_smoke(tmp_path):
 
 
 def test_staticcheck_cli_clean_in_process(capsys):
-    """graftcheck (ISSUE 11) gates the tree this bench drives —
+    """graftcheck (ISSUE 11 + 12) gates the tree this bench drives —
     bench.py itself is in the scan set. In-process like the probe
-    tests above (no subprocess spawn): the CLI must exit 0 at HEAD."""
+    tests above (no subprocess spawn): the CLI must exit 0 at HEAD,
+    and the nine-checker run (per-file passes + the shared call
+    graph) must stay inside its CI latency budget — the parse/graph
+    caches are what keep interprocedural analysis from turning the
+    gate into the slowest job in the pipeline."""
     from paddle_tpu.staticcheck.__main__ import main
+    t0 = time.perf_counter()
     assert main([]) == 0
+    elapsed = time.perf_counter() - t0
+    assert "0 findings" in capsys.readouterr().out
+    assert elapsed < 3.0, (
+        f"nine-checker staticcheck run took {elapsed:.2f}s — the "
+        f"parse-once/graph-once caches have regressed")
+    # the ISSUE 12 CLI surface: CI annotation format (clean tree ->
+    # zero annotation lines) and SC range syntax both run end to end
+    assert main(["--format=github"]) == 0
+    assert capsys.readouterr().out == ""
+    assert main(["--checkers", "SC06-SC09"]) == 0
     assert "0 findings" in capsys.readouterr().out
 
 

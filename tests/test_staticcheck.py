@@ -1,10 +1,11 @@
-"""graftcheck framework tests (ISSUE 11 tentpole): per-checker
-positive/negative fixtures driven through embedded source strings (no
-temp files — ``SourceFile.from_source`` parses in memory), suppression
-and unused-suppression behavior, CLI ``--json`` shape, byte-equivalence
-of the SC01/SC02 ports against inline reimplementations of the
-pre-framework lints, and the zero-findings gate over the real scan set
-at HEAD.
+"""graftcheck framework tests (ISSUE 11 tentpole + ISSUE 12 call-graph
+layer): per-checker positive/negative fixtures driven through embedded
+source strings (no temp files — ``SourceFile.from_source`` parses in
+memory), suppression and unused-suppression behavior, CLI ``--json`` /
+``--format=github`` shape, byte-equivalence of the SC01/SC02 ports
+against inline reimplementations of the pre-framework lints, callgraph
+resolution/reachability units, and the zero-findings gate over the real
+scan set at HEAD.
 """
 
 import ast
@@ -12,16 +13,21 @@ import json
 
 import pytest
 
-from paddle_tpu.staticcheck import (AdhocTimerChecker, Finding,
+from paddle_tpu.staticcheck import (AdhocTimerChecker, CallGraph,
+                                    DonationDisciplineChecker, Finding,
                                     HostSyncChecker,
-                                    LockDisciplineChecker, SourceFile,
+                                    LockDisciplineChecker,
+                                    MetricsSchemaChecker,
+                                    RecompileHazardChecker, SourceFile,
                                     SilentExceptChecker,
+                                    StepPathBlockingChecker,
                                     UNUSED_SUPPRESSION_ID,
                                     UnseededRandomChecker,
                                     all_checker_classes, checker_by_id,
                                     run)
+from paddle_tpu.staticcheck.__main__ import expand_checker_ids
 from paddle_tpu.staticcheck.__main__ import main as cli_main
-from paddle_tpu.staticcheck import config, util
+from paddle_tpu.staticcheck import callgraph, config, host_sync, util
 
 pytestmark = pytest.mark.staticcheck
 
@@ -51,12 +57,18 @@ def test_finding_order_is_file_line_checker_message():
     assert fs[0].render() == "b.py:1: SC02 m"
 
 
-def test_registry_has_the_five_checkers():
+def test_registry_has_the_nine_checkers():
     ids = [c.id for c in all_checker_classes()]
-    assert ids == ["SC01", "SC02", "SC03", "SC04", "SC05"]
+    assert ids == ["SC01", "SC02", "SC03", "SC04", "SC05",
+                   "SC06", "SC07", "SC08", "SC09"]
     assert checker_by_id("SC03") is HostSyncChecker
+    assert checker_by_id("SC07") is StepPathBlockingChecker
     with pytest.raises(KeyError):
         checker_by_id("SC99")
+    # the interprocedural layer is explicit about which checkers ride
+    # the shared CallGraph
+    proj = {c.id for c in all_checker_classes() if c.project}
+    assert proj == {"SC07", "SC08"}
 
 
 def test_sourcefile_parses_comment_directives():
@@ -352,6 +364,392 @@ def test_sc05_no_annotations_no_findings():
     assert fs == []
 
 
+# -- callgraph: resolution, edges, reachability (ISSUE 12 tentpole) ---------
+
+CG_FIXTURE = """\
+import jax
+
+def make_decode(n):
+    def decode_chunk(state):
+        return state
+    return decode_chunk
+
+def helper(x):
+    return x
+
+class Engine:
+    def __init__(self):
+        self._make_decode = make_decode
+    def compile(self, n):
+        return jax.jit(self._make_decode(n))
+    def step(self, q):
+        self.tick()
+        return helper(q)
+    def tick(self):
+        pass
+
+def drive(e):
+    e.step(None)
+    w = Engine()
+    return w
+"""
+
+
+def _graph(text, name="g.py"):
+    return CallGraph([SourceFile.from_source(name, text)])
+
+
+def test_callgraph_symbol_table_and_lookup():
+    g = _graph(CG_FIXTURE)
+    displays = {i.display for i in g.functions.values()}
+    assert {"make_decode", "make_decode.decode_chunk", "helper",
+            "Engine.__init__", "Engine.compile", "Engine.step",
+            "Engine.tick", "drive"} <= displays
+    (step,) = g.lookup("Engine.step")
+    assert step.name == "step" and step.cls == "Engine"
+    # bare-name fallback for plain identifiers
+    assert [i.display for i in g.lookup("helper")] == ["helper"]
+
+
+def test_callgraph_edges_self_import_and_ctor():
+    g = _graph(CG_FIXTURE)
+
+    def targets(display):
+        (info,) = g.lookup(display)
+        return {g.functions[q].display for q in g.edges[info.qualname]}
+
+    # self.tick() binds to the OWN class's method; helper() lexically
+    assert targets("Engine.step") == {"Engine.tick", "helper"}
+    # attribute alias + factory: jit(self._make_decode(n)) resolves
+    # through the alias to the factory AND to the def it returns
+    assert {"make_decode", "make_decode.decode_chunk"} <= \
+        targets("Engine.compile")
+    # obj.m() over-approximates to every project fn named m, and
+    # Cls(...) adds the Cls.__init__ edge
+    assert {"Engine.step", "Engine.__init__"} <= targets("drive")
+
+
+def test_callgraph_reachability_and_paths():
+    g = _graph(CG_FIXTURE)
+    reach = {i.display for i in g.reachable_from("drive")}
+    assert {"drive", "Engine.step", "Engine.tick", "helper",
+            "Engine.__init__"} <= reach
+    chains = {info.display: chain
+              for info, chain in g.paths_from("drive")}
+    assert chains["drive"] == ("drive",)
+    assert chains["Engine.tick"] == \
+        ("drive", "Engine.step", "Engine.tick")
+    # a cut prunes the node AND everything only reachable through it
+    cut_reach = {i.display for i in g.reachable_from(
+        "drive", cut=lambda i: i.display == "Engine.step")}
+    assert "Engine.step" not in cut_reach
+    assert "Engine.tick" not in cut_reach
+
+
+def test_callgraph_callers_of():
+    g = _graph(CG_FIXTURE)
+    assert [i.display for i in g.callers_of("Engine.tick")] == \
+        ["Engine.step"]
+    assert "drive" in {i.display for i in g.callers_of("Engine.step")}
+
+
+def test_callgraph_import_edge_across_files():
+    a = SourceFile.from_source("pkg/alpha.py",
+                               "def shared_helper(x):\n    return x\n")
+    b = SourceFile.from_source("pkg/beta.py", (
+        "from pkg.alpha import shared_helper\n"
+        "def use(q):\n"
+        "    return shared_helper(q)\n"))
+    g = CallGraph([a, b])
+    (use,) = g.lookup("use")
+    assert [g.functions[q].display for q in g.edges[use.qualname]] == \
+        ["shared_helper"]
+
+
+def test_callgraph_is_deterministic():
+    def build():
+        srcs = [SourceFile.from_source("g.py", CG_FIXTURE),
+                SourceFile.from_source("pkg/alpha.py",
+                                       "def shared_helper(x):\n"
+                                       "    return x\n")]
+        return CallGraph(srcs)
+    g1, g2 = build(), build()
+    assert g1.edges == g2.edges
+    assert list(g1.functions) == list(g2.functions)
+    assert [i.qualname for i in g1.reachable_from("drive")] == \
+        [i.qualname for i in g2.reachable_from("drive")]
+
+
+def test_file_index_is_memoized_per_source():
+    src = SourceFile.from_source("m.py", "def f():\n    pass\n")
+    assert callgraph.file_index(src) is callgraph.file_index(src)
+
+
+def test_sc03_rides_the_hoisted_resolver():
+    """ISSUE 12 hoist regression: host_sync's resolver machinery IS
+    callgraph's (aliases kept for back-compat), and SC03's verdicts
+    over the real scan set are byte-identical run to run."""
+    assert host_sync._Statics is callgraph.Statics
+    assert host_sync._jit_statics is callgraph.jit_statics
+    assert host_sync._last_name is callgraph.last_name
+    assert host_sync._param_names is callgraph.param_names
+    res1 = run(sources=config.scan_paths(), checkers=[HostSyncChecker])
+    res2 = run(sources=config.scan_paths(), checkers=[HostSyncChecker])
+    assert res1.to_json() == res2.to_json()
+    assert res1.ok
+
+
+# -- SC06 recompile-hazard --------------------------------------------------
+
+SC06_FACTORY_PREFIX = """\
+import jax
+
+def _decode_for(n):
+    def dec(x):
+        return x
+    return jax.jit(dec)
+
+"""
+
+
+def test_sc06_tainted_factory_arg():
+    fs = _check(RecompileHazardChecker, SC06_FACTORY_PREFIX + (
+        "def handle(req):\n"
+        "    return _decode_for(len(req.tokens))\n"))
+    assert _lines(fs) == [9]
+    assert fs[0].checker_id == "SC06"
+    assert "_decode_for" in fs[0].message
+    assert "_bucket" in fs[0].message
+
+
+def test_sc06_bucket_helper_sanitizes():
+    fs = _check(RecompileHazardChecker, SC06_FACTORY_PREFIX + (
+        "def handle(self, req):\n"
+        "    w = self._bucket_window(len(req.tokens))\n"
+        "    return _decode_for(w)\n"))
+    assert fs == []
+
+
+def test_sc06_static_argnums_position():
+    fs = _check(RecompileHazardChecker, (
+        "import jax\n"
+        "def f(x, n):\n"
+        "    return x\n"
+        "g = jax.jit(f, static_argnums=(1,))\n"
+        "def step(toks):\n"
+        "    n = len(toks)\n"
+        "    return g(toks, n)\n"))
+    assert _lines(fs) == [7]
+    assert "static_argnums" in fs[0].message
+
+
+def test_sc06_tainted_array_shape():
+    fs = _check(RecompileHazardChecker, (
+        "import jax\n"
+        "import numpy as np\n"
+        "def f(x):\n"
+        "    return x\n"
+        "g = jax.jit(f)\n"
+        "def step(toks):\n"
+        "    buf = np.zeros((len(toks), 4))\n"
+        "    return g(buf)\n"))
+    assert _lines(fs) == [8]
+    assert "shape" in fs[0].message
+
+
+def test_sc06_strong_update_untaints():
+    fs = _check(RecompileHazardChecker, SC06_FACTORY_PREFIX + (
+        "def handle(req):\n"
+        "    n = len(req.tokens)\n"
+        "    n = 8\n"
+        "    return _decode_for(n)\n"))
+    assert fs == []
+
+
+def test_sc06_jnp_array_ops_do_not_carry_int_taint():
+    """jnp./lax. calls RETURN arrays — building a mask from len() is
+    not an int cache key (the llama.py false-positive class)."""
+    fs = _check(RecompileHazardChecker, SC06_FACTORY_PREFIX + (
+        "import jax.numpy as jnp\n"
+        "def handle(req):\n"
+        "    mask = jnp.less(jnp.arange(8), len(req.tokens))\n"
+        "    return _decode_for(mask)\n"))
+    assert fs == []
+
+
+# -- SC07 blocking-call-on-step-path ----------------------------------------
+
+def _sc07(text, name="fleet.py"):
+    src = SourceFile.from_source(name, text)
+    g = CallGraph([src])
+    return list(StepPathBlockingChecker().check_project(g, [src]))
+
+
+def test_sc07_sleep_reachable_from_step_root():
+    fs = _sc07(
+        "import time\n"
+        "class ServingFleet:\n"
+        "    def step(self):\n"
+        "        self._drain()\n"
+        "    def _drain(self):\n"
+        "        time.sleep(0.1)\n")
+    assert _lines(fs) == [6]
+    assert fs[0].checker_id == "SC07"
+    assert "time.sleep" in fs[0].message
+    assert "ServingFleet.step -> ServingFleet._drain" in fs[0].message
+
+
+def test_sc07_io_boundary_cuts_the_walk():
+    fs = _sc07(
+        "class ServingFleet:\n"
+        "    def step(self):\n"
+        "        self._emit()\n"
+        "    def _emit(self):  # staticcheck: io-boundary\n"
+        "        open('/tmp/x', 'w')\n")
+    assert fs == []
+
+
+def test_sc07_off_path_io_is_not_flagged():
+    fs = _sc07(
+        "import time\n"
+        "class ServingFleet:\n"
+        "    def step(self):\n"
+        "        pass\n"
+        "def maintenance():\n"
+        "    time.sleep(5)\n")
+    assert fs == []
+
+
+def test_sc07_imported_sleep_and_net_roots():
+    fs = _sc07(
+        "from time import sleep\n"
+        "import urllib.request\n"
+        "class DecodeEngine:\n"
+        "    def step(self):\n"
+        "        sleep(1)\n"
+        "        urllib.request.urlopen('http://x')\n")
+    assert _lines(fs) == [5, 6]
+    msgs = "\n".join(f.message for f in fs)
+    assert "time.sleep" in msgs and "urllib.request.urlopen" in msgs
+
+
+# -- SC08 metrics-schema ----------------------------------------------------
+
+def _sc08(text, name="metrics.py"):
+    src = SourceFile.from_source(name, text)
+    g = CallGraph([src])
+    return list(MetricsSchemaChecker().check_project(g, [src]))
+
+
+def test_sc08_counter_suffix_discipline():
+    fs = _sc08(
+        "r.counter('engine_steps', 'steps completed')\n"
+        "r.gauge('queue_total', 'queued requests')\n"
+        "r.counter('engine_retired_total', 'retired')\n")
+    assert _lines(fs) == [1, 2]
+    msgs = {f.line: f.message for f in fs}
+    assert "must end '_total'" in msgs[1]
+    assert "must not end '_total'" in msgs[2]
+
+
+def test_sc08_kind_conflict_and_help_drift():
+    fs = _sc08(
+        "r.counter('steps_total', 'steps')\n"
+        "q.gauge('steps_total', 'steps')\n"
+        "p.counter('steps_total', 'number of steps')\n")
+    msgs = "\n".join(f.message for f in fs)
+    assert "registered as gauge here but as counter" in msgs
+    assert "help text drifts" in msgs
+
+
+def test_sc08_asserted_names_resolve_and_kinds_match():
+    fs = _sc08(
+        "r.gauge('queue_depth', 'queued')\n"
+        "v = snap['counters']['queue_depth']\n"
+        "w = snap['counters']['engine_ticks_total']\n")
+    msgs = {f.line: f.message for f in fs}
+    assert "asserted as counter but registered as gauge" in msgs[2]
+    assert "resolves to no registration" in msgs[3]
+
+
+def test_sc08_histogram_aggregates_resolve_to_base():
+    fs = _sc08(
+        "r.histogram('step_latency', 'seconds per step')\n"
+        "b = snap['histograms'].get('step_latency')\n"
+        "c = snap['counters']['step_latency_count']\n")
+    assert fs == []
+
+
+def test_sc08_label_keys():
+    fs = _sc08(
+        "r.counter('drops_total', 'drops', labels={'le': '1'})\n"
+        "m.add_labels({'worker': 'w0'})\n"
+        "m.add_labels({'9bad': 'x'})\n")
+    msgs = {f.line: f.message for f in fs}
+    assert "reserved for" in msgs[1]
+    assert "must not set 'worker'" in msgs[2]
+    assert "not a valid" in msgs[3]
+
+
+# -- SC09 donation-discipline -----------------------------------------------
+
+def test_sc09_range_spec_must_start_at_the_vararg():
+    fs = _check(DonationDisciplineChecker, (
+        "import jax\n"
+        "def prog(a, b, *pool):\n"
+        "    return a\n"
+        "f = jax.jit(prog, donate_argnums=tuple(range(1, 3)))\n"))
+    assert _lines(fs) == [4]
+    assert "matches no resolved callee" in fs[0].message
+    assert "prog" in fs[0].message
+
+
+def test_sc09_range_spec_at_vararg_is_clean():
+    fs = _check(DonationDisciplineChecker, (
+        "import jax\n"
+        "def prog(a, b, *pool):\n"
+        "    return a\n"
+        "f = jax.jit(prog, donate_argnums=tuple(range(2, 2 + n)))\n"))
+    assert fs == []
+
+
+def test_sc09_explicit_index_off_the_arity():
+    fs = _check(DonationDisciplineChecker, (
+        "import jax\n"
+        "def prog(a, b):\n"
+        "    return a\n"
+        "f = jax.jit(prog, donate_argnums=(5,))\n"
+        "g = jax.jit(prog, donate_argnums=(1,))\n"))
+    assert _lines(fs) == [4]
+
+
+def test_sc09_use_after_donate():
+    fs = _check(DonationDisciplineChecker, (
+        "import jax\n"
+        "def prog(a, *pool):\n"
+        "    return a\n"
+        "f = jax.jit(prog, donate_argnums=tuple(range(1, 3)))\n"
+        "def step(x, pool):\n"
+        "    out = f(x, *pool)\n"
+        "    return pool\n"))
+    assert _lines(fs) == [7]
+    assert "read after being donated to 'f'" in fs[0].message
+
+
+def test_sc09_rebind_idiom_is_clean():
+    """The engine's own shape: the donated pool is rebound from the
+    call's result in the SAME statement."""
+    fs = _check(DonationDisciplineChecker, (
+        "import jax\n"
+        "def prog(a, *pool):\n"
+        "    return a\n"
+        "f = jax.jit(prog, donate_argnums=tuple(range(1, 3)))\n"
+        "def step(x, pool):\n"
+        "    out, *pool = f(x, *pool)\n"
+        "    return pool\n"))
+    assert fs == []
+
+
 # -- suppressions and SC00 --------------------------------------------------
 
 def test_suppression_silences_the_finding():
@@ -404,11 +802,14 @@ def test_inactive_checker_suppression_is_not_reported_stale():
 # -- the real tree ----------------------------------------------------------
 
 def test_scan_set_is_clean_at_head():
-    """The acceptance gate: every SC01–SC05 invariant holds over the
-    configured scan set, so the CLI exits 0 at HEAD."""
+    """The acceptance gate: every SC01–SC09 invariant holds over the
+    configured scan set (plus the SC04/SC08 test-harness group), so
+    the CLI exits 0 at HEAD."""
     res = run()
     assert res.ok, "\n".join(f.render() for f in res.findings)
-    assert res.files_scanned == len(config.scan_paths())
+    assert res.files_scanned == len(config.run_paths())
+    assert res.files_scanned == \
+        len(config.scan_paths()) + len(config.nondet_extra_paths())
 
 
 def test_report_is_deterministic():
@@ -567,16 +968,18 @@ def test_cli_json_shape(capsys):
     doc = json.loads(capsys.readouterr().out)
     assert doc["ok"] is True
     assert doc["findings"] == []
-    assert doc["files_scanned"] == len(config.scan_paths())
+    assert doc["files_scanned"] == len(config.run_paths())
     assert [c["id"] for c in doc["checkers"]] == \
-        ["SC01", "SC02", "SC03", "SC04", "SC05"]
+        ["SC01", "SC02", "SC03", "SC04", "SC05",
+         "SC06", "SC07", "SC08", "SC09"]
     assert all(set(c) == {"id", "name"} for c in doc["checkers"])
 
 
 def test_cli_list_catalog(capsys):
     assert cli_main(["--list"]) == 0
     out = capsys.readouterr().out
-    for cid in ("SC01", "SC02", "SC03", "SC04", "SC05"):
+    for cid in ("SC01", "SC02", "SC03", "SC04", "SC05",
+                "SC06", "SC07", "SC08", "SC09"):
         assert cid in out
 
 
@@ -594,10 +997,29 @@ _VIOLATIONS = {
              "        self._lock = object()\n"
              "    def get(self):\n"
              "        return self._m\n"),
+    "SC06": ("import jax\n"
+             "def _decode_for(n):\n"
+             "    def dec(x):\n"
+             "        return x\n"
+             "    return jax.jit(dec)\n"
+             "def handle(req):\n"
+             "    return _decode_for(len(req.tokens))\n"),
+    "SC07": ("import time\n"
+             "class ServingFleet:\n"
+             "    def step(self):\n"
+             "        self._drain()\n"
+             "    def _drain(self):\n"
+             "        time.sleep(0.1)\n"),
+    "SC08": "r.counter('engine_steps', 'steps completed')\n",
+    "SC09": ("import jax\n"
+             "def prog(a, b, *pool):\n"
+             "    return a\n"
+             "f = jax.jit(prog, donate_argnums=tuple(range(1, 3)))\n"),
 }
 
 _VIOLATION_LINES = {"SC01": 1, "SC02": 3, "SC03": 3, "SC04": 2,
-                    "SC05": 6}
+                    "SC05": 6, "SC06": 7, "SC07": 6, "SC08": 1,
+                    "SC09": 4}
 
 
 @pytest.mark.parametrize("cid", sorted(_VIOLATIONS))
@@ -622,3 +1044,37 @@ def test_cli_checker_subset(tmp_path, capsys):
     assert "SC01" in out and "SC04" not in out
     capsys.readouterr()
     assert cli_main([str(mod), "--checkers", "SC03"]) == 0
+
+
+def test_expand_checker_ids_range_syntax():
+    assert expand_checker_ids("SC01,SC06-SC09") == \
+        ["SC01", "SC06", "SC07", "SC08", "SC09"]
+    assert expand_checker_ids("SC06-09") == \
+        ["SC06", "SC07", "SC08", "SC09"]
+    assert expand_checker_ids("SC03") == ["SC03"]
+    with pytest.raises(ValueError):
+        expand_checker_ids("SC09-SC06")
+
+
+def test_cli_checker_range(tmp_path, capsys):
+    mod = tmp_path / "bad.py"
+    mod.write_text(_VIOLATIONS["SC09"])
+    assert cli_main([str(mod), "--checkers", "SC06-SC09"]) == 1
+    out = capsys.readouterr().out
+    assert "SC09" in out
+    capsys.readouterr()
+    # the SC01-SC05 slice does not see the donation hazard
+    assert cli_main([str(mod), "--checkers", "SC01-SC05"]) == 0
+
+
+def test_cli_github_format(tmp_path, capsys):
+    mod = tmp_path / "bad.py"
+    mod.write_text(_VIOLATIONS["SC04"])
+    assert cli_main([str(mod), "--format=github"]) == 1
+    out = capsys.readouterr().out
+    want = f"::error file={mod.resolve().as_posix()},line=2::SC04 "
+    assert want in out, f"missing {want!r} in:\n{out}"
+    capsys.readouterr()
+    # clean tree -> no annotation lines at all
+    assert cli_main(["--format=github"]) == 0
+    assert capsys.readouterr().out == ""
