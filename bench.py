@@ -377,6 +377,22 @@ def _dump_metrics_snapshot(eng, preset: str,
     return path
 
 
+def _dump_profile(preset: str, payload: dict) -> str | None:
+    """ISSUE 13 twin of :func:`_dump_metrics_snapshot`: write the
+    step-phase profiler / compile-observatory payload as
+    ``bench_profile_<preset>.json`` so a BENCH row links to the phase
+    breakdown behind its number. Same unwritable-directory contract."""
+    out_dir = os.environ.get("BENCH_METRICS_DIR", "log")
+    path = os.path.join(out_dir, f"bench_profile_{preset}.json")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+    except OSError:
+        return None
+    return path
+
+
 def bench_engine():
     """Continuous-batching serving throughput: staggered arrivals with
     mixed max_new through the paged DecodeEngine. tokens/s comes from
@@ -1011,6 +1027,11 @@ def bench_mixed():
         eng = DecodeEngine(
             model, capacity=4, s_max=s_max, chunk=chunk, block_size=bs,
             chunked_prefill=chunked,
+            # ISSUE 13: both modes profiled, so the phase breakdown is
+            # a fair comparison and the dumped profile explains where
+            # each mode's TTFT went (outputs stay bit-identical —
+            # regression-tested)
+            profile=True,
             # one page-chunk per idle lane: several chunks per step so
             # the budget shapes, not starves, the flood
             step_budget=(4 * chunk + 4 * bs) if chunked else None)
@@ -1049,6 +1070,12 @@ def bench_mixed():
     p99_mono = float(np.percentile(ttft_mono, 99)) * 1e3
     p99_ch = float(np.percentile(ttft_ch, 99)) * 1e3
     snap_path = _dump_metrics_snapshot(eng_ch, "mixed")
+    prof_path = _dump_profile("mixed", {
+        "admission": eng_mono.profile.summary(),
+        "chunked": eng_ch.profile.summary(),
+        "compiles": {"admission": eng_mono.compiles.stats(),
+                     "chunked": eng_ch.compiles.stats()},
+        "compile_log": eng_ch.compiles.compile_log()})
     print(json.dumps({
         "metric": "mixed_p99_ttft_ms",
         "value": round(p99_ch, 2),
@@ -1066,6 +1093,7 @@ def bench_mixed():
                       eng_ch.stats()["prefill_chunks"]),
                   "chunk_prog_windows": sorted(eng_ch._prefix_progs),
                   "metrics_snapshot": snap_path,
+                  "profile_snapshot": prof_path,
                   "backend": jax.default_backend()},
     }))
 
@@ -1333,13 +1361,13 @@ def bench_chaos():
     arrivals = gen.arrivals(10.0)
     dt, n_steps, n_workers = 0.25, 72, 3
 
-    def run_once(fault_seed):
+    def run_once(fault_seed, profile=False, pdir=None):
         vt = [0.0]
         fleet = ServingFleet(
             model, n_workers=n_workers, policy="round_robin",
             engine_kwargs=dict(capacity=2, s_max=s_max, chunk=chunk,
                                block_size=bs),
-            stall_s=1.0,
+            stall_s=1.0, profile=profile, postmortem_dir=pdir,
             restart=RestartPolicy(auto=True, backoff_base_s=0.5,
                                   backoff_max_s=4.0, probation_steps=2,
                                   clock=lambda: vt[0]))
@@ -1404,12 +1432,26 @@ def bench_chaos():
             episodes.append(cur)
         snap = fleet.aggregator().snapshot()
         final_healthy = sum(1 for w in fleet.workers if w.healthy)
+        prof = None
+        if profile:
+            # ISSUE 13: same payloads the live /statusz + /compilez
+            # endpoints serve, captured before close()
+            surf = fleet.debug_surface()
+            prof = {"statusz": surf["statusz"](),
+                    "compilez": surf["compilez"]()}
         fleet.close()
-        return sig, outs, episodes, final_healthy, snap
+        return sig, outs, episodes, final_healthy, snap, prof
 
-    sig_free, outs_free, _, _, _ = run_once(None)
-    sig_a, outs_a, episodes, healthy_end, snap = run_once(9)
-    sig_b, _, _, _, _ = run_once(9)
+    pdir = os.path.join(os.environ.get("BENCH_METRICS_DIR", "log"),
+                        "postmortems_chaos")
+    sig_free, outs_free, _, _, _, _ = run_once(None)
+    # only the measured chaos run is profiled + bundle-dumping; the
+    # repeat stays plain — the determinism signature carries no wall
+    # times, so sig_a == sig_b also certifies the observability stack
+    # didn't perturb the schedule
+    sig_a, outs_a, episodes, healthy_end, snap, prof = run_once(
+        9, profile=True, pdir=pdir)
+    sig_b, _, _, _, _, _ = run_once(9)
 
     both = sorted(set(outs_free) & set(outs_a))
     parity = all(np.array_equal(outs_free[i], outs_a[i]) for i in both)
@@ -1419,6 +1461,13 @@ def bench_chaos():
     for _, kind, _ in sig_a["fired"]:
         fired_mix[kind] = fired_mix.get(kind, 0) + 1
     snap_path = _dump_metrics_snapshot(None, "chaos", snapshot=snap)
+    try:
+        bundles = sorted(f for f in os.listdir(pdir)
+                         if f.startswith("postmortem_"))
+    except OSError:
+        bundles = []
+    prof["postmortems"] = bundles
+    prof_path = _dump_profile("chaos", prof)
     print(json.dumps({
         "metric": "chaos_goodput_ratio",
         "value": round(goodput, 4),
@@ -1438,7 +1487,9 @@ def bench_chaos():
                   "recovery_steps_max": max(episodes, default=0),
                   "recovery_episodes": episodes,
                   "virtual_window_s": round(n_steps * dt, 2),
+                  "postmortem_bundles": len(bundles),
                   "metrics_snapshot": snap_path,
+                  "profile_snapshot": prof_path,
                   "backend": jax.default_backend()},
     }))
 

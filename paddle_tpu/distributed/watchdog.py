@@ -141,13 +141,17 @@ class EngineStallWatchdog:
                  counter="engine_device_steps_total",
                  busy_gauges=("engine_batch_occupancy",
                               "engine_backlog"),
-                 on_stall=None):
+                 on_stall=None, recorder=None):
         self.registry = registry
         self.stall_s = float(stall_s)
         self.poll_s = float(poll_s)
         self.counter = counter
         self.busy_gauges = tuple(busy_gauges)
         self.on_stall = on_stall
+        # ISSUE 13: optional FlightRecorder — a fired stall lands in
+        # the flight ring BEFORE the fleet's failover machinery reacts,
+        # so the postmortem bundle shows the detection itself
+        self.recorder = recorder
         self.stalls: list[dict] = []
         self._last_value = None
         self._last_advance = None      # monotonic time of last movement
@@ -198,6 +202,10 @@ class EngineStallWatchdog:
                counter=self.counter, value=v,
                stalled_s=info["stalled_s"],
                backlog=backlog.value if backlog is not None else None)
+        if self.recorder is not None:
+            self.recorder.record("stall", counter=self.counter,
+                                 value=v,
+                                 stalled_s=info["stalled_s"])
         if self.on_stall is not None:
             # fleet hook: ServingFleet marks the worker unhealthy here
             # (fired once per episode, AFTER the snapshot dump above).

@@ -215,6 +215,15 @@ class FaultInjector:
                    duration=e.duration)
             log_event("chaos_fault", step=self.step_idx, kind=e.kind,
                       worker=wid)
+            # ISSUE 13: injected faults land in the flight ring, so a
+            # postmortem bundle shows the fault NEXT TO the failover /
+            # restart events it provoked (tests compare these against
+            # plan.signature())
+            rec = getattr(fleet, "flight", None)
+            if rec is not None:
+                rec.record("fault", step=self.step_idx, fault=e.kind,
+                           worker=wid, duration=e.duration,
+                           magnitude=e.magnitude)
             if e.kind == "worker_crash":
                 self._crash.add(wid)
             elif e.kind == "worker_hang":

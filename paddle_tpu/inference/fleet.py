@@ -80,8 +80,9 @@ from collections import deque
 
 from ..distributed.watchdog import EngineStallWatchdog
 from ..observability import MetricsRegistry, merge_snapshots
+from ..observability.flight import FlightRecorder, dump_postmortem
 from ..utils.log import get_logger, log_event, log_kv
-from .serving import DecodeEngine, _Request, _tmark
+from .serving import DecodeEngine, _Request, _phase, _tmark
 
 __all__ = ["GlobalPrefixDirectory", "NoHealthyWorkersError",
            "RequestPoisonedError", "RestartPolicy", "ServingFleet"]
@@ -287,7 +288,9 @@ class ServingFleet:
     def __init__(self, model, n_workers=2, policy="affinity",
                  load_penalty=None, engine_kwargs=None,
                  stall_s=30.0, registry=None, qos=None,
-                 max_retries=2, restart=None, tp_degree=None):
+                 max_retries=2, restart=None, tp_degree=None,
+                 profile=False, flight_capacity=512,
+                 postmortem_dir=None, postmortem_keep=16):
         if n_workers < 1:
             raise ValueError(f"n_workers={n_workers}")
         if policy not in ("affinity", "round_robin"):
@@ -373,6 +376,28 @@ class ServingFleet:
         self.chaos = None               # FaultInjector.install() hook
         self._degradation = 0
         self._deg_boost = 1.0           # set by enable_slo
+        # ISSUE 13: flight recorder + postmortem surface. The fleet
+        # ring is ALWAYS on — the r9-r14 failure machinery (failover,
+        # restart, poison, shed, injected faults) is worthless to
+        # debug without the events leading up to it — and per-worker
+        # rings mirror into it with a ``src`` tag. profile=True
+        # additionally threads a StepProfiler + CompileTracker into
+        # every worker engine and a router-side profiler for the
+        # schedule/telemetry phases; postmortem_dir arms automatic
+        # bundle dumps on stall, restart harvest, and poison
+        # quarantine.
+        self.profile = bool(profile)
+        self.postmortem_dir = postmortem_dir
+        self.postmortem_keep = int(postmortem_keep)
+        self.flight = FlightRecorder(capacity=int(flight_capacity),
+                                     name="fleet",
+                                     registry=self.metrics)
+        self._prof = None
+        if self.profile:
+            from ..observability.profiling import StepProfiler
+            self._prof = StepProfiler(registry=self.metrics,
+                                      recorder=self.flight,
+                                      worker_id="router")
         self.workers: list[_Worker] = []
         for i in range(n_workers):
             wid = f"w{i}"
@@ -417,15 +442,26 @@ class ServingFleet:
                 self.tp_degree,
                 devices=jax.devices()[i * self.tp_degree:
                                       (i + 1) * self.tp_degree])
+        rec = FlightRecorder(capacity=self.flight.capacity, name=wid,
+                             forward_to=self.flight, registry=reg)
         eng = DecodeEngine(
             self.model, registry=reg, worker_id=wid,
             prefix_listener=self.directory.listener(wid),
-            qos=self.qos, **kw)
+            qos=self.qos, profile=self.profile or None,
+            recorder=rec, **kw)
         wd = EngineStallWatchdog(
-            reg, stall_s=self._stall_s,
-            on_stall=lambda info, w=wid: self._mark_unhealthy(
-                w, "stall", info))
+            reg, stall_s=self._stall_s, recorder=rec,
+            on_stall=lambda info, w=wid: self._on_stall(w, info))
         return eng, reg, wd
+
+    def _on_stall(self, wid, info):
+        """Watchdog hook: flag the worker AND freeze the evidence —
+        the bundle written here is the state at detection, before the
+        next step's failover mutates it."""
+        flagged = self._mark_unhealthy(wid, "stall", info)
+        if flagged:
+            self.dump_postmortem(f"stall:{wid}")
+        return flagged
 
     # -- routing ------------------------------------------------------------
     def _healthy(self) -> list[_Worker]:
@@ -547,6 +583,8 @@ class ServingFleet:
                        worker=wid, reason=reason)
                 log_event("fleet_worker_unhealthy", worker=wid,
                           reason=reason)
+                self.flight.record("worker_unhealthy", worker=wid,
+                                   reason=reason)
                 return True
         return False
 
@@ -647,6 +685,15 @@ class ServingFleet:
                    parked=parked)
             log_event("fleet_failover", worker=w.wid,
                       rerouted=len(reqs))
+            self.flight.record("failover", worker=w.wid,
+                               reason=reason,
+                               rerouted=len(reqs) - parked,
+                               parked=parked)
+            # ISSUE 13: one bundle per drained worker — the flight ring
+            # at this point holds the fault/stall event next to the
+            # failover it provoked (dump_postmortem never takes the
+            # fleet lock, so calling it here under _lock is safe)
+            self.dump_postmortem(f"failover:{w.wid}:{reason}")
         return moved
 
     def _poison_request(self, req, reason: str, wid: str) -> None:
@@ -674,6 +721,10 @@ class ServingFleet:
                req=tr.request_id if tr is not None else None,
                reason=poison_reason)
         log_event("fleet_request_poisoned", worker=wid, retries=n)
+        self.flight.record(
+            "poisoned", worker=wid, retries=n,
+            req=tr.request_id if tr is not None else None)
+        self.dump_postmortem(f"poison:{wid}")
 
     def _park_locked(self, req, frm) -> None:
         req._parked_from = frm
@@ -760,6 +811,10 @@ class ServingFleet:
                probation=w.probation)
         log_event("fleet_worker_restarted", worker=wid,
                   restarts=w.restarts)
+        self.flight.record("worker_restarted", worker=wid,
+                           restarts=w.restarts,
+                           probation=w.probation)
+        self.dump_postmortem(f"restart:{wid}")
         self._unpark_locked()
         return w.restarts
 
@@ -836,6 +891,7 @@ class ServingFleet:
                count=len(victims), reason=reason,
                remaining=self.pending_work())
         log_event("fleet_shed", count=len(victims), reason=reason)
+        self.flight.record("shed", count=len(victims), reason=reason)
         return len(victims)
 
     def _shed_request(self, req, reason: str) -> None:
@@ -857,11 +913,21 @@ class ServingFleet:
         unhealthy, then admit + one decode chunk per healthy worker (a
         raising step fails the WORKER, not the fleet — its requests
         re-route on the spot). Returns live rows across the fleet."""
+        prof = self._prof
+        if prof is None:
+            return self._step_inner()
+        prof.begin_step()
+        try:
+            return self._step_inner()
+        finally:
+            prof.end_step()
+
+    def _step_inner(self) -> int:
         if self.chaos is not None:
             # deterministic fault injection (ISSUE 9): advance the
             # step-indexed schedule before anything else observes it
             self.chaos.begin_step(self)
-        with self._lock:
+        with _phase(self._prof, "schedule"), self._lock:
             if self._qos_gate is not None:
                 # buckets refilled since submit: route the released
                 # requests in arrival order before this step's admission
@@ -917,7 +983,8 @@ class ServingFleet:
             # O(1) between intervals and contains every sink fault, so
             # the serving path is unaffected (bit-identical outputs —
             # tested)
-            self.shipper.tick()
+            with _phase(self._prof, "telemetry"):
+                self.shipper.tick()
         return alive
 
     def pending_work(self) -> int:
@@ -1043,6 +1110,73 @@ class ServingFleet:
             + [w.legacy_snap for w in self.workers
                if w.legacy_snap is not None])
 
+    # -- postmortem bundles (ISSUE 13) ---------------------------------------
+    def dump_postmortem(self, reason="manual"):
+        """Write one postmortem bundle (flight ring, merged registry
+        snapshot, scheduler/worker state, last-N request traces,
+        per-worker compile logs, fleet config) into ``postmortem_dir``;
+        returns the path, or None when disabled or the dump failed.
+        Invoked automatically from the watchdog ``on_stall``, the
+        restart harvest, and poison quarantine; safe to call by hand.
+
+        MUST NOT take the fleet lock: the restart/poison triggers run
+        with it held, the stall trigger without — every read below is
+        either lock-free by design (worker registries lock themselves,
+        the trace deque only appends) or a point-in-time scalar where a
+        torn read costs nothing."""
+        if self.postmortem_dir is None:
+            return None
+        try:
+            traces = list(self._traces)[-64:]
+        except RuntimeError:            # deque mutated mid-copy: the
+            traces = []                 # bundle just loses its traces
+        compile_log = []
+        state_workers = {}
+        for w in self.workers:
+            ct = getattr(w.engine, "compiles", None)
+            if ct is not None:
+                compile_log.extend({**e, "worker": w.wid}
+                                   for e in ct.compile_log())
+            state_workers[w.wid] = {
+                "healthy": w.healthy, "fail_reason": w.fail_reason,
+                "restarts": w.restarts, "probation": w.probation,
+                "pending": len(w.pending),
+                "occupancy": w.occupancy,
+                "backlog": w.engine.backlog,
+            }
+        state = {"degradation": self._degradation,
+                 "load_penalty": self.load_penalty,
+                 "slo": self.slo.states() if self.slo is not None
+                 else None,
+                 "workers": state_workers}
+        if self._prof is not None:
+            state["router_profile"] = self._prof.summary()
+        config = {"n_workers": len(self.workers),
+                  "policy": self.policy,
+                  "tp_degree": self.tp_degree or 1,
+                  "max_retries": self.max_retries,
+                  "engine_kwargs": dict(self._engine_kw)}
+        return dump_postmortem(
+            self.postmortem_dir, reason=reason, recorder=self.flight,
+            registry=self.merged_snapshot(), traces=traces,
+            compile_log=compile_log, config=config, state=state,
+            keep=self.postmortem_keep)
+
+    def mark_warm(self) -> int:
+        """Declare compile warmup over on every profiled worker: any
+        compiled-program signature FIRST seen after this call counts
+        as an unexpected post-warmup recompile (the
+        ``engine_unexpected_compiles`` gauge — runtime twin of the
+        static SC06 bucket checker; attach an SLO ``value`` rule to
+        alert on it). Returns the number of trackers armed."""
+        n = 0
+        for w in self.workers:
+            ct = getattr(w.engine, "compiles", None)
+            if ct is not None:
+                ct.warmup_done()
+                n += 1
+        return n
+
     def _sweep_traces(self) -> list[dict]:
         """Move freshly-terminal traces to the unshipped summary list;
         returns the summaries accumulated so far (without clearing)."""
@@ -1162,6 +1296,7 @@ class ServingFleet:
         log_kv(_log, "degradation", level=logging.WARNING,
                old=old, new=level, load_penalty=self.load_penalty)
         log_event("fleet_degradation", old=old, new=level)
+        self.flight.record("degradation", old=old, new=level)
 
     def _apply_degradation_worker(self, w: _Worker) -> None:
         """Apply the CURRENT ladder level to one worker's engine —
@@ -1255,19 +1390,73 @@ class ServingFleet:
                     events.append({**base, "ph": "X",
                                    "ts": s.start_ns / 1e3,
                                    "dur": (s.end_ns - s.start_ns) / 1e3})
+        # ISSUE 13: step-phase lanes ride the same perf_counter
+        # timebase — each profiled worker's admission/launch/publish
+        # spans render beside its request traces, the router's
+        # schedule/telemetry spans in lane 0
+        if self._prof is not None:
+            events.extend(self._prof.to_events(pid=0))
+        for w in self.workers:
+            sp = getattr(w.engine, "profile", None)
+            if sp is not None:
+                events.extend(sp.to_events(pid=pids[w.wid]))
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)
         return path
 
+    def debug_surface(self) -> dict:
+        """Named providers for the debug HTTP routes (ISSUE 13): each
+        value is a zero-arg callable returning a JSON-able dict,
+        evaluated per request on the scrape thread."""
+        return {"statusz": self._statusz,
+                "requestz": self._requestz,
+                "flightz": self.flight.snapshot,
+                "compilez": self._compilez}
+
+    def _statusz(self) -> dict:
+        out = {"stats": self.stats(),
+               "degradation": self._degradation,
+               "load_penalty": self.load_penalty,
+               "slo": self.slo.states() if self.slo is not None
+               else None,
+               "flight_seen": len(self.flight)}
+        if self._prof is not None:
+            out["router_profile"] = self._prof.summary()
+            out["worker_profiles"] = {
+                w.wid: w.engine.profile.summary()
+                for w in self.workers
+                if getattr(w.engine, "profile", None) is not None}
+        return out
+
+    def _requestz(self) -> dict:
+        try:
+            traces = list(self._traces)[-64:]
+        except RuntimeError:
+            traces = []
+        return {"count": len(traces),
+                "traces": [t.summary() for t in traces]}
+
+    def _compilez(self) -> dict:
+        out = {}
+        for w in self.workers:
+            ct = getattr(w.engine, "compiles", None)
+            if ct is not None:
+                out[w.wid] = {"stats": ct.stats(),
+                              "log": ct.compile_log()}
+        return out
+
     def serve_metrics(self, host="127.0.0.1", port=0):
         """Start the stdlib scrape endpoint (GET /metrics → labeled
-        Prometheus text, /metrics.json → merged JSON snapshot). Returns
-        the server; ``.port`` holds the bound port when ``port=0``."""
+        Prometheus text, /metrics.json → merged JSON snapshot, plus
+        the ISSUE 13 debug routes /statusz /requestz /flightz
+        /compilez). Returns the server; ``.port`` holds the bound port
+        when ``port=0``."""
         from .fleet_metrics import MetricsHTTPServer
         if self._http is None:
             self._http = MetricsHTTPServer(
-                self.aggregator(), host=host, port=port).start()
+                self.aggregator(), host=host, port=port,
+                debug=self.debug_surface()).start()
         return self._http
 
     def stats(self) -> dict:

@@ -19,14 +19,18 @@ snapshot. Bind ``port=0`` in tests and read ``.port``.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..observability.metrics import (Counter, Gauge, Histogram,
                                      MetricsRegistry, merge_snapshots,
                                      escape_help, escape_label)
+from ..utils.log import get_logger, log_kv
 
 __all__ = ["MetricsAggregator", "MetricsHTTPServer"]
+
+_log = get_logger("paddle_tpu.inference.fleet_metrics")
 
 
 class MetricsAggregator:
@@ -143,19 +147,60 @@ class MetricsAggregator:
 class _ScrapeHandler(BaseHTTPRequestHandler):
     server_version = "paddle_tpu_fleet/1.0"
 
+    def _paths(self) -> list:
+        """Every path this server answers (404 bodies list them, so a
+        fat-fingered scrape config is self-diagnosing)."""
+        fixed = ["/", "/metrics", "/metrics.json", "/healthz"]
+        debug = self.server.debug         # type: ignore[attr-defined]
+        return fixed + sorted("/" + name for name in debug)
+
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         agg = self.server.aggregator      # type: ignore[attr-defined]
+        debug = self.server.debug         # type: ignore[attr-defined]
         if self.path in ("/metrics", "/"):
             body = agg.prometheus_text().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif self.path == "/metrics.json":
             body = json.dumps(agg.snapshot()).encode()
             ctype = "application/json"
+        elif self.path == "/healthz":
+            # liveness only: the scrape thread answering IS the signal
+            # (worker health lives in /statusz and the metrics)
+            body = b'{"status": "ok"}\n'
+            ctype = "application/json"
+        elif self.path.lstrip("/") in debug:
+            # ISSUE 13 debug surface: providers run per request on
+            # this thread; a raising provider is a 500 with the error
+            # named, never a wedged handler
+            try:
+                payload = debug[self.path.lstrip("/")]()
+                body = json.dumps(payload, default=str,
+                                  sort_keys=True).encode()
+                ctype = "application/json"
+            except Exception as e:  # noqa: BLE001 — surface, don't wedge
+                log_kv(_log, "debug_provider_failed",
+                       level=logging.ERROR, path=self.path,
+                       error=type(e).__name__, detail=str(e))
+                self._plain(500, f"debug provider {self.path!r} "
+                            f"raised {type(e).__name__}: {e}\n")
+                return
         else:
-            self.send_error(404)
+            # self-diagnosing 404: the body lists every served path so
+            # a fat-fingered scrape config explains itself
+            self._plain(404, f"no handler for {self.path!r}; "
+                        "served paths: "
+                        + " ".join(self._paths()) + "\n")
             return
         self.send_response(200)
         self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _plain(self, code: int, text: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -165,13 +210,21 @@ class _ScrapeHandler(BaseHTTPRequestHandler):
 
 
 class MetricsHTTPServer:
-    """Stdlib scrape endpoint over a :class:`MetricsAggregator`."""
+    """Stdlib scrape endpoint over a :class:`MetricsAggregator`.
+
+    ``debug=`` (ISSUE 13) maps route names to zero-arg providers
+    returning JSON-able payloads — the fleet passes
+    ``{"statusz": ..., "requestz": ..., "flightz": ..., "compilez":
+    ...}`` and each becomes ``GET /<name>``. ``/healthz`` always
+    answers; unknown paths 404 with a body listing every served
+    path."""
 
     def __init__(self, aggregator: MetricsAggregator,
-                 host="127.0.0.1", port=0):
+                 host="127.0.0.1", port=0, debug=None):
         self._srv = ThreadingHTTPServer((host, port), _ScrapeHandler)
         self._srv.daemon_threads = True
         self._srv.aggregator = aggregator   # handler reads it per GET
+        self._srv.debug = dict(debug or {})
         self.host = self._srv.server_address[0]
         self.port = self._srv.server_address[1]
         self._thread = None

@@ -32,6 +32,29 @@ __all__ = ["GenerationPredictor", "BatchingServer", "DecodeEngine"]
 _log = get_logger("paddle_tpu.inference.engine")
 
 
+class _NullSpan:
+    """No-op phase guard: the ``profile=None`` hot path enters this
+    singleton instead of a profiler span, so the cost of instrumentation
+    with profiling off is one attribute check per phase."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOPROF = _NullSpan()
+
+
+def _phase(prof, name):
+    """Phase guard for ``with`` — a real profiler span when a
+    StepProfiler is attached, the no-op singleton otherwise."""
+    return _NOPROF if prof is None else prof.phase(name)
+
+
 def _tmark(req, state, worker=None, n_tokens=None):
     """Mark a lifecycle transition on the request's trace (requests
     without one — foreign test doubles — are silently skipped).
@@ -79,7 +102,7 @@ class DecodeEngine:
                  prefix_listener=None, qos=None, chunked_prefill=False,
                  prefill_chunk=None, step_budget=None,
                  spec_decode=False, spec_max_draft=4, kv_dtype="fp",
-                 mesh=None, tp_axis="tp"):
+                 mesh=None, tp_axis="tp", profile=None, recorder=None):
         from ..distributed.fleet.mp_layers import current_mesh
         from ..models.llama import _pp_degree
         if _pp_degree(current_mesh()) > 1:
@@ -216,6 +239,26 @@ class DecodeEngine:
         self.metrics = registry if registry is not None \
             else MetricsRegistry()
         self._init_metrics()
+        # ISSUE 13: step-phase profiler + recompile observatory.
+        # profile=None (the default) creates NEITHER — phase guards
+        # collapse to a no-op singleton and the compiled programs stay
+        # unwrapped, so the hot path and outputs are untouched. Pass
+        # profile=True (or a StepProfiler kwargs dict) to attach both;
+        # recorder= threads a FlightRecorder so compile and step-outlier
+        # events land beside the fleet's lifecycle events.
+        self.flight = recorder
+        self.profile = None
+        self.compiles = None
+        if profile:
+            from ..observability.profiling import (CompileTracker,
+                                                   StepProfiler)
+            kw = dict(profile) if isinstance(profile, dict) else {}
+            self.profile = StepProfiler(registry=self.metrics,
+                                        recorder=recorder,
+                                        worker_id=self.worker_id, **kw)
+            self.compiles = CompileTracker(registry=self.metrics,
+                                           recorder=recorder,
+                                           worker_id=self.worker_id)
         self._build()
         self._reset()
 
@@ -578,8 +621,24 @@ class DecodeEngine:
             self._mixed = jax.jit(
                 _tp_wrap(mixed_step, 4),
                 donate_argnums=tuple(range(9, 9 + self._n_pool)))
+            if self.compiles is not None:
+                # ISSUE 13 recompile observatory: each wrapped program
+                # logs (name, abstract shapes, wall) on every NEW
+                # argument signature — a post-warmup entry is a
+                # recompile the bucket discipline should have prevented
+                # (runtime twin of the SC06 static checker).
+                self._prefill = self.compiles.wrap(
+                    "prefill_paged", self._prefill)
+                self._decode = self.compiles.wrap(
+                    "decode_chunk_paged", self._decode)
+                self._cow = self.compiles.wrap("cow_copy", self._cow)
+                self._mixed = self.compiles.wrap(
+                    "mixed_step", self._mixed)
         else:
             self._prefill = jax.jit(prefill)
+            if self.compiles is not None:
+                self._prefill = self.compiles.wrap(
+                    "prefill", self._prefill)
             self._decode = self._decode_for(self.chunk)
         self._cfg = cfg
         self._kvh = cfg.num_key_value_heads
@@ -596,6 +655,8 @@ class DecodeEngine:
         fn = self._decode_progs.get(n)
         if fn is None:
             fn = jax.jit(self._make_decode(n), donate_argnums=(6, 7))
+            if self.compiles is not None:
+                fn = self.compiles.wrap("decode_chunk", fn, key=n)
             self._decode_progs[n] = fn
         return fn
 
@@ -617,6 +678,8 @@ class DecodeEngine:
                                        4),
                          donate_argnums=tuple(
                              range(9, 9 + self._n_pool)))
+            if self.compiles is not None:
+                fn = self.compiles.wrap("prefix_prefill", fn, key=sc)
             self._prefix_progs[sc] = fn
         return fn
 
@@ -631,6 +694,8 @@ class DecodeEngine:
                                        4),
                          donate_argnums=tuple(
                              range(9, 9 + self._n_pool)))
+            if self.compiles is not None:
+                fn = self.compiles.wrap("verify_prefill", fn, key=sc)
             self._verify_progs[sc] = fn
         return fn
 
@@ -849,6 +914,10 @@ class DecodeEngine:
         Contiguous mode: a prompt longer than the current global fill
         can only start when the engine is empty (its left-pad would
         rewind other rows' history)."""
+        with _phase(self.profile, "admission"):
+            return self._admit_inner(pending)
+
+    def _admit_inner(self, pending):
         import jax
         import jax.numpy as jnp
         import numpy as _np
@@ -1338,8 +1407,9 @@ class DecodeEngine:
         pad = sc - tail.size
         st, embed, fnorm, lm = self._weights()
         self._drain_scale_resets()
-        with RecordEvent("engine.prefill_chunk", "engine",
-                         worker=self.worker_id):
+        with _phase(self.profile, "prefill_chunk"), \
+                RecordEvent("engine.prefill_chunk", "engine",
+                            worker=self.worker_id):
             first, *pool = self._prefix_prefill_for(sc)(
                 st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
                 jnp.asarray([pad], jnp.int32),
@@ -1374,6 +1444,16 @@ class DecodeEngine:
         """Run ONE bounded decode chunk, collect tokens, retire finished
         rows (their futures resolve immediately). Returns the number of
         still-alive rows."""
+        prof = self.profile
+        if prof is None:
+            return self._decode_once_inner()
+        prof.begin_step()
+        try:
+            return self._decode_once_inner()
+        finally:
+            prof.end_step()
+
+    def _decode_once_inner(self):
         import jax.numpy as jnp
         import numpy as _np
         if self.idle():
@@ -1417,11 +1497,13 @@ class DecodeEngine:
         #                            prefill/compile must not read as a
         #                            phantom throughput collapse
         with RecordEvent("engine.decode_chunk", "engine", worker=self.worker_id):
-            toks, self._ck, self._cv = self._decode_for(steps)(
-                st, embed, fnorm, lm, self._scales,
-                jnp.asarray(self._tok), self._ck, self._cv, self._g,
-                jnp.asarray(self._pad))
-            toks = _np.asarray(toks)    # [steps, B] (fetch = sync)
+            with _phase(self.profile, "launch"):
+                toks, self._ck, self._cv = self._decode_for(steps)(
+                    st, embed, fnorm, lm, self._scales,
+                    jnp.asarray(self._tok), self._ck, self._cv,
+                    self._g, jnp.asarray(self._pad))
+            with _phase(self.profile, "host_sync"):
+                toks = _np.asarray(toks)   # [steps, B] (fetch = sync)
         wall = _now() - t0
         self._g += steps
         self.device_steps += steps
@@ -1435,24 +1517,27 @@ class DecodeEngine:
                   tokens_per_s=round(steps * n_busy
                                      / max(wall, 1e-9), 1))
         alive = 0
-        for slot, row in enumerate(self._rows):
-            if row is None:
-                continue
-            emitted_before = len(row["toks"])
-            row["toks"].extend(int(t) for t in toks[:, slot])
-            self._tok[slot] = int(toks[-1, slot])
-            req = row["req"]
-            _tmark(req, "decode_chunk", worker=self.worker_id,
-                   n_tokens=min(steps, req.max_new - emitted_before))
-            if len(row["toks"]) >= req.max_new:
-                req.result = _np.concatenate(
-                    [row["prompt"],
-                     _np.asarray(row["toks"][:req.max_new], _np.int32)])
-                self._observe_retired(req)
-                req.event.set()
-                self._rows[slot] = None  # slot free for the next admit
-            else:
-                alive += 1
+        with _phase(self.profile, "publish"):
+            for slot, row in enumerate(self._rows):
+                if row is None:
+                    continue
+                emitted_before = len(row["toks"])
+                row["toks"].extend(int(t) for t in toks[:, slot])
+                self._tok[slot] = int(toks[-1, slot])
+                req = row["req"]
+                _tmark(req, "decode_chunk", worker=self.worker_id,
+                       n_tokens=min(steps,
+                                    req.max_new - emitted_before))
+                if len(row["toks"]) >= req.max_new:
+                    req.result = _np.concatenate(
+                        [row["prompt"],
+                         _np.asarray(row["toks"][:req.max_new],
+                                     _np.int32)])
+                    self._observe_retired(req)
+                    req.event.set()
+                    self._rows[slot] = None  # slot free for next admit
+                else:
+                    alive += 1
         if alive == 0 and self.idle():
             self._reset()                # fresh fill for the next burst
         return alive
@@ -1593,12 +1678,14 @@ class DecodeEngine:
         self._drain_scale_resets()
         t0 = _now()
         with RecordEvent("engine.decode_chunk", "engine", worker=self.worker_id):
-            toks, *pool = self._decode(
-                st, embed, fnorm, lm, self._scales,
-                jnp.asarray(self._tok), jnp.asarray(self._tables),
-                jnp.asarray(self._lens), *self._pool())
-            self._set_pool(pool)
-            toks = _np.asarray(toks)    # [chunk, B] (fetch = sync)
+            with _phase(self.profile, "launch"):
+                toks, *pool = self._decode(
+                    st, embed, fnorm, lm, self._scales,
+                    jnp.asarray(self._tok), jnp.asarray(self._tables),
+                    jnp.asarray(self._lens), *self._pool())
+                self._set_pool(pool)
+            with _phase(self.profile, "host_sync"):
+                toks = _np.asarray(toks)   # [chunk, B] (fetch = sync)
         wall = _now() - t0
         self.device_steps += self.chunk
         self._c_steps.inc(self.chunk)
@@ -1613,35 +1700,38 @@ class DecodeEngine:
                   blocks_used=self._alloc.num_used,
                   blocks_free=self._alloc.num_free)
         alive = 0
-        for slot, row in enumerate(self._rows):
-            if row is None:
-                continue
-            if "pf_seq" in row:
-                alive += 1          # mid-prefill: alive, not decoding
-                continue            # (its lane wrote to the NULL page)
-            emitted_before = len(row["toks"])
-            row["toks"].extend(int(t) for t in toks[:, slot])
-            self._tok[slot] = int(toks[-1, slot])
-            req = row["req"]
-            useful = min(self.chunk, req.max_new - emitted_before)
-            _tmark(req, "decode_chunk", worker=self.worker_id,
-                   n_tokens=useful)
-            # fair-share: the tenant pays for the USEFUL tokens this
-            # chunk produced (overshoot past max_new is engine padding,
-            # not tenant work)
-            self._qos_charge(req, useful)
-            if len(row["toks"]) >= req.max_new:
-                req.result = _np.concatenate(
-                    [row["prompt"],
-                     _np.asarray(row["toks"][:req.max_new], _np.int32)])
-                self._retire_paged(slot)  # pages free for next admit
-                req.event.set()
-                if self.qos is not None:
-                    from .qos import tenant_of
-                    self.qos.note_served(tenant_of(req), req.max_new)
-            else:
-                self._lens[slot] += self.chunk
-                alive += 1
+        with _phase(self.profile, "publish"):
+            for slot, row in enumerate(self._rows):
+                if row is None:
+                    continue
+                if "pf_seq" in row:
+                    alive += 1      # mid-prefill: alive, not decoding
+                    continue        # (its lane wrote to the NULL page)
+                emitted_before = len(row["toks"])
+                row["toks"].extend(int(t) for t in toks[:, slot])
+                self._tok[slot] = int(toks[-1, slot])
+                req = row["req"]
+                useful = min(self.chunk, req.max_new - emitted_before)
+                _tmark(req, "decode_chunk", worker=self.worker_id,
+                       n_tokens=useful)
+                # fair-share: the tenant pays for the USEFUL tokens
+                # this chunk produced (overshoot past max_new is
+                # engine padding, not tenant work)
+                self._qos_charge(req, useful)
+                if len(row["toks"]) >= req.max_new:
+                    req.result = _np.concatenate(
+                        [row["prompt"],
+                         _np.asarray(row["toks"][:req.max_new],
+                                     _np.int32)])
+                    self._retire_paged(slot)  # pages free to re-admit
+                    req.event.set()
+                    if self.qos is not None:
+                        from .qos import tenant_of
+                        self.qos.note_served(tenant_of(req),
+                                             req.max_new)
+                else:
+                    self._lens[slot] += self.chunk
+                    alive += 1
         return alive
 
     # -- self-speculative decoding (ISSUE 8 tentpole) -----------------------
@@ -1659,7 +1749,8 @@ class DecodeEngine:
             return _np.zeros((0,), _np.int32)
         ctx = _np.concatenate(
             [row["prompt"], _np.asarray(row["toks"], _np.int32)])
-        return self._drafter.propose(ctx, limit=limit)
+        with _phase(self.profile, "spec_draft"):
+            return self._drafter.propose(ctx, limit=limit)
 
     def _decode_once_spec(self):
         """One SPECULATIVE engine step (ISSUE 8 tentpole): every
@@ -1811,47 +1902,52 @@ class DecodeEngine:
         t0 = _now()
         with RecordEvent("engine.spec_verify", "engine",
                          worker=self.worker_id):
-            preds, *pool = self._verify_prefill_for(sc)(
-                st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
-                jnp.asarray([pad], jnp.int32),
-                jnp.asarray([lens0], jnp.int32),
-                jnp.asarray(self._tables[slot]), *self._pool())
-            self._set_pool(pool)
-            preds = _np.asarray(preds)[0, pad:]  # [k+1] greedy chain
+            with _phase(self.profile, "launch"):
+                preds, *pool = self._verify_prefill_for(sc)(
+                    st, embed, fnorm, lm, self._scales,
+                    jnp.asarray(ids), jnp.asarray([pad], jnp.int32),
+                    jnp.asarray([lens0], jnp.int32),
+                    jnp.asarray(self._tables[slot]), *self._pool())
+                self._set_pool(pool)
+            with _phase(self.profile, "host_sync"):
+                # [k+1] greedy chain
+                preds = _np.asarray(preds)[0, pad:]
         wall = _now() - t0
         self.device_steps += 1
         self._c_steps.inc(1)
         self._c_device_calls.inc()
         self._h_chunk.observe(wall)
-        out = [int(preds[0])]
-        for i in range(k):
-            if int(draft[i]) != out[i]:
-                break
-            out.append(int(preds[i + 1]))
-        m_len = len(out)
-        self._c_spec_proposed.inc(k)
-        self._c_spec_accepted.inc(m_len - 1)
-        self._h_spec_accept.observe(m_len)
-        _tmark(req, "spec_verify", worker=self.worker_id)
-        row["toks"].extend(out)
-        self._tok[slot] = out[-1]
-        # the draft clamp guarantees len(toks) never passes max_new, so
-        # every accepted token is useful — the tenant pays for exactly
-        # what it got, never for rejected speculation
-        _tmark(req, "decode_chunk", worker=self.worker_id,
-               n_tokens=m_len)
-        self._qos_charge(req, m_len)
-        if len(row["toks"]) >= req.max_new:
-            req.result = _np.concatenate(
-                [row["prompt"],
-                 _np.asarray(row["toks"][:req.max_new], _np.int32)])
-            self._retire_paged(slot)      # pages free for next admit
-            req.event.set()
-            if self.qos is not None:
-                from .qos import tenant_of
-                self.qos.note_served(tenant_of(req), req.max_new)
-        else:
-            self._lens[slot] = lens0 + m_len
+        with _phase(self.profile, "publish"):
+            out = [int(preds[0])]
+            for i in range(k):
+                if int(draft[i]) != out[i]:
+                    break
+                out.append(int(preds[i + 1]))
+            m_len = len(out)
+            self._c_spec_proposed.inc(k)
+            self._c_spec_accepted.inc(m_len - 1)
+            self._h_spec_accept.observe(m_len)
+            _tmark(req, "spec_verify", worker=self.worker_id)
+            row["toks"].extend(out)
+            self._tok[slot] = out[-1]
+            # the draft clamp guarantees len(toks) never passes
+            # max_new, so every accepted token is useful — the tenant
+            # pays for exactly what it got, never for rejected
+            # speculation
+            _tmark(req, "decode_chunk", worker=self.worker_id,
+                   n_tokens=m_len)
+            self._qos_charge(req, m_len)
+            if len(row["toks"]) >= req.max_new:
+                req.result = _np.concatenate(
+                    [row["prompt"],
+                     _np.asarray(row["toks"][:req.max_new], _np.int32)])
+                self._retire_paged(slot)  # pages free for next admit
+                req.event.set()
+                if self.qos is not None:
+                    from .qos import tenant_of
+                    self.qos.note_served(tenant_of(req), req.max_new)
+            else:
+                self._lens[slot] = lens0 + m_len
 
     # -- single-launch mixed step (ISSUE 10 tentpole) -----------------------
     def _decode_once_mixed(self):
@@ -1955,12 +2051,16 @@ class DecodeEngine:
         t0 = _now()
         with RecordEvent("engine.mixed_step", "engine",
                          worker=self.worker_id):
-            preds, *pool = self._mixed(
-                st, embed, fnorm, lm, self._scales, jnp.asarray(ids),
-                jnp.asarray(q_lens), jnp.asarray(kv_lens),
-                jnp.asarray(tabs), *self._pool())
-            self._set_pool(pool)
-            preds = _np.asarray(preds)   # [B, T] argmax per position
+            with _phase(self.profile, "launch"):
+                preds, *pool = self._mixed(
+                    st, embed, fnorm, lm, self._scales,
+                    jnp.asarray(ids), jnp.asarray(q_lens),
+                    jnp.asarray(kv_lens), jnp.asarray(tabs),
+                    *self._pool())
+                self._set_pool(pool)
+            with _phase(self.profile, "host_sync"):
+                # [B, T] argmax per position
+                preds = _np.asarray(preds)
         wall = _now() - t0
         self.device_steps += 1
         self._c_steps.inc(1)
@@ -1970,61 +2070,65 @@ class DecodeEngine:
                   window=T, wall_s=round(wall, 4),
                   blocks_used=self._alloc.num_used,
                   blocks_free=self._alloc.num_free)
-        for slot, row, kind, tail, kvl, table in windows:
-            if self._rows[slot] is not row:
-                continue
-            req = row["req"]
-            if kind == "chunk":
-                take = tail.size
-                row["pf_pos"] = int(row["pf_pos"]) + take
-                self._c_prefill_chunks.inc()
-                _tmark(req, "prefill_chunk", worker=self.worker_id)
-                self._qos_charge(req, take)
-                if row["pf_pos"] >= row["pf_seq"].size:
-                    # last chunk: its last-real-position argmax IS the
-                    # first token (mirrors _prefill_chunk_row)
-                    resume = row.pop("pf_resume")
-                    toks = list(resume) if resume \
-                        else [int(preds[slot, take - 1])]
-                    self._tables[slot] = row.pop("pf_table")
-                    self._lens[slot] = row["pf_seq"].size
-                    self._tok[slot] = toks[-1]
-                    row["toks"] = toks
-                    del row["pf_seq"], row["pf_pos"]
-                    self.prefills += 1
-                    self._c_prefills.inc()
-                    self._observe_first_token(req)
-                continue
-            # decode/verify lane: greedy accept chain off the window
-            k = tail.size - 1
-            out = [int(preds[slot, 0])]
-            for i in range(k):
-                if int(tail[i + 1]) != out[i]:
-                    break
-                out.append(int(preds[slot, i + 1]))
-            m_len = len(out)
-            if self.spec_decode:
-                self._c_spec_proposed.inc(k)
-                self._c_spec_accepted.inc(m_len - 1)
-                self._h_spec_accept.observe(m_len)
-                _tmark(req, "spec_verify", worker=self.worker_id)
-            row["toks"].extend(out)
-            self._tok[slot] = out[-1]
-            _tmark(req, "decode_chunk", worker=self.worker_id,
-                   n_tokens=m_len)
-            self._qos_charge(req, m_len)
-            if len(row["toks"]) >= req.max_new:
-                req.result = _np.concatenate(
-                    [row["prompt"],
-                     _np.asarray(row["toks"][:req.max_new],
-                                 _np.int32)])
-                self._retire_paged(slot)
-                req.event.set()
-                if self.qos is not None:
-                    from .qos import tenant_of
-                    self.qos.note_served(tenant_of(req), req.max_new)
-            else:
-                self._lens[slot] = kvl - tail.size + m_len
+        with _phase(self.profile, "publish"):
+            for slot, row, kind, tail, kvl, table in windows:
+                if self._rows[slot] is not row:
+                    continue
+                req = row["req"]
+                if kind == "chunk":
+                    take = tail.size
+                    row["pf_pos"] = int(row["pf_pos"]) + take
+                    self._c_prefill_chunks.inc()
+                    _tmark(req, "prefill_chunk",
+                           worker=self.worker_id)
+                    self._qos_charge(req, take)
+                    if row["pf_pos"] >= row["pf_seq"].size:
+                        # last chunk: its last-real-position argmax IS
+                        # the first token (mirrors _prefill_chunk_row)
+                        resume = row.pop("pf_resume")
+                        toks = list(resume) if resume \
+                            else [int(preds[slot, take - 1])]
+                        self._tables[slot] = row.pop("pf_table")
+                        self._lens[slot] = row["pf_seq"].size
+                        self._tok[slot] = toks[-1]
+                        row["toks"] = toks
+                        del row["pf_seq"], row["pf_pos"]
+                        self.prefills += 1
+                        self._c_prefills.inc()
+                        self._observe_first_token(req)
+                    continue
+                # decode/verify lane: greedy accept chain off the
+                # window
+                k = tail.size - 1
+                out = [int(preds[slot, 0])]
+                for i in range(k):
+                    if int(tail[i + 1]) != out[i]:
+                        break
+                    out.append(int(preds[slot, i + 1]))
+                m_len = len(out)
+                if self.spec_decode:
+                    self._c_spec_proposed.inc(k)
+                    self._c_spec_accepted.inc(m_len - 1)
+                    self._h_spec_accept.observe(m_len)
+                    _tmark(req, "spec_verify", worker=self.worker_id)
+                row["toks"].extend(out)
+                self._tok[slot] = out[-1]
+                _tmark(req, "decode_chunk", worker=self.worker_id,
+                       n_tokens=m_len)
+                self._qos_charge(req, m_len)
+                if len(row["toks"]) >= req.max_new:
+                    req.result = _np.concatenate(
+                        [row["prompt"],
+                         _np.asarray(row["toks"][:req.max_new],
+                                     _np.int32)])
+                    self._retire_paged(slot)
+                    req.event.set()
+                    if self.qos is not None:
+                        from .qos import tenant_of
+                        self.qos.note_served(tenant_of(req),
+                                             req.max_new)
+                else:
+                    self._lens[slot] = kvl - tail.size + m_len
         return sum(r is not None for r in self._rows)
 
 

@@ -31,14 +31,21 @@ lifecycle next to op-dispatch spans (PAPER §L0–L4 host+device merge).
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       DEFAULT_LATENCY_BUCKETS, get_registry,
-                      merge_snapshots, now, escape_help, escape_label)
+                      merge_snapshots, now, quantile_from_buckets,
+                      escape_help, escape_label)
 from .tracing import (RequestTrace, LIFECYCLE_STATES, TERMINAL_STATES)
 from .slo import SLORule, SLOEngine, AlertState
 from .export import TelemetryShipper, JsonlFileSink, HTTPPostSink
+from .flight import (FlightRecorder, build_bundle, dump_postmortem,
+                     get_flight_recorder)
+from .profiling import PHASES, StepProfiler, CompileTracker
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_LATENCY_BUCKETS", "get_registry", "merge_snapshots",
-           "now", "escape_help", "escape_label",
+           "now", "quantile_from_buckets", "escape_help", "escape_label",
            "RequestTrace", "LIFECYCLE_STATES", "TERMINAL_STATES",
            "SLORule", "SLOEngine", "AlertState",
-           "TelemetryShipper", "JsonlFileSink", "HTTPPostSink"]
+           "TelemetryShipper", "JsonlFileSink", "HTTPPostSink",
+           "FlightRecorder", "build_bundle", "dump_postmortem",
+           "get_flight_recorder",
+           "PHASES", "StepProfiler", "CompileTracker"]

@@ -1,0 +1,42 @@
+"""Postmortem dump CLI (ISSUE 13 satellite)::
+
+    python -m paddle_tpu.observability.dump <dir> [reason]
+
+Writes one postmortem bundle — the process-default flight recorder
+(:func:`~paddle_tpu.observability.flight.get_flight_recorder`) plus
+the process-default metrics registry — into ``<dir>`` and prints the
+bundle path. Exit status: 0 on success, 1 when the dump failed, 2 on
+usage errors. In-process tooling should call
+:func:`~paddle_tpu.observability.flight.dump_postmortem` directly
+(fleets pass their own recorder/registry/state)."""
+
+from __future__ import annotations
+
+import sys
+
+USAGE = "usage: python -m paddle_tpu.observability.dump <dir> [reason]"
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if any(a in ("-h", "--help") for a in args):
+        print(USAGE)
+        return 0
+    if not 1 <= len(args) <= 2:
+        print(USAGE, file=sys.stderr)
+        return 2
+    reason = args[1] if len(args) > 1 else "manual"
+    from .flight import dump_postmortem, get_flight_recorder
+    from .metrics import get_registry
+    path = dump_postmortem(args[0], reason=reason,
+                           recorder=get_flight_recorder(),
+                           registry=get_registry())
+    if path is None:
+        print("postmortem dump failed (see log)", file=sys.stderr)
+        return 1
+    print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,223 @@
+"""Flight recorder + postmortem bundles (ISSUE 13 tentpole; reference
+shape: an aircraft FDR applied to the serving fleet — a bounded ring of
+structured events that is ALWAYS on, cheap enough to never matter, and
+harvested into a replayable artifact the moment something dies).
+
+The r6–r15 stack's failure evidence was a cumulative metrics snapshot:
+it says a worker restarted, never WHAT the fleet was doing in the steps
+before. A :class:`FlightRecorder` closes that gap — lifecycle
+transitions, preemptions, failovers, restarts, injected faults,
+shed/quarantine decisions, compile events and step-phase outliers all
+land in per-worker rings that mirror into one fleet ring, and
+:func:`dump_postmortem` freezes the rings plus registry/scheduler/
+allocator state into a JSON bundle. The fleet invokes it automatically
+from the r9 watchdog ``on_stall``, the r14 restart harvest and poison
+quarantine, so every chaos event leaves an artifact.
+
+Determinism contract: the recorder takes an injected ``clock=``
+(defaulting to the shared ``observability.now`` alias) and a
+monotonically increasing sequence number; with an injected clock two
+same-seed runs produce byte-identical bundles (``json.dump`` with
+``sort_keys``), which the chaos suite pins. ``record`` is O(1), takes
+one lock, and NEVER raises — observability must never take down
+serving."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from collections import deque
+
+from ..utils.log import get_logger, log_kv
+from .metrics import now
+
+__all__ = ["FlightRecorder", "build_bundle", "dump_postmortem",
+           "get_flight_recorder", "BUNDLE_VERSION"]
+
+_log = get_logger("paddle_tpu.observability.flight")
+
+#: bundle schema version (bump on breaking layout changes; consumers
+#: gate on it instead of sniffing keys)
+BUNDLE_VERSION = 1
+
+
+class FlightRecorder:
+    """Bounded, lock-disciplined ring of structured events.
+
+    - ``record(kind, **fields)`` appends ``{"seq", "t", "kind", ...}``
+      — O(1), drop-oldest, exception-contained;
+    - ``forward_to=`` mirrors every event into a parent recorder (the
+      fleet ring) with a ``src`` tag, so worker rings stay local while
+      the fleet keeps the global interleaving;
+    - ``registry=`` registers fn-gauges (events seen / dropped) whose
+      callbacks take the ring lock themselves — scrape threads read
+      them outside any caller lock."""
+
+    def __init__(self, capacity: int = 512, clock=None, name=None,
+                 forward_to=None, registry=None):
+        self.name = name
+        self.capacity = int(capacity)
+        self._clock = now if clock is None else clock
+        self._forward = forward_to
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self._seq = 0                                    # guarded-by: _lock
+        self._dropped = 0                                # guarded-by: _lock
+        if registry is not None:
+            registry.gauge(
+                "flight_events_seen",
+                "events recorded into the flight ring since start",
+                fn=self._seen)
+            registry.gauge(
+                "flight_events_dropped",
+                "flight events evicted from the bounded ring",
+                fn=self._drop_count)
+
+    # fn-gauge callbacks run on the scrape thread with NO caller locks
+    # held — they take the ring lock themselves
+    def _seen(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def _drop_count(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def record(self, kind: str, **fields):
+        """Append one event; returns it (or None if recording failed).
+        Never raises and never blocks beyond the ring lock."""
+        try:
+            t = float(self._clock())
+            with self._lock:
+                self._seq += 1
+                evt = {"seq": self._seq, "t": round(t, 6),
+                       "kind": str(kind)}
+                evt.update(fields)
+                if len(self._ring) == self._ring.maxlen:
+                    self._dropped += 1
+                self._ring.append(evt)
+            if self._forward is not None:
+                fwd = {k: v for k, v in fields.items() if k != "src"}
+                self._forward.record(kind, src=self.name, **fwd)
+            return evt
+        except Exception as e:  # noqa: BLE001 — recorder never kills serving
+            log_kv(_log, "flight_record_failed", level=logging.WARNING,
+                   error=type(e).__name__, detail=str(e), kind=kind)
+            return None
+
+    def events(self, n=None, kind=None) -> list:
+        """Newest-last copy of the ring; ``n`` keeps the newest n,
+        ``kind`` filters."""
+        with self._lock:
+            evts = [dict(e) for e in self._ring]
+        if kind is not None:
+            evts = [e for e in evts if e.get("kind") == kind]
+        return evts[-int(n):] if n else evts
+
+    def snapshot(self) -> dict:
+        """JSON-able view of the whole recorder (bundle component)."""
+        with self._lock:
+            return {"name": self.name, "capacity": self.capacity,
+                    "seq": self._seq, "dropped": self._dropped,
+                    "events": [dict(e) for e in self._ring]}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+    def __repr__(self):
+        return (f"FlightRecorder({self.name!r}, "
+                f"capacity={self.capacity})")
+
+
+def build_bundle(reason="manual", recorder=None, registry=None,
+                 traces=(), compile_log=(), config=None,
+                 state=None) -> dict:
+    """Assemble (but do not write) a postmortem bundle dict.
+
+    Components mirror the ISSUE 13 schema: flight ring, registry
+    snapshot, scheduler/allocator ``state``, last-N request trace
+    summaries, compile log, config. Every component is optional so the
+    same builder serves the fleet, a bare engine, and the CLI."""
+    bundle = {"bundle_version": BUNDLE_VERSION, "reason": str(reason)}
+    if recorder is not None:
+        bundle["flight"] = recorder.snapshot()
+    if registry is not None:
+        bundle["metrics"] = registry.snapshot() \
+            if hasattr(registry, "snapshot") else dict(registry)
+    bundle["traces"] = [t.summary() if hasattr(t, "summary") else t
+                        for t in traces]
+    bundle["compile_log"] = list(compile_log)
+    bundle["config"] = dict(config or {})
+    bundle["state"] = dict(state or {})
+    return bundle
+
+
+def _write_bundle(path, bundle) -> None:  # staticcheck: io-boundary
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(bundle, f, sort_keys=True, indent=1, default=str)
+        f.write("\n")
+
+
+def _slug(s: str) -> str:
+    out = "".join(c if c.isalnum() or c in "-_" else "-" for c in str(s))
+    return out[:48] or "event"
+
+
+def dump_postmortem(dirpath, reason="manual", recorder=None,
+                    registry=None, traces=(), compile_log=(),
+                    config=None, state=None, keep: int = 16):
+    """Write one postmortem bundle into ``dirpath`` and return its
+    path (None on failure — the dump must never take down serving).
+
+    The file name is ``postmortem_<seq>_<reason>.json`` where ``seq``
+    is the recorder's sequence number AFTER recording the dump itself
+    as a ``postmortem`` event — monotone per recorder, so bundles from
+    one run never collide and sort in event order. ``keep`` bounds the
+    directory (oldest bundles beyond it are pruned)."""
+    try:
+        if recorder is not None:
+            recorder.record("postmortem", reason=str(reason))
+        bundle = build_bundle(reason=reason, recorder=recorder,
+                              registry=registry, traces=traces,
+                              compile_log=compile_log, config=config,
+                              state=state)
+        seq = bundle.get("flight", {}).get("seq", 0)
+        os.makedirs(str(dirpath), exist_ok=True)
+        path = os.path.join(
+            str(dirpath), f"postmortem_{int(seq):06d}_{_slug(reason)}.json")
+        _write_bundle(path, bundle)
+        if keep:
+            bundles = sorted(
+                p for p in os.listdir(str(dirpath))
+                if p.startswith("postmortem_") and p.endswith(".json"))
+            for old in bundles[:-int(keep)]:
+                os.remove(os.path.join(str(dirpath), old))
+        log_kv(_log, "postmortem_dumped", level=logging.WARNING,
+               path=path, reason=reason)
+        return path
+    except Exception as e:  # noqa: BLE001 — the dump is best-effort
+        log_kv(_log, "postmortem_dump_failed", level=logging.ERROR,
+               error=type(e).__name__, detail=str(e), reason=reason)
+        return None
+
+
+_DEFAULT: list = [None]
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """Process-default recorder (ad-hoc tooling and the
+    ``python -m paddle_tpu.observability.dump`` CLI). Fleets own
+    PRIVATE recorders — pass ``recorder=get_flight_recorder()`` style
+    wiring to share this one."""
+    with _DEFAULT_LOCK:
+        if _DEFAULT[0] is None:
+            _DEFAULT[0] = FlightRecorder(name="process")
+        return _DEFAULT[0]

@@ -29,6 +29,7 @@ from ..utils.log import get_logger, log_kv
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "get_registry", "merge_snapshots", "now",
+           "quantile_from_buckets",
            "DEFAULT_LATENCY_BUCKETS", "escape_help", "escape_label"]
 
 _log = get_logger("paddle_tpu.observability.metrics")
@@ -348,25 +349,35 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
-def _parse_le(key: str) -> float:
-    return float("inf") if key == "+Inf" else float(key)
+def _parse_le(key) -> float:
+    if isinstance(key, str):
+        return float("inf") if key == "+Inf" else float(key)
+    return float(key)
 
 
-def _merged_quantile(q: float, buckets: dict, total: int, mx) -> float:
-    """Same rule as :meth:`Histogram.quantile`, applied to a merged
-    cumulative-bucket dict (rank = q * total, first inclusive upper
-    edge whose cumulative count reaches it; +Inf resolves to the
-    observed max)."""
-    if total == 0:
-        return 0.0
+def quantile_from_buckets(q: float, buckets: dict, total,
+                          observed_max=None, empty=0.0):
+    """THE percentile-from-cumulative-buckets rule, shared by
+    :func:`merge_snapshots`, the SLO windowed-percentile rules and the
+    StepProfiler phase summaries (ISSUE 13 satellite — this logic used
+    to live in three private copies).
+
+    ``buckets`` maps inclusive upper edges (floats, or the snapshot
+    serialization's string keys with ``"+Inf"``) to CUMULATIVE counts.
+    Rank = ``q * total``; the answer is the first edge whose cumulative
+    count reaches the rank, with the ``+Inf`` bucket resolving to
+    ``observed_max`` (0.0 when unknown). ``total <= 0`` returns
+    ``empty`` — 0.0 for merged snapshots, ``None`` for the SLO delta
+    path (no data = objective met)."""
+    if total is None or total <= 0:
+        return empty
     rank = q * total
+    mx = 0.0 if observed_max is None else observed_max
     for key in sorted(buckets, key=_parse_le):
         if buckets[key] >= rank:
             le = _parse_le(key)
-            if le == float("inf"):
-                return mx if mx is not None else 0.0
-            return le
-    return mx if mx is not None else 0.0
+            return mx if le == float("inf") else le
+    return mx
 
 
 def merge_snapshots(snaps) -> dict:
@@ -416,10 +427,10 @@ def merge_snapshots(snaps) -> dict:
                 acc[k] = b if a is None else (a if b is None
                                               else pick(a, b))
     for name, h in out["histograms"].items():
-        h["p50"] = _merged_quantile(0.5, h["buckets"], h["count"],
-                                    h["max"])
-        h["p99"] = _merged_quantile(0.99, h["buckets"], h["count"],
-                                    h["max"])
+        h["p50"] = quantile_from_buckets(0.5, h["buckets"], h["count"],
+                                         h["max"])
+        h["p99"] = quantile_from_buckets(0.99, h["buckets"], h["count"],
+                                         h["max"])
         # keep the per-registry snapshot key order (count..p99, buckets)
         h["buckets"] = h.pop("buckets")
     return out

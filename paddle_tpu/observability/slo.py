@@ -26,7 +26,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from ..utils.log import get_logger, log_kv
-from .metrics import _parse_le, now
+from .metrics import _parse_le, now, quantile_from_buckets
 
 __all__ = ["SLORule", "SLOEngine", "AlertState"]
 
@@ -111,20 +111,6 @@ def _hist_delta(first: dict | None, last: dict | None):
             buckets[k] = buckets.get(k, 0.0) - c
         count -= first["count"]
     return buckets, count, last.get("max")
-
-
-def _delta_quantile(q: float, buckets: dict, total: float, mx):
-    """Same rank rule as Histogram.quantile over delta buckets."""
-    if total <= 0:
-        return None
-    rank = q * total
-    for key in sorted(buckets, key=_parse_le):
-        if buckets[key] >= rank:
-            le = _parse_le(key)
-            if le == float("inf"):
-                return mx if mx is not None else 0.0
-            return le
-    return mx if mx is not None else 0.0
 
 
 def _bad_fraction(buckets: dict, total: float, threshold: float):
@@ -220,7 +206,10 @@ class SLOEngine:
             if h1 is None:
                 return None, None
             buckets, total, mx = _hist_delta(h0, h1)
-            measured = _delta_quantile(q, buckets, total, mx)
+            # quantile of the bucket-count DELTAS (empty=None: no data
+            # in the window means the objective is met, not breached)
+            measured = quantile_from_buckets(q, buckets, total, mx,
+                                             empty=None)
             if measured is None:
                 return None, None
             budget = max(1.0 - q, 1e-12)

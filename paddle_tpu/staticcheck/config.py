@@ -65,6 +65,13 @@ BUCKET_HELPERS: frozenset = frozenset({"_bucket_window", "_bucket_len"})
 STEP_PATH_ROOTS: tuple = ("ServingFleet.step", "DecodeEngine.step",
                           "DecodeEngine.decode_once")
 
+#: ISSUE 13: observability modules the scan set must always contain.
+#: The flight recorder / step profiler carry their own lock-discipline
+#: and clock-alias invariants (SC01/SC05); a rename that silently drops
+#: them from the glob would un-enforce those. ``scan_paths`` asserts
+#: their presence on every build of the set.
+OBSERVABILITY_PINNED: tuple = ("flight.py", "profiling.py", "dump.py")
+
 
 def _glob(d: pathlib.Path) -> list[pathlib.Path]:
     return sorted(p for p in d.glob("*.py") if p.name != "__pycache__")
@@ -83,8 +90,11 @@ def silent_except_paths() -> list[pathlib.Path]:
 
 
 def scan_paths() -> list[pathlib.Path]:
-    """The full shared scan set, deterministic order."""
-    return (
+    """The full shared scan set, deterministic order. Asserts the
+    ISSUE 13 observability modules are present — a rename that drops
+    them from the glob must fail the build, not quietly narrow the
+    checked set."""
+    paths = (
         _glob(PKG / "inference")
         + _glob(PKG / "observability")
         + [WATCHDOG]
@@ -92,6 +102,13 @@ def scan_paths() -> list[pathlib.Path]:
         + _glob(PKG / "kernels")
         + [REPO_ROOT / "bench.py"]
     )
+    names = {p.name for p in paths}
+    missing = [n for n in OBSERVABILITY_PINNED if n not in names]
+    if missing:
+        raise AssertionError(
+            f"pinned observability modules missing from scan set: "
+            f"{missing} (OBSERVABILITY_PINNED)")
+    return paths
 
 
 #: The serving-stack test harnesses SC04 (and SC08's asserted-name

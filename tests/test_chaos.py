@@ -659,3 +659,171 @@ class TestSatellites:
         for key in ("restarts", "poisoned", "parked", "degradation"):
             assert key in st
         fleet.close()
+
+
+class TestPostmortemBundles:
+    """ISSUE 13: every injected crash leaves a postmortem bundle whose
+    flight ring shows the fault next to the failover it provoked, and
+    the whole observability stack (profiler + recorders + bundles)
+    never perturbs the token stream."""
+
+    def test_bundle_per_crash_with_bit_identical_outputs(self, tmp_path):
+        m = _model()
+        rng = np.random.RandomState(23)
+        prompts = [rng.randint(1, 128, (n,)).astype(np.int32)
+                   for n in (8, 11, 6, 9)]
+        plan = FaultPlan([FaultEvent(1, "worker_crash", worker="w1"),
+                          FaultEvent(2, "worker_crash", worker="w2")])
+
+        def run(with_chaos, pdir=None):
+            fleet = ServingFleet(
+                m, n_workers=3, policy="round_robin",
+                engine_kwargs=ENGINE_KW, profile=with_chaos,
+                postmortem_dir=pdir)
+            inj = None
+            if with_chaos:
+                inj = FaultInjector(plan).install(fleet)
+            reqs = [fleet.submit(p, max_new_tokens=6) for p in prompts]
+            fleet.run_until_drained()
+            outs = [_out(r) for r in reqs]
+            faults = fleet.flight.events(kind="fault")
+            fleet.close()
+            return outs, inj, faults
+
+        base, _, _ = run(False)
+        pdir = tmp_path / "bundles"
+        outs, inj, faults = run(True, pdir=str(pdir))
+        # bit-parity: failover is recompute-resume, the profiler and
+        # bundle dumping are pure observers
+        for a, b in zip(base, outs):
+            np.testing.assert_array_equal(a, b)
+        # the flight ring's fault events ARE the plan signature
+        assert [(e["step"], e["fault"], e["worker"], e["duration"],
+                 e["magnitude"]) for e in faults] == plan.signature()
+        assert [(s, k, w) for s, k, w in inj.fired] == \
+            [(e.step, e.kind, e.worker) for e in plan.events]
+        bundles = sorted(p.name for p in pdir.iterdir()
+                         if p.name.startswith("postmortem_"))
+        crash_bundles = [b for b in bundles if "failover" in b]
+        assert len(crash_bundles) == len(plan)
+        import json
+        doc = json.loads((pdir / crash_bundles[0]).read_text())
+        assert doc["bundle_version"] == 1
+        assert doc["reason"].startswith("failover:w1")
+        kinds = [e["kind"] for e in doc["flight"]["events"]]
+        assert "fault" in kinds and "failover" in kinds
+        assert kinds.index("fault") < kinds.index("failover")
+        # the bundle carries the observatory: compile log + state
+        assert any(e["program"] for e in doc["compile_log"])
+        assert set(doc["state"]["workers"]) == {"w0", "w1", "w2"}
+
+    def test_stall_dumps_bundle(self, tmp_path):
+        """A tripped stall watchdog triggers a bundle BEFORE the fleet
+        harvests the worker (reason ``stall:<wid>``)."""
+        m = _model()
+        fleet = ServingFleet(m, n_workers=2, policy="round_robin",
+                             stall_s=1.0, engine_kwargs=ENGINE_KW,
+                             postmortem_dir=str(tmp_path))
+        plan = FaultPlan([FaultEvent(1, "worker_hang", worker="w0",
+                                     duration=50)])
+        FaultInjector(plan).install(fleet)
+        rng = np.random.RandomState(5)
+        reqs = [fleet.submit(rng.randint(1, 128, (7,)).astype(np.int32),
+                             max_new_tokens=4) for _ in range(3)]
+        t = 0.0
+        for _ in range(6):
+            fleet.step()
+            t += 0.5
+            fleet.check_watchdogs(now=t)
+        fleet.run_until_drained()
+        for r in reqs:
+            _out(r)
+        fleet.close()
+        names = sorted(p.name for p in tmp_path.iterdir())
+        assert any("stall-w0" in n for n in names)
+        stalls = fleet.flight.events(kind="stall")
+        assert stalls and stalls[0]["src"] == "w0"
+
+
+class TestBundleDeterminism:
+    """Two recorders driven by the same scripted clock and events must
+    dump byte-identical bundles — the postmortem format carries no
+    hidden wall-clock state (sorted keys, injected clocks only)."""
+
+    @staticmethod
+    def _scripted(tmpdir):
+        from paddle_tpu.observability import (FlightRecorder,
+                                              dump_postmortem)
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.125
+            return t[0]
+
+        rec = FlightRecorder(capacity=16, clock=clock, name="w0")
+        rec.record("fault", step=3, fault="worker_crash", worker="w0")
+        rec.record("failover", worker="w0", rerouted=2, parked=0)
+        path = dump_postmortem(
+            str(tmpdir), reason="failover:w0", recorder=rec,
+            registry={"counters": {"fleet_failovers_total": 1.0},
+                      "gauges": {}, "histograms": {}},
+            traces=[{"request_id": "r1", "terminal": "retired"}],
+            compile_log=[{"program": "decode_chunk", "bucket_key": 4,
+                          "wall_s": 0.5, "post_warmup": False}],
+            config={"n_workers": 2}, state={"degradation": 0})
+        assert path is not None
+        return path
+
+    def test_same_script_same_bytes(self, tmp_path):
+        a = self._scripted(tmp_path / "a")
+        b = self._scripted(tmp_path / "b")
+        import pathlib
+        pa, pb = pathlib.Path(a), pathlib.Path(b)
+        assert pa.name == pb.name
+        assert pa.read_bytes() == pb.read_bytes()
+
+    def test_keep_prunes_oldest(self, tmp_path):
+        from paddle_tpu.observability import (FlightRecorder,
+                                              dump_postmortem)
+        rec = FlightRecorder(capacity=4, clock=lambda: 1.0)
+        for i in range(5):
+            dump_postmortem(str(tmp_path), reason=f"r{i}",
+                            recorder=rec, keep=3)
+        names = sorted(p.name for p in tmp_path.iterdir())
+        assert len(names) == 3
+        assert names[-1].endswith("_r4.json")
+
+
+class TestProfiledFleetBitIdentical:
+    """ISSUE 13 acceptance: ``profile=True`` (step profiler + compile
+    tracker + always-on flight ring) must leave fleet outputs
+    byte-identical to the unprofiled default."""
+
+    def test_profile_on_off_same_tokens(self):
+        m = _model()
+        rng = np.random.RandomState(17)
+        prompts = [rng.randint(1, 128, (n,)).astype(np.int32)
+                   for n in (5, 12, 9)]
+
+        def run(profile):
+            fleet = ServingFleet(m, n_workers=2, policy="round_robin",
+                                 engine_kwargs=ENGINE_KW,
+                                 profile=profile)
+            reqs = [fleet.submit(p, max_new_tokens=6) for p in prompts]
+            fleet.run_until_drained()
+            outs = [_out(r) for r in reqs]
+            fleet.close()
+            return outs, fleet
+
+        base, fleet_off = run(False)
+        prof, fleet_on = run(True)
+        for a, b in zip(base, prof):
+            np.testing.assert_array_equal(a, b)
+        # off: engines carry no instruments at all
+        assert all(w.engine.profile is None and w.engine.compiles is None
+                   for w in fleet_off.workers)
+        # on: every worker profiled, phases populated, compiles seen
+        s = fleet_on.workers[0].engine.profile.summary()
+        assert s["steps"] > 0 and "launch" in s["phases"]
+        assert fleet_on.workers[0].engine.compiles.stats()["compiles"] > 0
+        assert fleet_on.mark_warm() == 2

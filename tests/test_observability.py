@@ -692,3 +692,361 @@ class TestPrometheusLabels:
         assert 'h_seconds_count{host="a",worker="w3"} 1' in text
         # HELP/TYPE headers stay unlabeled
         assert "# TYPE c_total counter" in text
+
+
+class TestQuantileFromBuckets:
+    """ISSUE 13 satellite: the shared cumulative-bucket quantile rule,
+    exercised on the edge buckets (the dedup target for
+    merge_snapshots / SLO windows / StepProfiler.summary)."""
+
+    def test_empty_returns_empty_default(self):
+        from paddle_tpu.observability import quantile_from_buckets
+        assert quantile_from_buckets(0.5, {}, 0) == 0.0
+        assert quantile_from_buckets(
+            0.99, {"+Inf": 0}, 0, empty=None) is None
+
+    def test_median_lands_on_covering_edge(self):
+        from paddle_tpu.observability import quantile_from_buckets
+        # 3 of 4 samples at/below 2e-4 (second edge): p50 -> 0.0002
+        buckets = {"0.0001": 1, "0.0002": 3, "+Inf": 4}
+        assert quantile_from_buckets(0.5, buckets, 4) == \
+            pytest.approx(2e-4)
+
+    def test_p99_clamps_to_observed_max(self):
+        from paddle_tpu.observability import quantile_from_buckets
+        # all mass in +Inf: without a max the edge would be inf; the
+        # observed max is the honest clamp
+        buckets = {"0.0001": 0, "+Inf": 10}
+        assert quantile_from_buckets(0.99, buckets, 10, 7.5) == 7.5
+
+    def test_float_and_string_keys_agree(self):
+        from paddle_tpu.observability import quantile_from_buckets
+        total = 8
+        s = {"0.0001": 2, "0.0004": 6, "+Inf": 8}
+        f = {1e-4: 2, 4e-4: 6, float("inf"): 8}
+        for q in (0.25, 0.5, 0.9, 0.99):
+            assert quantile_from_buckets(q, s, total) == \
+                pytest.approx(quantile_from_buckets(q, f, total))
+
+    def test_matches_registry_snapshot_quantiles(self):
+        from paddle_tpu.observability import quantile_from_buckets
+        r = MetricsRegistry()
+        h = r.histogram("h_seconds")
+        rng = np.random.RandomState(3)
+        for v in 10 ** rng.uniform(-4, 1, size=64):
+            h.observe(float(v))
+        snap = r.snapshot()["histograms"]["h_seconds"]
+        for q, key in ((0.5, "p50"), (0.99, "p99")):
+            assert quantile_from_buckets(
+                q, snap["buckets"], snap["count"],
+                snap["max"]) == pytest.approx(snap[key], rel=1e-5)
+
+
+class TestFlightRecorder:
+    def _rec(self, **kw):
+        from paddle_tpu.observability import FlightRecorder
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.25
+            return t[0]
+
+        return FlightRecorder(clock=clock, **kw)
+
+    def test_ring_bound_and_drop_accounting(self):
+        rec = self._rec(capacity=4, name="w0")
+        for i in range(10):
+            rec.record("tick", i=i)
+        evts = rec.events()
+        assert len(rec) == 4 and len(evts) == 4
+        assert [e["i"] for e in evts] == [6, 7, 8, 9]
+        snap = rec.snapshot()
+        assert snap["seq"] == 10 and snap["dropped"] == 6
+        assert snap["capacity"] == 4 and snap["name"] == "w0"
+
+    def test_seq_and_clock_stamps(self):
+        rec = self._rec(capacity=8)
+        rec.record("a")
+        rec.record("b")
+        a, b = rec.events()
+        assert (a["seq"], b["seq"]) == (1, 2)
+        assert a["t"] == 0.25 and b["t"] == 0.5
+
+    def test_kind_filter_and_tail(self):
+        rec = self._rec(capacity=16)
+        for i in range(6):
+            rec.record("even" if i % 2 == 0 else "odd", i=i)
+        assert [e["i"] for e in rec.events(kind="odd")] == [1, 3, 5]
+        assert [e["i"] for e in rec.events(n=2)] == [4, 5]
+
+    def test_forwarding_stamps_src(self):
+        fleet = self._rec(capacity=8, name="fleet")
+        w = self._rec(capacity=8, name="w1", forward_to=fleet)
+        w.record("fault", step=3, src="should_be_replaced")
+        local, = w.events()
+        assert local["src"] == "should_be_replaced"  # local keeps it
+        fwd, = fleet.events()
+        assert fwd["kind"] == "fault" and fwd["step"] == 3
+        assert fwd["src"] == "w1"      # forwarded copy is attributed
+
+    def test_fn_gauges_registered(self):
+        from paddle_tpu.observability import FlightRecorder
+        r = MetricsRegistry()
+        rec = FlightRecorder(capacity=2, registry=r)
+        for _ in range(5):
+            rec.record("x")
+        g = r.snapshot()["gauges"]
+        assert g["flight_events_seen"] == 5
+        assert g["flight_events_dropped"] == 3
+
+    def test_clear_keeps_seen(self):
+        rec = self._rec(capacity=4)
+        rec.record("x")
+        rec.clear()
+        assert len(rec) == 0
+        assert rec.snapshot()["seq"] == 1
+
+
+class TestStepProfiler:
+    def _prof(self, **kw):
+        from paddle_tpu.observability import StepProfiler
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.001
+            return t[0]
+
+        return StepProfiler(clock=clock, **kw), t
+
+    def test_phase_ring_and_summary(self):
+        prof, _ = self._prof(capacity=8, worker_id="w0")
+        for _ in range(3):
+            prof.begin_step()
+            with prof.phase("launch"):
+                pass
+            with prof.phase("host_sync"):
+                pass
+            prof.end_step()
+        s = prof.summary()
+        assert s["worker"] == "w0" and s["steps"] == 3
+        assert set(s["phases"]) == {"launch", "host_sync"}
+        ph = s["phases"]["launch"]
+        # ticking clock: every span is exactly one 1ms tick wide
+        assert ph["count"] == 3
+        assert ph["max_s"] == pytest.approx(0.001)
+        assert ph["p50_s"] >= 0.001
+        assert s["step_wall"]["count"] == 3
+
+    def test_rings_are_bounded(self):
+        prof, _ = self._prof(capacity=4)
+        for _ in range(10):
+            prof.begin_step()
+            with prof.phase("publish"):
+                pass
+            prof.end_step()
+        s = prof.summary()
+        assert s["steps"] == 10          # counter keeps counting
+        assert s["window"] == 4          # ring keeps the newest 4
+        assert s["phases"]["publish"]["count"] == 4
+
+    def test_end_step_without_begin_is_none(self):
+        prof, _ = self._prof()
+        assert prof.end_step() is None
+
+    def test_unknown_phase_raises(self):
+        prof, _ = self._prof()
+        with pytest.raises(KeyError):
+            prof.phase("not_a_phase")
+
+    def test_registry_histogram_and_gauges(self):
+        from paddle_tpu.observability import StepProfiler
+        r = MetricsRegistry()
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.002
+            return t[0]
+
+        prof = StepProfiler(clock=clock, registry=r)
+        prof.begin_step()
+        with prof.phase("admission"):
+            pass
+        prof.end_step()
+        snap = r.snapshot()
+        assert snap["histograms"]["engine_step_phase_seconds"][
+            "count"] == 1
+        assert snap["gauges"]["engine_profiled_steps"] == 1
+        assert snap["gauges"]["engine_step_wall_ewma_seconds"] > 0
+
+    def test_outlier_flags_counter_and_flight(self):
+        from paddle_tpu.observability import (FlightRecorder,
+                                              StepProfiler)
+        r = MetricsRegistry()
+        rec = FlightRecorder(capacity=16)
+        t = [0.0]
+        dur = [0.001]
+
+        def clock():
+            t[0] += dur[0]
+            return t[0]
+
+        prof = StepProfiler(clock=clock, registry=r, recorder=rec,
+                            worker_id="w9", outlier_min_steps=4)
+        for _ in range(20):
+            prof.begin_step()
+            prof.end_step()
+        dur[0] = 1.0                     # one pathological step
+        prof.begin_step()
+        prof.end_step()
+        assert r.get("engine_step_outliers_total").value == 1
+        ev, = rec.events(kind="phase_outlier")
+        assert ev["worker"] == "w9" and ev["wall_s"] >= 1.0
+
+    def test_to_events_chrome_shape(self):
+        prof, _ = self._prof(capacity=8, worker_id="w0")
+        prof.begin_step()
+        with prof.phase("launch"):
+            pass
+        prof.end_step()
+        evts = prof.to_events(pid=7)
+        steps = [e for e in evts if e["name"] == "engine.step"]
+        phases = [e for e in evts if e["name"] == "launch"]
+        assert len(steps) == 1 and len(phases) == 1
+        for e in evts:
+            assert e["ph"] == "X" and e["cat"] == "profile"
+            assert e["pid"] == 7 and e["dur"] > 0
+        assert steps[0]["tid"] == 0 and phases[0]["tid"] == 1
+
+
+class TestCompileTracker:
+    def _tracker(self, **kw):
+        from paddle_tpu.observability import CompileTracker
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.5
+            return t[0]
+
+        return CompileTracker(clock=clock, **kw)
+
+    def test_first_seen_signature_counts_once(self):
+        tr = self._tracker()
+        fn = tr.wrap("decode", lambda x: x, key=4)
+        a = np.zeros((2, 4), np.float32)
+        fn(a)
+        fn(a)
+        fn(np.zeros((2, 8), np.float32))    # new shape -> new compile
+        assert tr.stats() == {"compiles": 2, "unexpected": 0,
+                              "warm": False}
+        log = tr.compile_log()
+        assert [e["program"] for e in log] == ["decode", "decode"]
+        assert log[0]["bucket_key"] == 4
+        assert log[0]["wall_s"] == pytest.approx(0.5)
+        assert tr.programs() == {"decode": 2}
+
+    def test_post_warmup_compile_is_unexpected(self):
+        from paddle_tpu.observability import FlightRecorder
+        r = MetricsRegistry()
+        rec = FlightRecorder(capacity=8)
+        tr = self._tracker(registry=r, recorder=rec, worker_id="w1")
+        fn = tr.wrap("prefill", lambda x: x)
+        fn(np.zeros((1, 4), np.int32))
+        tr.warmup_done()
+        fn(np.zeros((1, 4), np.int32))      # seen: no new compile
+        assert tr.stats()["unexpected"] == 0
+        fn(np.zeros((1, 16), np.int32))     # stray shape post-warmup
+        st = tr.stats()
+        assert st == {"compiles": 2, "unexpected": 1, "warm": True}
+        snap = r.snapshot()
+        assert snap["counters"]["engine_compiles_total"] == 2
+        assert snap["gauges"]["engine_unexpected_compiles"] == 1
+        kinds = [e["kind"] for e in rec.events()]
+        assert kinds == ["compile", "unexpected_compile"]
+        assert tr.compile_log()[-1]["post_warmup"] is True
+
+    def test_signature_covers_leaves_and_scalars(self):
+        from paddle_tpu.observability import CompileTracker
+        sig = CompileTracker.signature(
+            (np.zeros((2, 3), np.float32), 7))
+        assert sig == ((((2, 3)), "float32"), "int")
+
+
+class TestDebugHTTPSurface:
+    """ISSUE 13 satellite: /healthz, debug routes, the self-diagnosing
+    404 and explicit Content-Type on every response."""
+
+    def _serve(self, debug=None):
+        from paddle_tpu.inference.fleet_metrics import (
+            MetricsAggregator, MetricsHTTPServer)
+        r = MetricsRegistry()
+        r.counter("c_total").inc()
+        agg = MetricsAggregator({"w0": r})
+        return MetricsHTTPServer(agg, debug=debug).start()
+
+    @staticmethod
+    def _get(srv, path):
+        import urllib.error
+        import urllib.request
+        try:
+            resp = urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}{path}", timeout=10)
+            return resp.status, resp.headers.get("Content-Type"), \
+                resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.headers.get("Content-Type"), e.read()
+
+    def test_healthz(self):
+        srv = self._serve()
+        try:
+            code, ctype, body = self._get(srv, "/healthz")
+        finally:
+            srv.close()
+        assert code == 200 and ctype == "application/json"
+        assert json.loads(body) == {"status": "ok"}
+
+    def test_debug_route_serves_provider_json(self):
+        srv = self._serve(debug={"statusz": lambda: {"x": 1}})
+        try:
+            code, ctype, body = self._get(srv, "/statusz")
+        finally:
+            srv.close()
+        assert code == 200 and ctype == "application/json"
+        assert json.loads(body) == {"x": 1}
+
+    def test_404_lists_served_paths(self):
+        srv = self._serve(debug={"flightz": lambda: []})
+        try:
+            code, ctype, body = self._get(srv, "/nope")
+        finally:
+            srv.close()
+        assert code == 404
+        assert ctype.startswith("text/plain")
+        text = body.decode()
+        for p in ("/metrics", "/metrics.json", "/healthz", "/flightz"):
+            assert p in text
+
+    def test_raising_provider_is_500_not_wedge(self):
+        def boom():
+            raise RuntimeError("kaput")
+
+        srv = self._serve(debug={"statusz": boom})
+        try:
+            code, ctype, body = self._get(srv, "/statusz")
+            # server still answers afterwards
+            ok, _, _ = self._get(srv, "/healthz")
+        finally:
+            srv.close()
+        assert code == 500 and ctype.startswith("text/plain")
+        assert b"RuntimeError" in body and b"kaput" in body
+        assert ok == 200
+
+    def test_metrics_content_types(self):
+        srv = self._serve()
+        try:
+            _, ct_text, _ = self._get(srv, "/metrics")
+            _, ct_json, body = self._get(srv, "/metrics.json")
+        finally:
+            srv.close()
+        assert ct_text.startswith("text/plain")
+        assert ct_json == "application/json"
+        assert "fleet" in json.loads(body)
