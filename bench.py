@@ -49,6 +49,7 @@ _PRESET_METRICS = {
     "mixed": "mixed_p99_ttft_ms",
     "spec": "spec_tokens_per_step",
     "chaos": "chaos_goodput_ratio",
+    "disagg": "disagg_p99_ttft_ms",
     "smoke": "smoke_wall_seconds",
     "tp": "tp_device_calls_per_step",
 }
@@ -1494,6 +1495,163 @@ def bench_chaos():
     }))
 
 
+def bench_disagg():
+    """Prefill/decode disaggregation (ISSUE 14): a seeded two-tenant
+    mix — a prompt-heavy tenant streaming LONG prompts against a chatty
+    tenant holding many live decode rows — drives the SAME 2-worker
+    fleet twice on identical arrivals: role-split (``roles=("prefill",
+    "decode")``, prompts prefill on a dedicated worker and hand their
+    KV pages off over the transplant path) vs unified (``roles=None``,
+    both workers interleave prefill chunks with resident decode rows
+    under the same per-step token budget). Decode residency is what
+    the split removes: unified lanes stay occupied for a row's whole
+    decode, so long prompts queue behind chat decodes and their chunks
+    compete with decode tokens for the step budget; the split worker's
+    lanes turn over at first token. Greedy decode + identical prompts
+    means the outputs-bit-identical oracle rides in ``extra``, and a
+    same-seed repeat of the split run must replay bit-for-bit (the
+    signature carries tokens and migration counters, never wall
+    times). value = split p99 TTFT (ms) for the prompt-heavy tenant;
+    vs_baseline = unified_p99 / split_p99 (> 1 means disaggregation
+    flattened the prompt tenant's tail)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.fleet import ServingFleet
+    from paddle_tpu.inference.traffic import (TenantProfile,
+                                              TrafficGenerator)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    on_tpu = jax.default_backend() not in ("cpu",)
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=4096,
+                          intermediate_size=14336, num_hidden_layers=2,
+                          num_attention_heads=32, num_key_value_heads=8,
+                          max_position_embeddings=4096, dtype="bfloat16")
+        s_max, chunk, bs = 512, 8, 16
+        p_long, p_chat = (192, 320), (8, 24)
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=344, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2)
+        s_max, chunk, bs = 96, 4, 8
+        p_long, p_chat = (32, 72), (4, 12)
+
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    # two generators = two tenants with DIFFERENT prompt shapes (the
+    # generator's prompt distribution is global, so each tenant gets
+    # its own seeded stream); the merged list is the one arrival
+    # schedule every run replays
+    gen_long = TrafficGenerator(
+        [TenantProfile("prompts")], rate=1.0, seed=0,
+        process="poisson", prompt_dist="uniform",
+        prompt_min=p_long[0], prompt_max=p_long[1], max_new=4)
+    gen_chat = TrafficGenerator(
+        [TenantProfile("chat")], rate=4.0, seed=1,
+        process="bursty", prompt_dist="uniform",
+        prompt_min=p_chat[0], prompt_max=p_chat[1], max_new=24)
+    horizon = 8.0
+    arrivals = sorted(
+        [(sr, gen_long, i)
+         for i, sr in enumerate(gen_long.arrivals(horizon))]
+        + [(sr, gen_chat, i)
+           for i, sr in enumerate(gen_chat.arrivals(horizon))],
+        key=lambda a: (a[0].t, a[0].tenant))
+    dt, max_steps = 0.25, 6000
+
+    def run_once(roles):
+        fleet = ServingFleet(
+            model, n_workers=2, policy="round_robin",
+            engine_kwargs=dict(capacity=8, s_max=s_max, chunk=chunk,
+                               block_size=bs, chunked_prefill=True,
+                               # tight budget: decode tokens and
+                               # prefill chunks visibly compete on a
+                               # unified worker
+                               step_budget=chunk + bs),
+            roles=roles)
+        # warmup outside the measurement (mixed-preset idiom): compile
+        # each worker's decode program and the chunk windows the long
+        # prompts ride, so TTFT measures steady-state service, not XLA
+        # compiles landing on whichever run goes first
+        for w in fleet.workers:
+            eng = w.engine
+            wr = eng.submit(np.arange(1, p_long[1] + 1,
+                                      dtype=np.int32),
+                            max_new_tokens=2)
+            while not (eng.idle() and not eng.backlog):
+                eng.admit([])
+                eng.decode_once()
+            wr.wait(timeout=120)
+        vt, reqs, idx = 0.0, [], 0
+        for _ in range(max_steps):
+            while idx < len(arrivals) and arrivals[idx][0].t <= vt:
+                sr, g, gi = arrivals[idx]
+                ids = g.prompt_ids(sr, cfg.vocab_size, index=gi)
+                reqs.append((sr.tenant, fleet.submit(
+                    ids, max_new_tokens=sr.max_new, tenant=sr.tenant)))
+                idx += 1
+            fleet.step()
+            vt += dt
+            if idx >= len(arrivals) and not fleet.pending_work():
+                break
+        outs = [np.asarray(r.result) for _, r in reqs]
+        ttfts = {"prompts": [], "chat": []}
+        for (tenant, r) in reqs:
+            ttfts[tenant].append(r.trace.ttft)
+        st = fleet.stats()
+        sig = {"submitted": idx,
+               "outputs": [o.tolist() for o in outs],
+               "migrations": st["migrations"],
+               "migrated_pages": st["migrated_pages"],
+               "stale_hints": st["stale_hints"]}
+        snap = fleet.aggregator().snapshot()
+        fleet.close()
+        return sig, outs, ttfts, st, snap
+
+    # split FIRST so it pays the cold-compile steps — a split win is
+    # then a floor, not a warm-cache artifact
+    sig_a, outs_split, tt_split, st_split, snap = run_once(
+        ("prefill", "decode"))
+    sig_uni, outs_uni, tt_uni, _, _ = run_once(None)
+    sig_b, _, _, _, _ = run_once(("prefill", "decode"))
+
+    identical = (len(outs_uni) == len(outs_split)
+                 and all(np.array_equal(a, b)
+                         for a, b in zip(outs_uni, outs_split)))
+
+    def p99_ms(vals):
+        return float(np.percentile(np.asarray(vals, np.float64),
+                                   99)) * 1e3
+
+    split_p99 = p99_ms(tt_split["prompts"])
+    uni_p99 = p99_ms(tt_uni["prompts"])
+    snap_path = _dump_metrics_snapshot(None, "disagg", snapshot=snap)
+    print(json.dumps({
+        "metric": "disagg_p99_ttft_ms",
+        "value": round(split_p99, 2),
+        "unit": "ms",
+        "vs_baseline": round(uni_p99 / max(split_p99, 1e-9), 4),
+        "extra": {"arrivals": len(arrivals),
+                  "prompt_tenant_arrivals": len(tt_split["prompts"]),
+                  "chat_tenant_arrivals": len(tt_split["chat"]),
+                  "outputs_identical": identical,
+                  "deterministic": sig_a == sig_b,
+                  "split_p99_ttft_ms": round(split_p99, 2),
+                  "unified_p99_ttft_ms": round(uni_p99, 2),
+                  "split_chat_p99_ttft_ms": round(
+                      p99_ms(tt_split["chat"]), 2),
+                  "unified_chat_p99_ttft_ms": round(
+                      p99_ms(tt_uni["chat"]), 2),
+                  "migrations": st_split["migrations"],
+                  "migrated_pages": st_split["migrated_pages"],
+                  "unified_migrations": sig_uni["migrations"],
+                  "virtual_window_s": round(horizon, 2),
+                  "metrics_snapshot": snap_path,
+                  "backend": jax.default_backend()},
+    }))
+
+
 def bench_smoke():
     """Sub-minute pipeline probe: ONE tiny compiled train step
     (fwd+bwd+AdamW) plus ONE compiled flash-attention fwd+bwd. The
@@ -1598,6 +1756,8 @@ def main():
         return bench_spec()
     if preset == "chaos":
         return bench_chaos()
+    if preset == "disagg":
+        return bench_disagg()
     if preset == "tp":
         return bench_tp()
     if preset == "smoke":

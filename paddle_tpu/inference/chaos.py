@@ -34,6 +34,12 @@ bespoke one):
   admission as a ``step_raised`` worker fault.
 - ``sink_fail`` — every shipper sink raises for the window, exercising
   the r10 backoff/drop accounting.
+- ``migration_fail`` — cross-worker KV transplants involving the target
+  worker raise :class:`ChaosMigrationError` for the window. Migration
+  is an OPTIMIZATION, never a correctness input: the fleet catches the
+  raise and the request cold-prefills on its routed worker (r19's
+  dead-transplant fallback, mirroring the directory's stale-hint
+  rule).
 
 A ``poison_token`` additionally models a POISON REQUEST: while any
 admitted row's prompt contains the token, that worker's step raises
@@ -50,14 +56,22 @@ from dataclasses import dataclass
 
 from ..utils.log import get_logger, log_event, log_kv
 
-__all__ = ["FAULT_KINDS", "FaultEvent", "FaultPlan", "FaultInjector",
-           "ChaosWorkerCrash", "ChaosAllocOOM", "ChaosPoisonError"]
+__all__ = ["FAULT_KINDS", "RANDOM_KINDS", "FaultEvent", "FaultPlan",
+           "FaultInjector", "ChaosWorkerCrash", "ChaosAllocOOM",
+           "ChaosPoisonError", "ChaosMigrationError"]
 
 _log = get_logger("paddle_tpu.inference.chaos")
 
 #: canonical fault vocabulary (see module docstring for semantics)
 FAULT_KINDS = ("worker_crash", "worker_hang", "slow_step", "alloc_oom",
-               "sink_fail")
+               "sink_fail", "migration_fail")
+
+#: :meth:`FaultPlan.random`'s default draw set stays the r14 five —
+#: widening the uniform draw would reshuffle every seeded plan (the
+#: chaos preset's replay signatures are pinned to them). Plans that
+#: want dead transplants opt in with ``kinds=FAULT_KINDS`` or an
+#: explicit event.
+RANDOM_KINDS = FAULT_KINDS[:-1]
 
 
 class ChaosWorkerCrash(RuntimeError):
@@ -71,6 +85,12 @@ class ChaosAllocOOM(MemoryError):
 class ChaosPoisonError(RuntimeError):
     """Injected poison request: raised while a row whose prompt holds
     the injector's ``poison_token`` is admitted on the worker."""
+
+
+class ChaosMigrationError(RuntimeError):
+    """Injected ``migration_fail``: raised from the fleet's transplant
+    path while the window covers either endpoint. The fleet catches it
+    and falls back to a cold prefill — outputs are unaffected."""
 
 
 @dataclass(frozen=True)
@@ -112,7 +132,7 @@ class FaultPlan:
             events, key=lambda e: (e.step, e.kind, e.worker or "")))
 
     @classmethod
-    def random(cls, seed, n_steps, workers, kinds=FAULT_KINDS,
+    def random(cls, seed, n_steps, workers, kinds=RANDOM_KINDS,
                rate=0.05, duration=3, magnitude=1.0):
         """Seeded schedule: each step fires at most one fault with
         probability ``rate``, uniform over ``kinds`` × ``workers``."""
@@ -191,6 +211,7 @@ class FaultInjector:
         self._slow: dict[str, tuple[int, float]] = {}
         self._oom: dict[str, int] = {}     # wid -> last oom step
         self._oom_wrapped: dict[str, tuple] = {}   # wid -> (alloc, fn)
+        self._mig: dict[str, int] = {}     # wid -> last blocked step
         self._sink_until = -1
         self._sink_wrapped: list[tuple] = []       # (_SinkState, sink)
 
@@ -235,6 +256,8 @@ class FaultInjector:
             elif e.kind == "sink_fail":
                 self._sink_until = max(self._sink_until, last)
                 self._wrap_sinks(fleet)
+            elif e.kind == "migration_fail":
+                self._mig[wid] = max(self._mig.get(wid, -1), last)
         self._expire(fleet)
         return events
 
@@ -262,6 +285,17 @@ class FaultInjector:
         so the device-steps heartbeat freezes and the watchdog's
         ``check(now=)`` fires through the normal stall path."""
         return self.step_idx <= self._hang.get(worker.wid, -1)
+
+    def check_migration(self, src_wid, dst_wid) -> None:
+        """Raise while a ``migration_fail`` window covers either
+        endpoint of a transplant (called from the fleet's migration
+        path before any pages move — a dead transplant must fail
+        BEFORE mutating allocator state, like a dead link would)."""
+        for wid in (src_wid, dst_wid):
+            if self.step_idx <= self._mig.get(wid, -1):
+                raise ChaosMigrationError(
+                    f"chaos: injected migration_fail on {wid} at step "
+                    f"{self.step_idx} (transplant {src_wid}->{dst_wid})")
 
     def before_worker_step(self, worker) -> None:
         wid = worker.wid

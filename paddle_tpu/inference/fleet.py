@@ -239,7 +239,7 @@ class GlobalPrefixDirectory:
 class _Worker:
     __slots__ = ("wid", "engine", "registry", "watchdog", "pending",
                  "healthy", "fail_reason", "restarts", "restart_at",
-                 "probation", "deg_saved", "legacy_snap")
+                 "probation", "deg_saved", "legacy_snap", "role")
 
     def __init__(self, wid, engine, registry, watchdog):
         self.wid = wid
@@ -247,6 +247,8 @@ class _Worker:
         self.registry = registry
         self.watchdog = watchdog
         self.pending: list = []         # routed, not yet handed to admit
+        self.role = None                # "prefill"/"decode" under an
+        #                                 ISSUE 14 role split, else None
         self.healthy = True
         self.fail_reason = None
         self.restarts = 0               # completed restarts (ISSUE 9)
@@ -290,12 +292,46 @@ class ServingFleet:
                  stall_s=30.0, registry=None, qos=None,
                  max_retries=2, restart=None, tp_degree=None,
                  profile=False, flight_capacity=512,
-                 postmortem_dir=None, postmortem_keep=16):
+                 postmortem_dir=None, postmortem_keep=16,
+                 roles=None, migration_budget_pages=None):
         if n_workers < 1:
             raise ValueError(f"n_workers={n_workers}")
         if policy not in ("affinity", "round_robin"):
             raise ValueError(f"unknown routing policy {policy!r}")
         self.policy = policy
+        # ISSUE 14: prefill/decode disaggregation. ``roles`` marks each
+        # worker prefill- or decode-heavy: new prompts route to prefill
+        # workers (forced chunked so long prompts stream), and a row
+        # whose prompt finishes hands off — block tables, published
+        # pages and all — to a decode worker over the KV transplant
+        # path (migration.py). ``migration_budget_pages`` separately
+        # bounds warm-prefix migration on ROUTE: when an affinity
+        # directory hit loses to its own load penalty, the chain moves
+        # to the routed winner instead of re-prefilling cold, up to
+        # this many pages per fleet step. Both default OFF — r14
+        # routing/failover behavior and outputs stay bit-identical.
+        self.roles = tuple(roles) if roles is not None else None
+        if self.roles is not None:
+            if len(self.roles) != n_workers:
+                raise ValueError(
+                    f"roles has {len(self.roles)} entries for "
+                    f"n_workers={n_workers}")
+            bad = [r for r in self.roles
+                   if r not in ("prefill", "decode")]
+            if bad:
+                raise ValueError(f"unknown roles {bad!r} (want "
+                                 f"'prefill' or 'decode')")
+            if ("prefill" not in self.roles
+                    or "decode" not in self.roles):
+                raise ValueError(
+                    "a role split needs at least one prefill AND one "
+                    "decode worker")
+        self.migration_budget_pages = (int(migration_budget_pages)
+                                       if migration_budget_pages
+                                       else 0)
+        self._mig_left = self.migration_budget_pages  # guarded-by: _lock
+        #                                 per-step transplant budget;
+        #                                 _step_inner refills it
         kw = dict(engine_kwargs or {})
         kw.setdefault("paged", True)
         kw.pop("qos", None)     # the fleet owns the shared QoS policy
@@ -354,6 +390,17 @@ class ServingFleet:
         self._c_poisoned = self.metrics.counter(
             "fleet_poisoned_total",
             "requests quarantined after max_retries crash attributions")
+        # ISSUE 14: disaggregation accounting
+        self._c_migrations = self.metrics.counter(
+            "fleet_migrations_total",
+            "cross-worker KV chain transplants completed")
+        self._c_migrated_pages = self.metrics.counter(
+            "fleet_kv_migrated_pages_total",
+            "KV pages moved between worker pools")
+        self._c_stale_hints = self.metrics.counter(
+            "fleet_prefix_stale_hints_total",
+            "directory hits refuted by the owning cache at transplant "
+            "time (the hint-only consistency rule observed in action)")
         self.metrics.gauge(
             "fleet_healthy_workers", "workers currently routable",
             fn=lambda: sum(1 for w in self.workers if w.healthy))
@@ -402,7 +449,10 @@ class ServingFleet:
         for i in range(n_workers):
             wid = f"w{i}"
             eng, reg, wd = self._build_worker(wid)
-            self.workers.append(_Worker(wid, eng, reg, wd))
+            w = _Worker(wid, eng, reg, wd)
+            if self.roles is not None:
+                w.role = self.roles[i]
+            self.workers.append(w)
         self._rr = 0                    # round-robin cursor
         self._seq = 0                   # fleet-wide FCFS stamp: keeps
         #                                 _sched_seq unique across the
@@ -434,6 +484,13 @@ class ServingFleet:
         directory repopulates as the new cache publishes)."""
         reg = MetricsRegistry()
         kw = dict(self._engine_kw)
+        if self.roles is not None \
+                and self.roles[int(wid[1:])] == "prefill":
+            # prefill-heavy worker: always chunked, so long prompts
+            # stream through the step budget and finished rows hand
+            # off to a decode worker at page boundaries (ISSUE 14).
+            # Restart rebuilds derive the same role from the wid.
+            kw["chunked_prefill"] = True
         if self.tp_degree is not None:
             import jax
             from .sharding import make_tp_mesh
@@ -482,6 +539,13 @@ class ServingFleet:
         # — unless it is all that's left
         healthy = [w for w in all_healthy if not w.probation] \
             or all_healthy
+        if self.roles is not None:
+            # ISSUE 14 role split: new prompts go to prefill workers
+            # (decode workers receive their rows via handoff). With
+            # every prefill worker down, any healthy worker serves
+            # end-to-end — a degraded fleet beats a dead one.
+            healthy = [w for w in healthy if w.role == "prefill"] \
+                or healthy
         if self.policy == "round_robin" or len(healthy) == 1:
             w = healthy[self._rr % len(healthy)]
             self._rr += 1
@@ -520,6 +584,68 @@ class ServingFleet:
         tr.set_attr("route_reason", info.get("reason", self.policy))
         tr.set_attr("route_candidates", info.get("candidates", []))
         tr.mark("routed", worker=w.wid)
+
+    def _maybe_migrate_locked(self, ids, winner: _Worker) -> None:
+        """Warm-prefix migration on route (ISSUE 14): the affinity
+        score just sent this prompt to ``winner``, but a LOSING
+        candidate held strictly more cached prefix — a directory hit
+        beaten by its own load penalty. Move that chain to the winner
+        (bounded by the per-step page budget) so the routed worker
+        prefills warm instead of cold. Every failure mode — stale
+        hint, full destination pool, injected ``migration_fail``,
+        anything raising — degrades to exactly the cold prefill that
+        would have happened anyway. Lock held by caller."""
+        if (self._mig_left <= 0 or self.policy != "affinity"):
+            return
+        info = getattr(self, "_last_route", None) or {}
+        cands = info.get("candidates") or []
+        win_cached, best = 0, None
+        for c in cands:
+            ct = int(c.get("cached_tokens", 0) or 0)
+            if c.get("worker") == winner.wid:
+                win_cached = ct
+            elif best is None or ct > best[0]:
+                best = (ct, c["worker"])
+        if best is None or best[0] <= win_cached:
+            return
+        src = next((w for w in self.workers
+                    if w.wid == best[1] and w.healthy), None)
+        if src is None:
+            return
+        try:
+            if self.chaos is not None:
+                self.chaos.check_migration(src.wid, winner.wid)
+            from .migration import transplant_prefix
+            res = transplant_prefix(src.engine, winner.engine, ids,
+                                    max_pages=self._mig_left)
+        except Exception as e:  # noqa: BLE001 — a dead transplant
+            # costs one cold prefill, never the request (the chaos
+            # migration_fail fault lands here by design)
+            log_kv(_log, "kv_migration_failed", level=logging.WARNING,
+                   src=best[1], dst=winner.wid,
+                   error=type(e).__name__, detail=str(e))
+            self.flight.record("kv_migration_failed", src=best[1],
+                               dst=winner.wid,
+                               error=type(e).__name__)
+            return
+        if res.reason == "stale":
+            # the directory promised a chain the owner no longer holds
+            # (evicted since the last on_insert) — hint, not truth
+            self._c_stale_hints.inc()
+            return
+        if not res.moved:
+            return
+        self._mig_left -= res.pages
+        self._c_migrations.inc()
+        self._c_migrated_pages.inc(res.pages)
+        # the moved tokens charge the winner's NEXT step budget: KV
+        # bandwidth spent on its behalf is still its pacing debt
+        winner.engine._mig_debt += res.tokens
+        self.flight.record("kv_migrated", src=src.wid,
+                           dst=winner.wid, pages=res.pages,
+                           tokens=res.tokens, fused=res.fused)
+        log_kv(_log, "kv_migrated", level=logging.DEBUG, src=src.wid,
+               dst=winner.wid, pages=res.pages, tokens=res.tokens)
 
     def submit(self, input_ids, max_new_tokens=32,
                priority=0, tenant=None) -> _Request:
@@ -564,6 +690,7 @@ class ServingFleet:
                            req=req.trace.request_id, tenant=tenant)
                     return req
             w = self._route(ids)
+            self._maybe_migrate_locked(ids, w)
             self._stamp_route(req, w)
             w.pending.append(req)
         log_kv(_log, "routed", level=logging.DEBUG, worker=w.wid,
@@ -928,6 +1055,8 @@ class ServingFleet:
             # step-indexed schedule before anything else observes it
             self.chaos.begin_step(self)
         with _phase(self._prof, "schedule"), self._lock:
+            # refill the per-step transplant budget (ISSUE 14)
+            self._mig_left = self.migration_budget_pages
             if self._qos_gate is not None:
                 # buckets refilled since submit: route the released
                 # requests in arrival order before this step's admission
@@ -978,6 +1107,11 @@ class ServingFleet:
                 # a healthy step served: burn down the rejoin warm-up
                 w.probation -= 1
             alive += w.occupancy
+        if self.roles is not None:
+            # ISSUE 14: rows whose prompts just finished on a prefill
+            # worker hand off to decode workers before the next step
+            with _phase(self._prof, "schedule"), self._lock:
+                self._handoff_prefilled_locked()
         if self.shipper is not None:
             # periodic off-host flush rides the step loop; tick() is
             # O(1) between intervals and contains every sink fault, so
@@ -986,6 +1120,86 @@ class ServingFleet:
             with _phase(self._prof, "telemetry"):
                 self.shipper.tick()
         return alive
+
+    def _handoff_prefilled_locked(self) -> None:
+        """Role-split handoff (ISSUE 14): every row on a prefill
+        worker whose prompt has finished (no mid-prefill state left)
+        moves to the least-loaded healthy decode worker — published
+        pages ride the KV transplant, the request re-queues as a
+        recompute-resume (the r7 preemption contract, so outputs stay
+        bit-identical), and the trace gains a ``migrated`` hop. Any
+        failure — injected ``migration_fail``, full decode pool —
+        leaves the row decoding where it is: correct, just not
+        disaggregated. Lock held by caller."""
+        decode = [w for w in self.workers
+                  if w.healthy and w.role == "decode"]
+        if not decode:
+            return
+        for w in self.workers:
+            if not w.healthy or w.role != "prefill":
+                continue
+            if w.engine._cache is None:
+                continue        # no radix path — nothing to transplant
+            for slot, row in enumerate(list(w.engine._rows)):
+                if row is None or "pf_seq" in row:
+                    continue
+                if len(row["toks"]) >= row["req"].max_new:
+                    continue    # retiring on its own this step
+                dst = min(decode, key=lambda d: (d.load, d.wid))
+                self._handoff_row_locked(w, dst, slot)
+
+    def _handoff_row_locked(self, src_w: _Worker, dst_w: _Worker,
+                            slot: int) -> bool:
+        src = src_w.engine
+        row = src._rows[slot]
+        req = row["req"]
+        valid = int(src._lens[slot])
+        bs = src.block_size
+        full = (valid // bs) * bs
+        if full <= 0:
+            return False        # under one page: cheaper to keep
+        try:
+            if self.chaos is not None:
+                self.chaos.check_migration(src_w.wid, dst_w.wid)
+            seq = src._cached_seq(row)[:valid]
+            # publish the finished prompt's full pages (idempotent —
+            # retire would publish the same chain), then transplant
+            src._cache.insert(seq[:full], row["pages"][:full // bs])
+            from .migration import transplant_prefix
+            res = transplant_prefix(src, dst_w.engine, seq[:full])
+        except Exception as e:  # noqa: BLE001 — a failed handoff
+            # keeps the row decoding on the prefill worker
+            log_kv(_log, "kv_handoff_failed", level=logging.WARNING,
+                   src=src_w.wid, dst=dst_w.wid,
+                   error=type(e).__name__, detail=str(e))
+            self.flight.record("kv_migration_failed", src=src_w.wid,
+                               dst=dst_w.wid, error=type(e).__name__)
+            return False
+        if not res.moved:
+            return False
+        # requeue exactly like a preemption harvest: emitted tokens
+        # snapshot to resume, row state released on the source
+        req._resume_toks = list(row["toks"])
+        src._release_row_pages(row)
+        src._tables[slot] = 0
+        src._lens[slot] = 0
+        src._tok[slot] = 0
+        src._rows[slot] = None
+        tr = getattr(req, "trace", None)
+        if tr is not None:
+            tr.add_hop(src_w.wid, dst_w.wid, reason="migrated")
+        dst_w.pending.append(req)
+        dst_w.engine._mig_debt += res.tokens
+        self._c_migrations.inc()
+        self._c_migrated_pages.inc(res.pages)
+        self.flight.record("kv_migrated", src=src_w.wid,
+                           dst=dst_w.wid, pages=res.pages,
+                           tokens=res.tokens, fused=res.fused,
+                           handoff=True)
+        log_kv(_log, "kv_handoff", level=logging.DEBUG,
+               src=src_w.wid, dst=dst_w.wid, pages=res.pages,
+               req=tr.request_id if tr is not None else None)
+        return True
 
     def pending_work(self) -> int:
         """Requests anywhere in flight: routed, scheduled, running, or
@@ -1471,6 +1685,11 @@ class ServingFleet:
             "restarts": int(self._c_restarts.value),
             "poisoned": int(self._c_poisoned.value),
             "parked": n_parked,
+            "migrations": int(self._c_migrations.value),
+            "migrated_pages": int(self._c_migrated_pages.value),
+            "stale_hints": int(self._c_stale_hints.value),
+            "roles": ({w.wid: w.role for w in self.workers}
+                      if self.roles is not None else None),
             "degradation": self._degradation,
             "healthy_workers": sum(1 for w in self.workers if w.healthy),
             "tp_degree": self.tp_degree or 1,

@@ -81,6 +81,16 @@ class BlockAllocator:
         """Live references on ``page`` (0 = not allocated)."""
         return self._rc.get(page, 0)
 
+    @property
+    def conservation_ok(self) -> bool:
+        """The ISSUE 3 invariant as a predicate: every page ever
+        allocated is either still referenced or has been freed —
+        ``total_allocated - total_freed == in_use``. Cross-pool
+        transplants (r19) assert this on BOTH endpoints: a migration
+        uses only allocate/incref/decref, so a violation here means a
+        transplant leaked or double-freed a page."""
+        return self.total_allocated - self.total_freed == self.in_use
+
     def allocate(self, n: int) -> list[int] | None:
         """n pages at refcount 1, all-or-nothing. None when the pool
         can't cover it (caller decides: defer admission, evict cached

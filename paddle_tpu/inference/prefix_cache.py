@@ -145,6 +145,26 @@ class PrefixCache:
             self.hit_tokens += m.cached_len
         return m
 
+    def cached_blocks(self, tokens) -> int:
+        """Peek: how many FULL-page blocks of ``tokens`` this cache
+        holds right now, without taking references, touching the LRU
+        clock, or counting a query. The r19 migration path uses it to
+        size a transplant before pinning anything (the authoritative
+        pin is still :meth:`match` — this is a cheap pre-check, same
+        hint-not-truth rule as the fleet directory)."""
+        bs = self._bs
+        tokens = [int(t) for t in tokens]
+        node = self._root
+        f = 0
+        while (f + 1) * bs <= len(tokens):
+            child = node.children.get(
+                tuple(tokens[f * bs:(f + 1) * bs]))
+            if child is None:
+                break
+            node = child
+            f += 1
+        return f
+
     def release_cow(self, m: PrefixMatch) -> None:
         """Drop the COW-source reference (after the device copy, or when
         the caller decides not to use it)."""

@@ -144,6 +144,15 @@ class DecodeEngine:
         # funding order). Default: every decode lane plus one chunk.
         self.step_budget = int(step_budget) if step_budget \
             else self.capacity * self.chunk + self.prefill_chunk
+        # r19 cross-worker KV transplant plumbing (migration.py): the
+        # fused copy program lands in _transplant_prog lazily (compile-
+        # tracker-wrapped when profiling), and tokens migrated INTO
+        # this engine since the last step charge the next step's budget
+        # as debt — KV bandwidth spent on this engine's behalf that the
+        # pacing unit must still account for. Both stay at their zeros
+        # unless a fleet actually migrates, keeping r18 bit-identical.
+        self._mig_debt = 0
+        self._transplant_prog = None
         # ISSUE 8: self-speculative decoding. The n-gram drafter
         # proposes up to spec_max_draft tokens per row; the engine
         # verifies all of them in ONE position-offset prefill step and
@@ -1572,6 +1581,20 @@ class DecodeEngine:
         self._fail_request(row["req"], err)
         self._retire_paged(slot, publish=False)
 
+    def _step_budget(self):
+        """This step's token budget, pre-charged with migration debt:
+        tokens transplanted INTO this engine since the last step (r19)
+        were KV bandwidth spent on this engine's behalf, so they claim
+        budget force-side before decode lanes and prefill chunks see
+        the remainder. Zero debt — the default, and always when fleet
+        migration is off — builds the identical r12 budget."""
+        from .scheduler import StepBudget
+        budget = StepBudget(self.step_budget)
+        if self._mig_debt:
+            budget.take(self._mig_debt, force=True)
+            self._mig_debt = 0
+        return budget
+
     def _decode_once_paged(self):
         import jax.numpy as jnp
         import numpy as _np
@@ -1583,8 +1606,7 @@ class DecodeEngine:
             # last chunk lands joins THIS step's decode program — its
             # tokens are claimed force-side so the budget histogram
             # reflects the step's real load.
-            from .scheduler import StepBudget
-            budget = StepBudget(self.step_budget)
+            budget = self._step_budget()
             pre = set()
             for slot, row in enumerate(self._rows):
                 if row is not None and "pf_seq" not in row:
@@ -1772,8 +1794,7 @@ class DecodeEngine:
         (inside _verify_row)."""
         drafts = {}
         if self.chunked_prefill:
-            from .scheduler import StepBudget
-            budget = StepBudget(self.step_budget)
+            budget = self._step_budget()
             for slot, row in enumerate(self._rows):
                 if row is not None and "pf_seq" not in row:
                     d = self._draft_for(slot, row)
@@ -1976,8 +1997,7 @@ class DecodeEngine:
         drafts = {}
         chunk_plan = []
         if self.chunked_prefill:
-            from .scheduler import StepBudget
-            budget = StepBudget(self.step_budget)
+            budget = self._step_budget()
             for slot, row in enumerate(self._rows):
                 if row is not None and "pf_seq" not in row:
                     d = _draft(slot, row)

@@ -30,7 +30,8 @@ from __future__ import annotations
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["TP_AXIS", "make_tp_mesh", "validate_tp_config",
-           "stacked_weight_specs", "quant_scale_specs", "pool_specs"]
+           "stacked_weight_specs", "quant_scale_specs", "pool_specs",
+           "same_pool_placement"]
 
 TP_AXIS = "tp"
 
@@ -115,6 +116,22 @@ def quant_scale_specs(scales, axis=TP_AXIS):
         else:
             specs[n] = P()
     return specs
+
+
+def same_pool_placement(mesh_a, mesh_b) -> bool:
+    """True when two engines' pools share one device placement, so a
+    cross-pool page copy can ride ONE fused gather/scatter launch with
+    both pools as live operands (r19 KV transplant). Unsharded engines
+    (mesh=None on both sides) qualify — their pools sit on the same
+    default device — as do engines built over the SAME mesh devices.
+    Fleet workers on disjoint submeshes do NOT: their copy bounces
+    through host memory, the in-process stand-in for the multi-host
+    ICI/RDMA hop."""
+    if mesh_a is None and mesh_b is None:
+        return True
+    if mesh_a is None or mesh_b is None:
+        return False
+    return tuple(mesh_a.devices.flat) == tuple(mesh_b.devices.flat)
 
 
 def pool_specs(n_pool, axis=TP_AXIS):

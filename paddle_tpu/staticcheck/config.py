@@ -56,7 +56,8 @@ TRACED_EXTRA_NAMES: frozenset = frozenset()
 #: finite bucket domain the compiled-program caches are keyed on (the
 #: engine's windows/bucket table). A value that passed through one of
 #: these is sanctioned as a jit cache key.
-BUCKET_HELPERS: frozenset = frozenset({"_bucket_window", "_bucket_len"})
+BUCKET_HELPERS: frozenset = frozenset({"_bucket_window", "_bucket_len",
+                                       "_bucket_pages"})
 
 #: SC07: reachability roots of the serving hot path. Resolved against
 #: the call graph by display name; roots that resolve to nothing are
@@ -71,6 +72,13 @@ STEP_PATH_ROOTS: tuple = ("ServingFleet.step", "DecodeEngine.step",
 #: them from the glob would un-enforce those. ``scan_paths`` asserts
 #: their presence on every build of the set.
 OBSERVABILITY_PINNED: tuple = ("flight.py", "profiling.py", "dump.py")
+
+#: ISSUE 14: inference modules the scan set must always contain. The
+#: KV migration path mutates BOTH endpoints' allocators and donates a
+#: pool — exactly the territory SC06 (bucketed launch shapes) and SC09
+#: (donation rebind, live source operand) exist for. Same rule as the
+#: observability pins: dropping it from the glob must fail the build.
+INFERENCE_PINNED: tuple = ("migration.py",)
 
 
 def _glob(d: pathlib.Path) -> list[pathlib.Path]:
@@ -108,6 +116,11 @@ def scan_paths() -> list[pathlib.Path]:
         raise AssertionError(
             f"pinned observability modules missing from scan set: "
             f"{missing} (OBSERVABILITY_PINNED)")
+    missing = [n for n in INFERENCE_PINNED if n not in names]
+    if missing:
+        raise AssertionError(
+            f"pinned inference modules missing from scan set: "
+            f"{missing} (INFERENCE_PINNED)")
     return paths
 
 
@@ -120,7 +133,7 @@ _NONDET_EXTRA = (
     "test_chaos.py", "test_slo.py", "test_spec_decode.py",
     "test_chunked_prefill.py", "test_prefix_scheduler.py",
     "test_observability.py", "test_paged_attention.py",
-    "test_tp_sharding.py", "test_bench_probe.py")
+    "test_tp_sharding.py", "test_bench_probe.py", "test_migration.py")
 
 
 def nondet_extra_paths() -> list[pathlib.Path]:

@@ -73,6 +73,10 @@ def test_infra_skip_metric_follows_preset(monkeypatch, capsys):
     bench._emit_infra_skip("tunnel down")
     out = json.loads(capsys.readouterr().out.strip())
     assert out["metric"] == "tp_device_calls_per_step"
+    monkeypatch.setenv("BENCH_PRESET", "disagg")
+    bench._emit_infra_skip("tunnel down")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["metric"] == "disagg_p99_ttft_ms"
 
 
 @pytest.mark.slow
@@ -398,6 +402,54 @@ def test_chaos_preset_cpu_smoke(tmp_path):
     assert prof["statusz"]["router_profile"]["steps"] > 0
     assert len(prof["postmortems"]) == extra["postmortem_bundles"]
     assert all(n.startswith("postmortem_") for n in prof["postmortems"])
+
+
+@pytest.mark.slow
+def test_disagg_preset_cpu_smoke(tmp_path):
+    """End-to-end CPU run of BENCH_PRESET=disagg (ISSUE 14 satellite):
+    one JSON line; the role-split and unified runs of the same seeded
+    two-tenant mix produce bit-identical greedy outputs; the split
+    fleet's prompt-tenant p99 TTFT beats unified (the perf claim —
+    decode residency moved off the prefill worker); the same-seed
+    split repeat replays bit-for-bit; and the KV pages genuinely moved
+    over the transplant path (migration counters in the row AND the
+    merged registry snapshot, zero in the unified run)."""
+    env = dict(os.environ, BENCH_PRESET="disagg",
+               BENCH_ALLOW_CPU="1", BENCH_NO_WALL="1",
+               BENCH_SKIP_PROBE="1", BENCH_METRICS_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, bench.__file__], env=env,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1                         # one-JSON-line contract
+    out = json.loads(lines[0])
+    assert out["metric"] == "disagg_p99_ttft_ms"
+    assert out["value"] > 0
+    extra = out["extra"]
+    # the correctness oracle: disaggregation moves WHERE tokens are
+    # computed, never WHICH tokens come out
+    assert extra["outputs_identical"] is True
+    # the same-seed split repeat replays bit-for-bit (tokens AND
+    # migration counters — no wall times in the signature)
+    assert extra["deterministic"] is True
+    # the perf claim: a dedicated prefill worker flattens the
+    # prompt-heavy tenant's TTFT tail
+    assert out["vs_baseline"] > 1.0
+    assert extra["split_p99_ttft_ms"] < extra["unified_p99_ttft_ms"]
+    # pages really rode the transplant path — and only in split mode
+    assert extra["migrations"] > 0
+    assert extra["migrated_pages"] >= extra["migrations"]
+    assert extra["unified_migrations"] == 0
+    snap_path = extra["metrics_snapshot"]
+    assert snap_path == str(tmp_path / "bench_metrics_disagg.json")
+    snap = json.load(open(snap_path))
+    assert set(snap["workers"]) == {"w0", "w1", "router"}
+    merged = snap["fleet"]["counters"]
+    assert merged["fleet_migrations_total"] == extra["migrations"]
+    assert merged["fleet_kv_migrated_pages_total"] == \
+        extra["migrated_pages"]
 
 
 def test_staticcheck_cli_clean_in_process(capsys):

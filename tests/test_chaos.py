@@ -62,7 +62,13 @@ class TestFaultPlan:
             FaultEvent(0, "worker_hang", duration=0)
         assert set(FAULT_KINDS) == {"worker_crash", "worker_hang",
                                     "slow_step", "alloc_oom",
-                                    "sink_fail"}
+                                    "sink_fail", "migration_fail"}
+        # FaultPlan.random's DEFAULT draw set stays the r14 five: a
+        # wider uniform draw would reshuffle every seeded plan and
+        # break the chaos preset's pinned replay signatures (r19)
+        from paddle_tpu.inference.chaos import RANDOM_KINDS
+        assert RANDOM_KINDS == ("worker_crash", "worker_hang",
+                                "slow_step", "alloc_oom", "sink_fail")
 
     def test_events_sorted_and_indexed_by_step(self):
         plan = FaultPlan([FaultEvent(5, "worker_hang", "w0"),
@@ -827,3 +833,65 @@ class TestProfiledFleetBitIdentical:
         assert s["steps"] > 0 and "launch" in s["phases"]
         assert fleet_on.workers[0].engine.compiles.stats()["compiles"] > 0
         assert fleet_on.mark_warm() == 2
+
+
+class TestMigrationFault:
+    """ISSUE 14: ``migration_fail`` kills transplants touching the
+    faulted worker for the window. A dead transplant must fail BEFORE
+    any pages move, and the fleet must fall back to a cold prefill on
+    the routed worker — one slower request, never a wrong one."""
+
+    def test_dead_transplant_cold_prefills(self):
+        m = _model()
+        rng = np.random.RandomState(21)
+        A = rng.randint(1, 128, (24,)).astype(np.int32)
+        fleet = ServingFleet(m, n_workers=2,
+                             engine_kwargs=dict(ENGINE_KW),
+                             migration_budget_pages=8,
+                             load_penalty=100.0)
+        plan = FaultPlan([FaultEvent(0, "migration_fail", "w0",
+                                     duration=10**6)])
+        FaultInjector(plan).install(fleet)
+        r1 = fleet.submit(A, max_new_tokens=8)
+        fleet.run_until_drained()
+        out1 = _out(r1)
+        # pile load on the cached worker so the route would migrate
+        for n in (16, 16, 16):
+            fleet.submit(rng.randint(1, 128, (n,)).astype(np.int32),
+                         max_new_tokens=4)
+        r2 = fleet.submit(A, max_new_tokens=8)
+        st = fleet.stats()
+        assert st["migrations"] == 0       # transplant died, no pages
+        fails = [e for e in fleet.flight.snapshot()["events"]
+                 if e.get("kind") == "kv_migration_failed"]
+        assert fails and fails[0]["error"] == "ChaosMigrationError"
+        fleet.run_until_drained()
+        np.testing.assert_array_equal(out1, _out(r2))  # cold, correct
+        np.testing.assert_array_equal(out1, _solo(m, A, 8).reshape(-1))
+        for w in fleet.workers:
+            assert w.engine._alloc.conservation_ok
+        fleet.close()
+
+    def test_dead_handoff_keeps_row_on_prefill_worker(self):
+        """Role-split under a permanent migration_fail window: every
+        handoff dies, rows decode to completion on the prefill worker,
+        outputs still match the oracle."""
+        m = _model()
+        rng = np.random.RandomState(22)
+        prompts = [rng.randint(1, 128, (n,)).astype(np.int32)
+                   for n in (24, 14)]
+        fleet = ServingFleet(m, n_workers=2,
+                             engine_kwargs=dict(ENGINE_KW),
+                             roles=("prefill", "decode"))
+        plan = FaultPlan([FaultEvent(0, "migration_fail", "w1",
+                                     duration=10**6)])
+        FaultInjector(plan).install(fleet)
+        reqs = [fleet.submit(p, max_new_tokens=8) for p in prompts]
+        fleet.run_until_drained()
+        assert fleet.stats()["migrations"] == 0
+        for p, r in zip(prompts, reqs):
+            np.testing.assert_array_equal(
+                _out(r), _solo(m, p, 8).reshape(-1))
+        for w in fleet.workers:
+            assert w.engine._alloc.conservation_ok
+        fleet.close()
