@@ -291,7 +291,7 @@ class ServingFleet:
                  load_penalty=None, engine_kwargs=None,
                  stall_s=30.0, registry=None, qos=None,
                  max_retries=2, restart=None, tp_degree=None,
-                 profile=False, flight_capacity=512,
+                 seq_degree=None, profile=False, flight_capacity=512,
                  postmortem_dir=None, postmortem_keep=16,
                  roles=None, migration_budget_pages=None):
         if n_workers < 1:
@@ -344,13 +344,26 @@ class ServingFleet:
         # submesh.
         kw.pop("mesh", None)    # per-worker submeshes only
         self.tp_degree = int(tp_degree) if tp_degree else None
-        if self.tp_degree is not None:
+        # ISSUE 16: seq_degree adds the second mesh axis per worker —
+        # worker i's submesh becomes the 2-D (seq, tp) grid over
+        # devices [i*tp*seq, (i+1)*tp*seq). Normalized so seq_degree=1
+        # is byte-identical to the 1-D fleet.
+        sq = int(seq_degree) if seq_degree else 1
+        self.seq_degree = sq if sq > 1 else None
+        if self.tp_degree is not None or self.seq_degree is not None:
             import jax
             n_dev = len(jax.devices())
-            if n_workers * self.tp_degree > n_dev:
+            per = (self.tp_degree or 1) * (self.seq_degree or 1)
+            if self.seq_degree is None:
+                if n_workers * per > n_dev:
+                    raise ValueError(
+                        f"n_workers={n_workers} x tp_degree="
+                        f"{self.tp_degree} exceeds {n_dev} devices")
+            elif n_workers * per > n_dev:
                 raise ValueError(
                     f"n_workers={n_workers} x tp_degree="
-                    f"{self.tp_degree} exceeds {n_dev} devices")
+                    f"{self.tp_degree or 1} x seq_degree="
+                    f"{self.seq_degree} exceeds {n_dev} devices")
         # ISSUE 6: one QoSPolicy shared by the router (token-bucket
         # admission at submit, shed planning) and every worker engine
         # (fair-share scheduling weights). The fleet's gate is the only
@@ -491,7 +504,18 @@ class ServingFleet:
             # off to a decode worker at page boundaries (ISSUE 14).
             # Restart rebuilds derive the same role from the wid.
             kw["chunked_prefill"] = True
-        if self.tp_degree is not None:
+        if self.seq_degree is not None:
+            # ISSUE 16: 2-D (seq, tp) submesh per worker. Derived from
+            # the wid like the 1-D path, so a restarted worker rebuilds
+            # the SAME 2-D submesh.
+            import jax
+            from .sharding import make_mesh
+            i = int(wid[1:])
+            per = (self.tp_degree or 1) * self.seq_degree
+            kw["mesh"] = make_mesh(
+                self.tp_degree or 1, self.seq_degree,
+                devices=jax.devices()[i * per:(i + 1) * per])
+        elif self.tp_degree is not None:
             import jax
             from .sharding import make_tp_mesh
             i = int(wid[1:])
@@ -1368,6 +1392,7 @@ class ServingFleet:
         config = {"n_workers": len(self.workers),
                   "policy": self.policy,
                   "tp_degree": self.tp_degree or 1,
+                  "seq_degree": self.seq_degree or 1,
                   "max_retries": self.max_retries,
                   "engine_kwargs": dict(self._engine_kw)}
         return dump_postmortem(
@@ -1693,6 +1718,7 @@ class ServingFleet:
             "degradation": self._degradation,
             "healthy_workers": sum(1 for w in self.workers if w.healthy),
             "tp_degree": self.tp_degree or 1,
+            "seq_degree": self.seq_degree or 1,
             "directory": self.directory.stats(),
             "workers": {w.wid: w.engine.stats() for w in self.workers},
         }

@@ -22,6 +22,18 @@ when its last reader lets go. ``free`` keeps its r6 loud-error
 semantics and additionally refuses to free a page something else still
 references — sharing makes a unilateral free exactly the aliasing bug
 the allocator exists to prevent.
+
+STRIPING (2-D mesh, ISSUE 16): under a ``seq``-sharded pool, seq shard
+``s`` physically holds pages ``[s·N/seq, (s+1)·N/seq)``. The allocator
+partitions its free list into ``stripes`` such ranges and ``allocate``
+draws page ``i`` from stripe ``(start_col + i) % stripes``, where
+``start_col`` is the block-table column the first new page will occupy.
+That maintains the invariant *the page at table column j always lives
+in stripe j % stripes*, so each seq shard's attention gathers exactly
+the strided columns ``shard, shard+seq, ...`` of every table — a dense
+1/seq slice, no masking of foreign pages. COW inherits the invariant
+for free: the copy replaces a page at the SAME column, so src and dst
+share a stripe and the on-device copy never crosses shards.
 """
 
 from __future__ import annotations
@@ -35,14 +47,32 @@ class BlockAllocator:
     """Refcounted free-list over page ids ``1..n_blocks-1`` (page 0 =
     NULL)."""
 
-    def __init__(self, n_blocks: int):
+    def __init__(self, n_blocks: int, stripes: int = 1):
         if n_blocks < 2:
             raise ValueError(
                 f"n_blocks={n_blocks}: need at least one allocatable "
                 f"page beyond the reserved NULL page")
+        if stripes < 1:
+            raise ValueError(f"stripes={stripes}")
+        if n_blocks % stripes:
+            raise ValueError(
+                f"n_blocks={n_blocks} not divisible by stripes="
+                f"{stripes} (each seq shard holds n_blocks/stripes "
+                f"pages)")
+        if stripes > 1 and n_blocks // stripes < 2:
+            raise ValueError(
+                f"n_blocks={n_blocks} with stripes={stripes}: stripe 0 "
+                f"loses a page to NULL, leaving it empty")
         self.n_blocks = int(n_blocks)
-        # LIFO: freed pages are reused first
-        self._free = list(range(self.n_blocks - 1, NULL_PAGE, -1))
+        self.stripes = int(stripes)
+        self._stripe_size = self.n_blocks // self.stripes
+        # Per-stripe LIFO free lists: freed pages are reused first.
+        # stripes=1 degenerates to the single r6 free list; NULL_PAGE
+        # (page 0, stripe 0) is never listed.
+        self._frees = [
+            list(range((s + 1) * self._stripe_size - 1,
+                       max(s * self._stripe_size - 1, NULL_PAGE), -1))
+            for s in range(self.stripes)]
         self._rc: dict[int, int] = {}   # page -> live reference count
         self.track_allocations = False  # int8 engines flip this on
         self._handed_out: list[int] = []  # since last drain_allocated()
@@ -63,7 +93,11 @@ class BlockAllocator:
 
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._frees)
+
+    def stripe_of(self, page: int) -> int:
+        """The stripe (= seq shard) that physically holds ``page``."""
+        return page // self._stripe_size
 
     @property
     def num_used(self) -> int:
@@ -91,15 +125,46 @@ class BlockAllocator:
         transplant leaked or double-freed a page."""
         return self.total_allocated - self.total_freed == self.in_use
 
-    def allocate(self, n: int) -> list[int] | None:
+    def shortfall(self, n: int, start_col: int = 0) -> int:
+        """Pages missing for ``allocate(n, start_col)`` to succeed
+        (0 = it will). Striped allocators count per STRIPE — free
+        pages in another stripe can't satisfy a starved one, so the
+        reclamation path must not stop at the global free count."""
+        if self.stripes == 1:
+            return max(0, n - len(self._frees[0]))
+        need = [0] * self.stripes
+        for i in range(n):
+            need[(start_col + i) % self.stripes] += 1
+        return sum(max(0, need[s] - len(self._frees[s]))
+                   for s in range(self.stripes))
+
+    def allocate(self, n: int, start_col: int = 0) -> list[int] | None:
         """n pages at refcount 1, all-or-nothing. None when the pool
         can't cover it (caller decides: defer admission, evict cached
-        pages, preempt a row, or fail the one row that needed growth)."""
+        pages, preempt a row, or fail the one row that needed growth).
+
+        ``start_col`` is the block-table column page 0 of this request
+        will occupy (striped allocators only): page ``i`` comes from
+        stripe ``(start_col + i) % stripes``, preserving the
+        column-residency invariant. All-or-nothing is per STRIPE — a
+        request can fail with free pages elsewhere, same as a sharded
+        pool would physically."""
         if n < 0:
             raise ValueError(f"allocate({n})")
-        if n > len(self._free):
-            return None
-        pages = [self._free.pop() for _ in range(n)]
+        if self.stripes == 1:
+            free = self._frees[0]
+            if n > len(free):
+                return None
+            pages = [free.pop() for _ in range(n)]
+        else:
+            need = [0] * self.stripes
+            for i in range(n):
+                need[(start_col + i) % self.stripes] += 1
+            if any(need[s] > len(self._frees[s])
+                   for s in range(self.stripes)):
+                return None
+            pages = [self._frees[(start_col + i) % self.stripes].pop()
+                     for i in range(n)]
         for p in pages:
             self._rc[p] = 1
         if self.track_allocations:
@@ -139,7 +204,7 @@ class BlockAllocator:
             self._rc[page] = rc - 1
         else:
             del self._rc[page]
-            self._free.append(page)
+            self._frees[self.stripe_of(page)].append(page)
             self.total_freed += 1
 
     def free(self, pages) -> None:
@@ -157,13 +222,18 @@ class BlockAllocator:
                     f"free of page {p} with {rc} live references — "
                     f"shared pages release via decref")
             del self._rc[p]
-            self._free.append(p)
+            self._frees[self.stripe_of(p)].append(p)
             self.total_freed += 1
 
     def stats(self) -> dict:
-        """Occupancy snapshot (bench/engine observability)."""
-        return {"capacity": self.capacity, "used": self.num_used,
-                "free": self.num_free,
-                "high_watermark": self.high_watermark,
-                "total_allocated": self.total_allocated,
-                "total_freed": self.total_freed}
+        """Occupancy snapshot (bench/engine observability). The
+        ``stripes`` key appears only on striped allocators so the r6
+        snapshot shape is byte-stable for 1-D engines."""
+        out = {"capacity": self.capacity, "used": self.num_used,
+               "free": self.num_free,
+               "high_watermark": self.high_watermark,
+               "total_allocated": self.total_allocated,
+               "total_freed": self.total_freed}
+        if self.stripes > 1:
+            out["stripes"] = self.stripes
+        return out

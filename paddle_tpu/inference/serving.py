@@ -102,7 +102,8 @@ class DecodeEngine:
                  prefix_listener=None, qos=None, chunked_prefill=False,
                  prefill_chunk=None, step_budget=None,
                  spec_decode=False, spec_max_draft=4, kv_dtype="fp",
-                 mesh=None, tp_axis="tp", profile=None, recorder=None):
+                 mesh=None, tp_axis="tp", seq_axis="seq", profile=None,
+                 recorder=None):
         from ..distributed.fleet.mp_layers import current_mesh
         from ..models.llama import _pp_degree
         if _pp_degree(current_mesh()) > 1:
@@ -118,6 +119,33 @@ class DecodeEngine:
         self.paged = bool(paged)
         self.block_size = int(block_size)
         self._prefix_on = bool(prefix_cache) and self.paged
+        # ISSUE 10/16: mesh PARSE sits before the sizing defaults — the
+        # 2-D mesh's seq degree shapes the n_blocks striping and the
+        # default prefill chunk width. ``mesh=`` shards the paged block
+        # pools (and int8 page scales) over the kv-head axis — and,
+        # when the mesh carries a ``seq`` axis, their page axis too —
+        # lowering every paged program through jit + shard_map; the
+        # allocator, block tables, scheduler, prefix cache, and QoS
+        # stay host-side and replicated, so r7-r14 semantics carry over
+        # unchanged. mesh=None keeps the r14 single-device programs
+        # bit-identical; a seq extent of 1 keeps the r15 1-D programs.
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        self.seq_axis = seq_axis
+        self._tp = 1
+        self._seq = 1
+        if mesh is not None:
+            if not self.paged:
+                raise ValueError(
+                    "mesh= requires the paged engine (the block pools "
+                    "are what shards)")
+            if tp_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"mesh axes {mesh.axis_names} have no "
+                    f"tp_axis={tp_axis!r}")
+            self._tp = int(mesh.shape[tp_axis])
+            if seq_axis in mesh.axis_names:
+                self._seq = int(mesh.shape[seq_axis])
         # ISSUE 7: Sarathi-style chunked prefill. Admission allocates
         # pages but defers the prompt forward; decode_once() feeds
         # page-sized chunks through the r7 bucketed position-offset
@@ -131,12 +159,16 @@ class DecodeEngine:
             raise ValueError(
                 "chunked_prefill requires the paged engine (chunks "
                 "scatter into the block pool)")
-        # chunk size in tokens (default: one KV page). Chunk windows
-        # ride the existing bucketed prefix-prefill programs — powers
-        # of two from 16 — so chunking compiles NO shape beyond the r7
-        # bucket set.
+        # chunk size in tokens (default: one KV page PER SEQ SHARD —
+        # context parallelism's scheduling dividend: a 2-D engine moves
+        # seq× more prompt tokens per chunk launch at the same
+        # per-shard page cost, so one giant prompt stops monopolizing
+        # the step budget). Chunk windows ride the existing bucketed
+        # prefix-prefill programs — powers of two from 16 — so chunking
+        # compiles NO shape beyond the r7 bucket set. seq=1 keeps the
+        # r19 one-page default byte-exactly.
         self.prefill_chunk = int(prefill_chunk) if prefill_chunk \
-            else self.block_size
+            else self.block_size * self._seq
         if self.prefill_chunk <= 0:
             raise ValueError(f"prefill_chunk={prefill_chunk!r}")
         # per-step token budget: decode lanes claim theirs first, the
@@ -207,36 +239,27 @@ class DecodeEngine:
             if n_blocks is None:
                 # full occupancy never starves: every row can grow to
                 # s_max (ceil(s_max/bs) pages), plus the reserved NULL
-                n_blocks = self.capacity * -(-self.s_max
-                                             // self.block_size) + 1
+                # — per SEQ STRIPE, so each stripe can fund its share
+                # of every row's column-striped pages (stripe 0 also
+                # absorbs the NULL page). seq=1 reduces exactly to the
+                # r7 formula.
+                per = -(-self.s_max // self.block_size)
+                n_blocks = self._seq * (
+                    self.capacity * -(-per // self._seq) + 1)
             self.n_blocks = int(n_blocks)
             if qos is not None:
                 from .qos import FairShareScheduler
                 self._sched = FairShareScheduler(qos)
             else:
                 self._sched = RequestScheduler()
-        # ISSUE 10: tensor-parallel sharded engine. ``mesh=`` shards
-        # the paged block pools (and int8 page scales) over the kv-head
-        # axis and lowers every paged program through jit + shard_map;
-        # the allocator, block tables, scheduler, prefix cache, and QoS
-        # stay host-side and replicated, so r7-r14 semantics carry over
-        # unchanged. mesh=None keeps the r14 single-device programs
-        # bit-identical.
-        self.mesh = mesh
-        self.tp_axis = tp_axis
-        self._tp = 1
         if mesh is not None:
-            if not self.paged:
-                raise ValueError(
-                    "mesh= requires the paged engine (the block pools "
-                    "are what shards)")
-            if tp_axis not in mesh.axis_names:
-                raise ValueError(
-                    f"mesh axes {mesh.axis_names} have no "
-                    f"tp_axis={tp_axis!r}")
-            from .sharding import validate_tp_config
-            self._tp = int(mesh.shape[tp_axis])
-            validate_tp_config(model.config, self._tp)
+            # aggregate divisibility check (satellite: EVERY violated
+            # constraint in one message) — after n_blocks is known so
+            # the page-striping requirement is included.
+            from .sharding import validate_mesh_config
+            validate_mesh_config(
+                model.config, self._tp, self._seq,
+                n_blocks=self.n_blocks if self.paged else None)
         self.device_steps = 0           # decode steps actually executed
         self.prefills = 0
         self.resets = 0                 # cache resets (init counts as 1)
@@ -302,6 +325,10 @@ class DecodeEngine:
                 "tensor-parallel degree of the engine's device mesh "
                 "(1 = unsharded)",
                 fn=lambda: self._tp)
+        r.gauge("engine_seq_degree",
+                "sequence-parallel degree of the engine's device mesh "
+                "(pages sharded over the seq axis; 1 = unsharded)",
+                fn=lambda: self._seq)
         # ISSUE 7: chunked-prefill observability beside the existing
         # prefill counter — chunks per step and the step's token load
         self._c_prefill_chunks = r.counter(
@@ -386,8 +413,15 @@ class DecodeEngine:
         # ISSUE 10: inside a shard_map region the paged programs run on
         # kv-head shards and finish row-parallel matmuls with a psum
         # over this axis; mesh=None compiles the identical r14 programs
-        # (mp=None makes every _mp_sum the identity).
+        # (mp=None makes every _mp_sum the identity). ISSUE 16: ``sq``
+        # additionally page-shards the pools — pool writes rebase
+        # through ownership masks and attention merges per-shard
+        # softmax partials. A seq extent of 1 threads sq=None, so the
+        # r15 1-D programs compile byte-identically.
         mp = self.tp_axis if self.mesh is not None else None
+        sq = self.seq_axis \
+            if self.mesh is not None and self._seq > 1 else None
+        n_sq = self._seq
 
         def _weights():
             st = {n: m._parameters[n]._value for n in self._names}
@@ -461,7 +495,7 @@ class DecodeEngine:
                 last_index=self.s_max - 1, mp_axis=mp)
             out = _llama.scatter_prefill_kv(
                 pool[0], pool[1], ks, vs, table_row, pad_len[0],
-                kv_scales=_kv_scales_of(pool))
+                kv_scales=_kv_scales_of(pool), seq_axis=sq)
             return (jnp.argmax(logits, axis=-1), *out)
 
         def decode_chunk_paged(stacked, embed, fnorm, lm, scales, tok,
@@ -478,7 +512,7 @@ class DecodeEngine:
                 out = _llama._paged_decode_step(
                     cfg, stacked, embed, fnorm, lm, tok, carry[1],
                     carry[2], tables, lens + i, *carry[3:],
-                    mp_axis=mp)
+                    mp_axis=mp, seq_axis=sq, n_seq=n_sq)
                 nxt = jnp.argmax(out[0], axis=-1)
                 return (nxt, *out[1:]), nxt
 
@@ -502,7 +536,8 @@ class DecodeEngine:
                 out = _llama.prefix_prefill(
                     cfg, stacked, embed, fnorm, lm, ids, pad_len,
                     prefix_len, pool[0], pool[1], table_row,
-                    kv_scales=_kv_scales_of(pool), mp_axis=mp)
+                    kv_scales=_kv_scales_of(pool), mp_axis=mp,
+                    seq_axis=sq, n_seq=n_sq)
                 return (jnp.argmax(out[0], axis=-1), *out[1:])
 
             return prefill_prefix
@@ -527,7 +562,7 @@ class DecodeEngine:
                     cfg, stacked, embed, fnorm, lm, ids, pad_len,
                     prefix_len, pool[0], pool[1], table_row,
                     kv_scales=_kv_scales_of(pool), all_logits=True,
-                    mp_axis=mp)
+                    mp_axis=mp, seq_axis=sq, n_seq=n_sq)
                 return (jnp.argmax(out[0], axis=-1), *out[1:])
 
             return verify_prefill
@@ -546,7 +581,7 @@ class DecodeEngine:
                 lm = embed.T
             return _llama.mixed_paged_step(
                 cfg, stacked, embed, fnorm, lm, ids, q_lens, kv_lens,
-                tables, *pool, mp_axis=mp)
+                tables, *pool, mp_axis=mp, seq_axis=sq, n_seq=n_sq)
 
         def cow_copy(src, dst, *pool):
             """Copy-on-write: clone page ``src`` into the row's private
@@ -555,6 +590,20 @@ class DecodeEngine:
             admission reuses this one program."""
             out = tuple(a.at[:, dst].set(a[:, src]) for a in pool)
             return out
+
+        def cow_copy_seq(src, dst, *pool):
+            """Page-sharded COW (2-D mesh): the striped allocator
+            guarantees src and dst occupy the SAME table column, hence
+            the same stripe — so the copy is shard-LOCAL (no cross-seq
+            collective). Non-owning shards clamp the read and drop the
+            write."""
+            n_local = pool[0].shape[1]
+            off0 = jax.lax.axis_index(sq) * n_local
+            rs = jnp.clip(src - off0, 0, n_local - 1)
+            owned = (dst >= off0) & (dst < off0 + n_local)
+            wd = jnp.where(owned, dst - off0, n_local)
+            return tuple(a.at[:, wd].set(a[:, rs], mode="drop")
+                         for a in pool)
 
         self._make_decode = make_decode
         self._decode_progs = {}
@@ -580,7 +629,7 @@ class DecodeEngine:
             ax = self.tp_axis
             wsp = stacked_weight_specs(self._names, ax)
             ssp = quant_scale_specs(self._scales, ax)
-            psp = pool_specs(self._n_pool, ax)
+            psp = pool_specs(self._n_pool, ax, seq_axis=sq)
 
             def _tp_wrap(fn, n_data):
                 """(weights..., scales, <n_data host args>, *pool) →
@@ -593,9 +642,10 @@ class DecodeEngine:
                               *([_R] * n_data), *psp),
                     out_specs=(_R, *psp))
 
-            cow_wrapped = _shard_map(cow_copy, mesh=self.mesh,
-                                     in_specs=(_R, _R, *psp),
-                                     out_specs=psp)
+            cow_wrapped = _shard_map(
+                cow_copy_seq if sq is not None else cow_copy,
+                mesh=self.mesh, in_specs=(_R, _R, *psp),
+                out_specs=psp)
 
             def _placed_weights(_cache={}):
                 # device_put ONCE per engine: stacked weights land
@@ -735,7 +785,9 @@ class DecodeEngine:
                 import jax
                 from jax.sharding import NamedSharding
                 from .sharding import pool_specs
-                psp = pool_specs(4 if self._kv_q else 2, self.tp_axis)
+                psp = pool_specs(
+                    4 if self._kv_q else 2, self.tp_axis,
+                    seq_axis=(self.seq_axis if self._seq > 1 else None))
                 put = lambda a, sp: jax.device_put(
                     a, NamedSharding(self.mesh, sp))
                 self._kp = put(self._kp, psp[0])
@@ -743,7 +795,8 @@ class DecodeEngine:
                 if self._kv_q:
                     self._kscale = put(self._kscale, psp[2])
                     self._vscale = put(self._vscale, psp[3])
-            self._alloc = BlockAllocator(self.n_blocks)
+            self._alloc = BlockAllocator(self.n_blocks,
+                                         stripes=self._seq)
             # int8: recycled pages must drop the previous tenant's
             # running-max scale before their next write
             self._alloc.track_allocations = self._kv_q
@@ -845,6 +898,7 @@ class DecodeEngine:
              "device_steps": self.device_steps,
              "device_calls": int(self._c_device_calls.value),
              "tp_degree": self._tp,
+             "seq_degree": self._seq,
              "prefills": self.prefills,
              "resets": self.resets}
         if self.mesh is not None:
@@ -1133,7 +1187,7 @@ class DecodeEngine:
                emitted=len(req._resume_toks or []))
 
     def _reclaim_allocate(self, need, prio, exclude=None,
-                          claimant=None):
+                          claimant=None, start_col=0):
         """allocate() with reclamation: evict unreferenced cached pages
         first, then preempt strictly-lower-priority rows (each
         preemption parks its pages in the cache, so the follow-up evict
@@ -1141,13 +1195,15 @@ class DecodeEngine:
         ``need``. ``claimant`` is the request driving the reclamation —
         under fair-share QoS the PREEMPTING tenant is charged the
         victim's resident tokens, so a tenant cannot launder work
-        through evictions (ISSUE 6)."""
-        pages = self._alloc.allocate(need)
+        through evictions (ISSUE 6). ``start_col`` is the block-table
+        column the first page will occupy — striped allocators (2-D
+        mesh) pick stripes from it to keep column j in stripe
+        j % seq."""
+        pages = self._alloc.allocate(need, start_col)
         if pages is not None:
             return pages
         if self._cache is not None:
-            self._evict_cached(need - self._alloc.num_free)
-            pages = self._alloc.allocate(need)
+            pages = self._evict_allocate(need, start_col)
             if pages is not None:
                 return pages
         while True:
@@ -1161,9 +1217,25 @@ class DecodeEngine:
             if claimant is not None:
                 self._qos_charge(claimant, evicted_tokens)
             if self._cache is not None:
-                self._evict_cached(need - self._alloc.num_free)
-            pages = self._alloc.allocate(need)
+                pages = self._evict_allocate(need, start_col)
+            else:
+                pages = self._alloc.allocate(need, start_col)
             if pages is not None:
+                return pages
+
+    def _evict_allocate(self, need, start_col=0):
+        """Evict cached pages, then allocate — repeating while eviction
+        still frees something. One round suffices for an unstriped pool
+        (and stripes=1 keeps the single-round r14 behavior exactly),
+        but the LRU evictor frees pages by AGE, not by stripe, so a
+        striped pool may need several rounds before the starved
+        stripe's cached pages finally drain."""
+        while True:
+            freed = self._evict_cached(
+                self._alloc.shortfall(need, start_col))
+            pages = self._alloc.allocate(need, start_col)
+            if pages is not None or not freed \
+                    or self._alloc.stripes == 1:
                 return pages
 
     def _evict_cached(self, n):
@@ -1205,7 +1277,7 @@ class DecodeEngine:
             f = len(m.pages) if m is not None else 0
             pages = self._reclaim_allocate(total_need - f,
                                            self._prio(req),
-                                           claimant=req)
+                                           claimant=req, start_col=f)
             if pages is None and m is not None and m.cached_len:
                 # the match's own references pin otherwise-evictable
                 # pages: retry COLD so the infeasibility test below is
@@ -1652,7 +1724,8 @@ class DecodeEngine:
                 continue
             pages = self._reclaim_allocate(extra, self._prio(row["req"]),
                                            exclude=slot,
-                                           claimant=row["req"])
+                                           claimant=row["req"],
+                                           start_col=len(row["pages"]))
             if pages is None and self.chunked_prefill:
                 # a decode-complete row's growth outranks equal-or-
                 # lower-priority rows still MID-prefill: they lose the
@@ -1672,8 +1745,10 @@ class DecodeEngine:
                     self._preempt_row(v)
                     self._qos_charge(row["req"], evicted)
                     if self._cache is not None:
-                        self._evict_cached(extra - self._alloc.num_free)
-                    pages = self._alloc.allocate(extra)
+                        self._evict_cached(self._alloc.shortfall(
+                            extra, len(row["pages"])))
+                    pages = self._alloc.allocate(
+                        extra, len(row["pages"]))
             if pages is None:
                 others = any(r is not None and i != slot
                              for i, r in enumerate(self._rows))
@@ -1854,7 +1929,8 @@ class DecodeEngine:
         if extra <= 0:
             return True
         pages = self._reclaim_allocate(extra, self._prio(req),
-                                       exclude=slot, claimant=req)
+                                       exclude=slot, claimant=req,
+                                       start_col=len(row["pages"]))
         if pages is None and self.chunked_prefill:
             my_p = self._prio(req)
             pf = [i for i, r in enumerate(self._rows)
@@ -1867,8 +1943,9 @@ class DecodeEngine:
                 self._preempt_row(v)
                 self._qos_charge(req, evicted)
                 if self._cache is not None:
-                    self._evict_cached(extra - self._alloc.num_free)
-                pages = self._alloc.allocate(extra)
+                    self._evict_cached(self._alloc.shortfall(
+                        extra, len(row["pages"])))
+                pages = self._alloc.allocate(extra, len(row["pages"]))
         if pages is None:
             others = any(r is not None and i != slot
                          for i, r in enumerate(self._rows))

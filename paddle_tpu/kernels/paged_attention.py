@@ -73,6 +73,7 @@ except Exception:  # noqa: BLE001
 __all__ = ["paged_decode_attention", "paged_attention_pallas",
            "mixed_paged_attention", "mixed_attention_pallas",
            "verify_chunk_scores", "gather_pages_dequant",
+           "merge_softmax_partials", "seq_local_pages",
            "KV_SCALE_EPS", "NULL_PAGE"]
 
 #: page id 0 is never allocated: padded block-table entries and
@@ -415,13 +416,21 @@ def _paged_attn_reference_int8(q, k_pages, v_pages, block_table,
 
 
 def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens,
-                           kv_scales=None):
+                           kv_scales=None, seq_axis=None, n_seq=1):
     """Entry used by the llama paged decode step: the Pallas kernel on
     TPU when the block pool is tileable, else the XLA gather reference
     (CPU tests pin the reference's bit-parity with the contiguous
     path; the kernel's own parity is pinned in interpret mode).
     ``kv_scales`` switches to the int8 path — the TPU gate tightens to
-    the int8 minimum tile (bs % 32, hd % 128)."""
+    the int8 minimum tile (bs % 32, hd % 128). ``seq_axis`` (inside a
+    shard_map whose pools are page-sharded over that mesh axis into
+    ``n_seq`` stripes) switches to the partial-softmax form — each
+    shard attends over its local pages and the partials merge with one
+    collective (SURVEY §7.22)."""
+    if seq_axis is not None and n_seq > 1:
+        return _paged_decode_attention_seq(
+            q, k_pages, v_pages, block_table, seq_lens, seq_axis,
+            n_seq, kv_scales=kv_scales)
     bs, hd = k_pages.shape[1], k_pages.shape[3]
     if kv_scales is not None:
         if (_HAS_PLTPU and jax.default_backend() == "tpu"
@@ -600,7 +609,8 @@ def _mixed_attn_reference(q, k_pages, v_pages, block_table, kv_lens,
 
 
 def mixed_paged_attention(q, k_pages, v_pages, block_table, kv_lens,
-                          q_lens, kv_scales=None):
+                          q_lens, kv_scales=None, seq_axis=None,
+                          n_seq=1):
     """Entry for mixed prefill-chunk + decode launches: the Pallas
     kernel on TPU when the pool is tileable, else the XLA gather
     reference (the kernel's parity is pinned in interpret mode; the
@@ -609,7 +619,12 @@ def mixed_paged_attention(q, k_pages, v_pages, block_table, kv_lens,
     (``kv_scales`` given) always take the gather reference — the mixed
     int8 kernel is the per-page fp8 follow-on's problem, and decode
     steps (the bandwidth-bound path ISSUE 8 targets) never come through
-    here."""
+    here. ``seq_axis``/``n_seq`` switch to the page-sharded
+    partial-softmax form exactly like :func:`paged_decode_attention`."""
+    if seq_axis is not None and n_seq > 1:
+        return _mixed_paged_attention_seq(
+            q, k_pages, v_pages, block_table, kv_lens, q_lens,
+            seq_axis, n_seq, kv_scales=kv_scales)
     bs, hd = k_pages.shape[1], k_pages.shape[3]
     if kv_scales is not None:
         return _mixed_attn_reference(q, k_pages, v_pages, block_table,
@@ -627,7 +642,8 @@ def mixed_paged_attention(q, k_pages, v_pages, block_table, kv_lens,
 # ---------------------------------------------------------------------------
 
 def verify_chunk_scores(q, k_pages, v_pages, block_table, kv_lens,
-                        q_lens, kv_scales=None):
+                        q_lens, kv_scales=None, seq_axis=None,
+                        n_seq=1):
     """Attention for a speculative VERIFY chunk: row b's q_lens[b]
     query tokens are the pending next-input token plus its k drafts,
     already scattered into the pool at absolute positions
@@ -638,4 +654,135 @@ def verify_chunk_scores(q, k_pages, v_pages, block_table, kv_lens,
     query slots past q_lens[b] compute finite garbage the engine's
     accept loop never reads."""
     return mixed_paged_attention(q, k_pages, v_pages, block_table,
-                                 kv_lens, q_lens, kv_scales=kv_scales)
+                                 kv_lens, q_lens, kv_scales=kv_scales,
+                                 seq_axis=seq_axis, n_seq=n_seq)
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel partials (2-D mesh, ISSUE 16 tentpole)
+# ---------------------------------------------------------------------------
+# Inside a shard_map over a (seq, tp) mesh the pools arrive PAGE-
+# sharded: seq shard s holds global pages [s*n_local, (s+1)*n_local).
+# The allocator stripes pages so the page at block-table column j is
+# always in stripe j % n_seq (paged_cache.py), which makes the shard's
+# attention a dense STRIDED gather — columns s, s+n_seq, ... of every
+# table — rather than a masked full-width one. Each shard runs the
+# masked online-softmax over only those local keys and emits partial
+# (m, l, acc); ONE collective merge along seq (ring-attention math on a
+# flat topology) finishes the softmax:
+#     M = pmax(m);  w = exp(m - M)
+#     out = psum(acc * w) / max(psum(l * w), eps)
+# Masking uses the FINITE _NEG_INF, so a shard with zero valid keys
+# contributes m = _NEG_INF, w = exp(_NEG_INF - M) -> 0 (or 1 when ALL
+# shards are empty, where l = 0 makes the row exact zeros) — no NaNs,
+# and q_len=0 padding rows keep the exact-zero contract.
+
+def _seq_gather_ids(block_table, n_seq, n_local, bs, seq_axis):
+    """This seq shard's strided view of every row's block table.
+    Returns ``local`` [B, W] (page ids rebased into the shard's local
+    pool, W = ceil(max_blocks / n_seq)) and ``k_ids`` [W*bs] (the
+    ABSOLUTE key position of each gathered slot; slots from columns
+    past the table width get a huge sentinel so every ``< len`` mask
+    drops them)."""
+    B, mb = block_table.shape
+    shard = jax.lax.axis_index(seq_axis)
+    W = -(-mb // n_seq)
+    cols = shard + n_seq * jnp.arange(W, dtype=jnp.int32)   # [W]
+    valid = cols < mb
+    colsc = jnp.minimum(cols, mb - 1)
+    pages = jnp.take(block_table, colsc, axis=1)            # [B, W]
+    # clip, not mask: out-of-stripe ids only occur in NULL/pad entries,
+    # whose keys the k_ids sentinel or seq_lens mask already kills.
+    local = jnp.clip(pages - shard * n_local, 0, n_local - 1)
+    k_ids = colsc[:, None] * bs + jnp.arange(bs, dtype=jnp.int32)[None]
+    k_ids = jnp.where(valid[:, None], k_ids, jnp.int32(2 ** 30))
+    return local, k_ids.reshape(-1)
+
+
+def seq_local_pages(page, n_local, seq_axis):
+    """Rebase GLOBAL page ids for a WRITE on this seq shard: owned ids
+    map into [0, n_local); non-owned ids map to n_local — a positive
+    out-of-bounds index that ``.at[...].set(..., mode="drop")``
+    discards (negative indices would WRAP, silently corrupting page
+    n_local-1). Returns (local_ids, owned_mask)."""
+    off0 = jax.lax.axis_index(seq_axis) * n_local
+    owned = (page >= off0) & (page < off0 + n_local)
+    return jnp.where(owned, page - off0, n_local), owned
+
+
+def merge_softmax_partials(m, l, acc, axis):
+    """Combine per-shard online-softmax partials along mesh ``axis``:
+    m/l [...], acc [..., hd] -> merged [..., hd]. One pmax + two psums
+    — the flat-topology form of the ring-attention accumulator
+    combine."""
+    M = jax.lax.pmax(m, axis)
+    w = jnp.exp(m - M)
+    L = jax.lax.psum(l * w, axis)
+    ACC = jax.lax.psum(acc * w[..., None], axis)
+    return ACC / jnp.maximum(L, 1e-30)[..., None]
+
+
+def _paged_decode_attention_seq(q, k_pages, v_pages, block_table,
+                                seq_lens, seq_axis, n_seq,
+                                kv_scales=None):
+    """Page-sharded decode attention: the `_paged_attn_reference` math
+    over this shard's strided columns, finished by
+    :func:`merge_softmax_partials`. q [B, kvh_loc, G, hd]; pools
+    [n_local, bs, kvh_loc, hd]."""
+    n_local, bs = k_pages.shape[0], k_pages.shape[1]
+    local, k_ids = _seq_gather_ids(block_table, n_seq, n_local, bs,
+                                   seq_axis)
+    if kv_scales is not None:
+        ck = gather_pages_dequant(k_pages, local, kv_scales[0])
+        cv = gather_pages_dequant(v_pages, local, kv_scales[1])
+    else:
+        ck = gather_pages(k_pages, local)       # [B, W*bs, kvh, hd]
+        cv = gather_pages(v_pages, local)
+    mask = k_ids[None, :] < seq_lens[:, None]   # [B, W*bs]
+    qf = q.astype(jnp.float32)
+    scale = q.shape[-1] ** 0.5
+    s = jnp.einsum("bngd,btnd->bngt", qf,
+                   ck.astype(jnp.float32)) / scale
+    s = jnp.where(mask[:, None, None, :], s, _NEG_INF)
+    m = s.max(axis=-1)                          # [B, kvh, G]
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(mask[:, None, None, :], p, 0.0)
+    l = p.sum(axis=-1)
+    acc = jnp.einsum("bngt,btnd->bngd", p, cv.astype(jnp.float32))
+    return merge_softmax_partials(m, l, acc, seq_axis)
+
+
+def _mixed_paged_attention_seq(q, k_pages, v_pages, block_table,
+                               kv_lens, q_lens, seq_axis, n_seq,
+                               kv_scales=None):
+    """Page-sharded mixed launch: `_mixed_attn_reference`'s per-query
+    causal mask over this shard's strided columns + one partial merge.
+    q [B, T, kvh_loc, G, hd]; rows with no attendable position on ANY
+    shard (kv_len 0 / q_len 0 padding) come out exact zeros — every
+    shard's l is 0 so the merged L floors at eps over a zero ACC."""
+    n_local, bs = k_pages.shape[0], k_pages.shape[1]
+    local, k_ids = _seq_gather_ids(block_table, n_seq, n_local, bs,
+                                   seq_axis)
+    if kv_scales is not None:
+        ck = gather_pages_dequant(k_pages, local, kv_scales[0])
+        cv = gather_pages_dequant(v_pages, local, kv_scales[1])
+    else:
+        ck = gather_pages(k_pages, local)       # [B, W*bs, kvh, hd]
+        cv = gather_pages(v_pages, local)
+    T = q.shape[1]
+    pos = (kv_lens[:, None] - q_lens[:, None]
+           + jnp.arange(T)[None, :])            # [B, T]
+    j = k_ids[None, None, :]                    # absolute positions
+    ok = (j <= pos[:, :, None]) & (j < kv_lens[:, None, None])
+    qf = q.astype(jnp.float32)
+    scale = q.shape[-1] ** 0.5
+    s = jnp.einsum("btngd,bsnd->btngs", qf,
+                   ck.astype(jnp.float32)) / scale
+    okx = ok[:, :, None, None, :]
+    s = jnp.where(okx, s, _NEG_INF)
+    m = s.max(axis=-1)                          # [B, T, kvh, G]
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(okx, p, 0.0)
+    l = p.sum(axis=-1)
+    acc = jnp.einsum("btngs,bsnd->btngd", p, cv.astype(jnp.float32))
+    return merge_softmax_partials(m, l, acc, seq_axis)

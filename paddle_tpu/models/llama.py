@@ -34,7 +34,9 @@ from ..core.tensor import Tensor
 from ..nn import functional as F
 from ..distributed.fleet.mp_layers import shard_hint
 from ..distributed.fleet.pipeline import safe_psum  # the ONE bf16-psum shim
-from ..kernels.paged_attention import paged_decode_attention
+from ..kernels.paged_attention import (paged_decode_attention,
+                                       merge_softmax_partials,
+                                       seq_local_pages)
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "llama_loss_fn",
            "LLAMA_PRESETS", "quantize_weights_int8"]
@@ -965,7 +967,8 @@ def _decode_step(cfg, stacked, embed, final_norm, lm_head, token, cache_k,
     return logits, cks, cvs
 
 
-def _quantized_token_insert(pool, scales, page, off, tok):
+def _quantized_token_insert(pool, scales, page, off, tok,
+                            seq_axis=None):
     """Append ONE token per row into an int8 pool page with a
     RUNNING-MAX per-(page, kv head) scale (ISSUE 8 int8 paged KV).
 
@@ -976,24 +979,39 @@ def _quantized_token_insert(pool, scales, page, off, tok):
     when the token doesn't raise the max the ratio is exactly 1.0 and
     ``round(q * 1.0) == q``, so untouched tokens keep their codes
     bit-identical (the no-op case every step but the occasional
-    outlier). Inactive rows write the NULL page, same as the fp path."""
+    outlier). Inactive rows write the NULL page, same as the fp path.
+
+    ``seq_axis``: page-sharded pools (2-D mesh) — ``page`` is a GLOBAL
+    id; reads clamp into the local stripe (garbage on non-owners, whose
+    writes are dropped) and writes rebase + drop non-owned rows, so the
+    update lands exactly once, on the owning shard."""
     b = tok.shape[0]
+    if seq_axis is not None:
+        wp, owned = seq_local_pages(page, pool.shape[0], seq_axis)
+        rp = jnp.where(owned, wp, 0)
+    else:
+        wp = rp = page
     amax = jnp.abs(tok).max(axis=-1)                     # [b, kvh]
-    old = jnp.take(scales, page, axis=0)                 # [b, kvh]
+    old = jnp.take(scales, rp, axis=0)                   # [b, kvh]
     new = jnp.maximum(old, amax / 127.0)
-    codes = jnp.take(pool, page, axis=0)                 # [b, bs, kvh, hd]
+    codes = jnp.take(pool, rp, axis=0)                   # [b, bs, kvh, hd]
     ratio = (old / new)[:, None, :, None]
     req = jnp.clip(jnp.round(codes.astype(jnp.float32) * ratio),
                    -127, 127)
     qt = jnp.clip(jnp.round(tok / new[:, :, None]), -127, 127)
     req = req.at[jnp.arange(b), off].set(qt)
-    pool = pool.at[page].set(req.astype(pool.dtype))
-    scales = scales.at[page].set(new)
+    if seq_axis is not None:
+        pool = pool.at[wp].set(req.astype(pool.dtype), mode="drop")
+        scales = scales.at[wp].set(new, mode="drop")
+    else:
+        pool = pool.at[page].set(req.astype(pool.dtype))
+        scales = scales.at[page].set(new)
     return pool, scales
 
 
 def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens,
-                             kscale=None, vscale=None, mp_axis=None):
+                             kscale=None, vscale=None, mp_axis=None,
+                             seq_axis=None, n_seq=1):
     """One decoder layer for ONE token per row against the PAGED KV
     cache: kp/vp [N, bs, kvh, hd] block pool, tables [b, max_blocks]
     int32 page ids, lens [b] int32 = tokens already cached (the new
@@ -1004,7 +1022,10 @@ def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens,
     dequantizes inside the paged program. ``mp_axis``: inside a
     shard_map region the pool/weights are kv-head shards and the
     wo/w_down matmuls finish with a psum (ISSUE 10, same Megatron
-    pattern as _decoder_layer)."""
+    pattern as _decoder_layer). ``seq_axis``/``n_seq``: the pools are
+    additionally PAGE shards of a 2-D mesh (ISSUE 16) — writes route
+    through ownership rebasing and the attention merges per-shard
+    softmax partials."""
     hd = cfg.head_dim
     h = lp["wq"].shape[-1] // hd
     kvh = lp["wk"].shape[-1] // hd
@@ -1034,18 +1055,26 @@ def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens,
                                axis=1)[:, 0]
     off = lens % bs
     if kscale is not None:
-        kp, kscale = _quantized_token_insert(kp, kscale, page, off,
-                                             k[:, 0].astype(jnp.float32))
-        vp, vscale = _quantized_token_insert(vp, vscale, page, off,
-                                             v[:, 0].astype(jnp.float32))
+        kp, kscale = _quantized_token_insert(
+            kp, kscale, page, off, k[:, 0].astype(jnp.float32),
+            seq_axis=seq_axis)
+        vp, vscale = _quantized_token_insert(
+            vp, vscale, page, off, v[:, 0].astype(jnp.float32),
+            seq_axis=seq_axis)
         kv_scales = (kscale, vscale)
+    elif seq_axis is not None:
+        wp, _ = seq_local_pages(page, kp.shape[0], seq_axis)
+        kp = kp.at[wp, off].set(k[:, 0].astype(kp.dtype), mode="drop")
+        vp = vp.at[wp, off].set(v[:, 0].astype(vp.dtype), mode="drop")
+        kv_scales = None
     else:
         kp = kp.at[page, off].set(k[:, 0].astype(kp.dtype))
         vp = vp.at[page, off].set(v[:, 0].astype(vp.dtype))
         kv_scales = None
     qg = q[:, 0].reshape(b, kvh, g, hd)
     attn = paged_decode_attention(qg, kp, vp, tables, lens + 1,
-                                  kv_scales=kv_scales)
+                                  kv_scales=kv_scales,
+                                  seq_axis=seq_axis, n_seq=n_seq)
     attn = attn.astype(x.dtype).reshape(b, 1, h * hd)
     x = x + _mp_sum(attn @ lp["wo"])
 
@@ -1063,7 +1092,8 @@ def _paged_decode_layer_step(cfg, lp, x, kp, vp, tables, lens,
 
 def _paged_decode_step(cfg, stacked, embed, final_norm, lm_head, token,
                        pages_k, pages_v, tables, lens, kscales=None,
-                       vscales=None, mp_axis=None):
+                       vscales=None, mp_axis=None, seq_axis=None,
+                       n_seq=1):
     """Jittable paged single-token step: [b] token ids +
     [L, N, bs, kvh, hd] block pools + [b, max_blocks] tables + [b] lens
     -> (logits [b, V], updated pools). The tables/lens are DATA, so one
@@ -1076,7 +1106,8 @@ def _paged_decode_step(cfg, stacked, embed, final_norm, lm_head, token,
         def layer_fn(carry, xs):
             lp, kp, vp = xs
             out, kp, vp, _, _ = _paged_decode_layer_step(
-                cfg, lp, carry, kp, vp, tables, lens, mp_axis=mp_axis)
+                cfg, lp, carry, kp, vp, tables, lens, mp_axis=mp_axis,
+                seq_axis=seq_axis, n_seq=n_seq)
             return out, (kp, vp)
 
         x, (kps, vps) = jax.lax.scan(layer_fn, x,
@@ -1089,7 +1120,7 @@ def _paged_decode_step(cfg, stacked, embed, final_norm, lm_head, token,
         lp, kp, vp, ksc, vsc = xs
         out, kp, vp, ksc, vsc = _paged_decode_layer_step(
             cfg, lp, carry, kp, vp, tables, lens, ksc, vsc,
-            mp_axis=mp_axis)
+            mp_axis=mp_axis, seq_axis=seq_axis, n_seq=n_seq)
         return out, (kp, vp, ksc, vsc)
 
     x, (kps, vps, kscales, vscales) = jax.lax.scan(
@@ -1100,7 +1131,7 @@ def _paged_decode_step(cfg, stacked, embed, final_norm, lm_head, token,
 
 
 def _quantized_prefill_scatter(pool, scales, toks, page, off, valid,
-                               table_row):
+                               table_row, seq_axis=None):
     """int8 half of :func:`scatter_prefill_kv` for ONE pool. toks
     [L, sp, kvh, hd] f32; page/off/valid [sp]; scales [L, N, kvh].
     Scale update is a SCATTER-MAX (order-independent, so the multiple
@@ -1109,28 +1140,50 @@ def _quantized_prefill_scatter(pool, scales, toks, page, off, valid,
     — pages whose max didn't move get ratio exactly 1.0, i.e. their
     codes survive bit-identical (this is what keeps SHARED prefix pages
     unperturbed by a tail prefill: the tail never scatter-maxes into a
-    full shared page)."""
+    full shared page). ``seq_axis``: page-sharded pools — GLOBAL ids
+    rebase into the local stripe, reads clamp, writes drop non-owned
+    entries (scale growth and re-expression happen on the owning shard
+    only, which holds the authoritative codes and scales)."""
+    if seq_axis is not None:
+        n_local = pool.shape[1]
+        wp, owned = seq_local_pages(page, n_local, seq_axis)
+        rp = jnp.where(owned, wp, 0)
+        wt, owned_t = seq_local_pages(table_row, n_local, seq_axis)
+        rt = jnp.where(owned_t, wt, 0)
+    else:
+        wp = rp = page
+        wt = rt = table_row
     amax = jnp.where(valid[None, :, None],
                      jnp.abs(toks).max(axis=-1), 0.0)    # [L, sp, kvh]
     old_all = scales
-    scales = scales.at[:, page].max(amax / 127.0)
+    if seq_axis is not None:
+        scales = scales.at[:, wp].max(amax / 127.0, mode="drop")
+    else:
+        scales = scales.at[:, page].max(amax / 127.0)
     # re-express the row's resident codes in the grown scales
-    codes = jnp.take(pool, table_row, axis=1)    # [L, mb, bs, kvh, hd]
-    old = jnp.take(old_all, table_row, axis=1)           # [L, mb, kvh]
-    new = jnp.take(scales, table_row, axis=1)
+    codes = jnp.take(pool, rt, axis=1)       # [L, mb, bs, kvh, hd]
+    old = jnp.take(old_all, rt, axis=1)                  # [L, mb, kvh]
+    new = jnp.take(scales, rt, axis=1)
     ratio = (old / new)[:, :, None, :, None]
     req = jnp.clip(jnp.round(codes.astype(jnp.float32) * ratio),
                    -127, 127)
-    pool = pool.at[:, table_row].set(req.astype(pool.dtype))
+    if seq_axis is not None:
+        pool = pool.at[:, wt].set(req.astype(pool.dtype), mode="drop")
+    else:
+        pool = pool.at[:, table_row].set(req.astype(pool.dtype))
     # quantize the new tokens against their page's (post-max) scale
-    sc_tok = jnp.take(scales, page, axis=1)              # [L, sp, kvh]
+    sc_tok = jnp.take(scales, rp, axis=1)                # [L, sp, kvh]
     qt = jnp.clip(jnp.round(toks / sc_tok[..., None]), -127, 127)
-    pool = pool.at[:, page, off].set(qt.astype(pool.dtype))
+    if seq_axis is not None:
+        pool = pool.at[:, wp, off].set(qt.astype(pool.dtype),
+                                       mode="drop")
+    else:
+        pool = pool.at[:, page, off].set(qt.astype(pool.dtype))
     return pool, scales
 
 
 def scatter_prefill_kv(kp, vp, ks, vs, table_row, pad, offset=0,
-                       kv_scales=None):
+                       kv_scales=None, seq_axis=None):
     """Insert ONE row's prefill K/V into the block pools. ks/vs
     [L, 1, sp, kvh, hd] (right-aligned, ``pad`` left pads); table_row
     [max_blocks] int32. Pad positions are routed to the NULL page, so
@@ -1139,7 +1192,9 @@ def scatter_prefill_kv(kp, vp, ks, vs, table_row, pad, offset=0,
     real token lands at context position ``offset``, which may sit
     mid-page inside the row's private COW copy). With
     ``kv_scales=(kscale, vscale)`` ([L, N, kvh] f32) the pools are int8
-    codes and the return grows to (kp, vp, kscale, vscale)."""
+    codes and the return grows to (kp, vp, kscale, vscale).
+    ``seq_axis``: page-sharded pools — each shard keeps only the
+    positions whose page it owns (drop-mode writes)."""
     bs = kp.shape[2]
     sp = ks.shape[2]
     j = jnp.arange(sp)
@@ -1151,18 +1206,25 @@ def scatter_prefill_kv(kp, vp, ks, vs, table_row, pad, offset=0,
         kscale, vscale = kv_scales
         kp, kscale = _quantized_prefill_scatter(
             kp, kscale, ks[:, 0].astype(jnp.float32), page, off, valid,
-            table_row)
+            table_row, seq_axis=seq_axis)
         vp, vscale = _quantized_prefill_scatter(
             vp, vscale, vs[:, 0].astype(jnp.float32), page, off, valid,
-            table_row)
+            table_row, seq_axis=seq_axis)
         return kp, vp, kscale, vscale
+    if seq_axis is not None:
+        wp, _ = seq_local_pages(page, kp.shape[1], seq_axis)
+        kp = kp.at[:, wp, off].set(ks[:, 0].astype(kp.dtype),
+                                   mode="drop")
+        vp = vp.at[:, wp, off].set(vs[:, 0].astype(vp.dtype),
+                                   mode="drop")
+        return kp, vp
     kp = kp.at[:, page, off].set(ks[:, 0].astype(kp.dtype))
     vp = vp.at[:, page, off].set(vs[:, 0].astype(vp.dtype))
     return kp, vp
 
 
 def _quantized_mixed_scatter(pool, scales, toks, page, off, valid,
-                             tables):
+                             tables, seq_axis=None):
     """int8 write half of the MIXED step for ONE layer's pool (ISSUE
     10): the [B, T] window generalization of
     :func:`_quantized_prefill_scatter`. pool [N, bs, kvh, hd] int8;
@@ -1175,27 +1237,48 @@ def _quantized_mixed_scatter(pool, scales, toks, page, off, valid,
     row's private tail pages, so rows sharing a page re-express it to
     identical values and the duplicate scatter is deterministic.
     Padding slots (valid=False) contribute amax 0 and write the NULL
-    page, same as the per-row scatter."""
+    page, same as the per-row scatter. ``seq_axis``: page-sharded
+    pools — global ids rebase, reads clamp, non-owned writes drop."""
+    if seq_axis is not None:
+        n_local = pool.shape[0]
+        wp, owned = seq_local_pages(page, n_local, seq_axis)
+        rp = jnp.where(owned, wp, 0)
+        wt, owned_t = seq_local_pages(tables, n_local, seq_axis)
+        rt = jnp.where(owned_t, wt, 0)
+    else:
+        wp = rp = page
+        wt = rt = tables
     amax = jnp.where(valid[..., None],
                      jnp.abs(toks).max(axis=-1), 0.0)    # [B, T, kvh]
     old_all = scales
-    scales = scales.at[page].max(amax / 127.0)
-    codes = jnp.take(pool, tables, axis=0)   # [B, mb, bs, kvh, hd]
-    old = jnp.take(old_all, tables, axis=0)              # [B, mb, kvh]
-    new = jnp.take(scales, tables, axis=0)
+    if seq_axis is not None:
+        scales = scales.at[wp].max(amax / 127.0, mode="drop")
+    else:
+        scales = scales.at[page].max(amax / 127.0)
+    codes = jnp.take(pool, rt, axis=0)       # [B, mb, bs, kvh, hd]
+    old = jnp.take(old_all, rt, axis=0)                  # [B, mb, kvh]
+    new = jnp.take(scales, rt, axis=0)
     ratio = (old / new)[:, :, None, :, None]
     req = jnp.clip(jnp.round(codes.astype(jnp.float32) * ratio),
                    -127, 127)
-    pool = pool.at[tables].set(req.astype(pool.dtype))
-    sc_tok = jnp.take(scales, page, axis=0)              # [B, T, kvh]
+    if seq_axis is not None:
+        pool = pool.at[wt].set(req.astype(pool.dtype), mode="drop")
+    else:
+        pool = pool.at[tables].set(req.astype(pool.dtype))
+    sc_tok = jnp.take(scales, rp, axis=0)                # [B, T, kvh]
     qt = jnp.clip(jnp.round(toks / sc_tok[..., None]), -127, 127)
-    pool = pool.at[page, off].set(qt.astype(pool.dtype))
+    if seq_axis is not None:
+        pool = pool.at[wp, off].set(qt.astype(pool.dtype),
+                                    mode="drop")
+    else:
+        pool = pool.at[page, off].set(qt.astype(pool.dtype))
     return pool, scales
 
 
 def _mixed_decoder_layer(cfg, lp, x, positions, valid, page, off,
                          tables, kv_lens, q_lens, kp, vp, kscale=None,
-                         vscale=None, mp_axis=None):
+                         vscale=None, mp_axis=None, seq_axis=None,
+                         n_seq=1):
     """One decoder layer for a MIXED window batch (ISSUE 10 tentpole):
     row b carries q_lens[b] window tokens (LEFT-aligned — a prefill
     chunk, a verify window, or a single decode token) ending at context
@@ -1228,18 +1311,24 @@ def _mixed_decoder_layer(cfg, lp, x, positions, valid, page, off,
     if kscale is not None:
         kp, kscale = _quantized_mixed_scatter(
             kp, kscale, k.astype(jnp.float32), page, off, valid,
-            tables)
+            tables, seq_axis=seq_axis)
         vp, vscale = _quantized_mixed_scatter(
             vp, vscale, v.astype(jnp.float32), page, off, valid,
-            tables)
+            tables, seq_axis=seq_axis)
         kv_scales = (kscale, vscale)
+    elif seq_axis is not None:
+        wp, _ = seq_local_pages(page, kp.shape[0], seq_axis)
+        kp = kp.at[wp, off].set(k.astype(kp.dtype), mode="drop")
+        vp = vp.at[wp, off].set(v.astype(vp.dtype), mode="drop")
+        kv_scales = None
     else:
         kp = kp.at[page, off].set(k.astype(kp.dtype))
         vp = vp.at[page, off].set(v.astype(vp.dtype))
         kv_scales = None
     qg = q.reshape(b, t, kvh, g, hd)
     attn = mixed_paged_attention(qg, kp, vp, tables, kv_lens, q_lens,
-                                 kv_scales=kv_scales)
+                                 kv_scales=kv_scales,
+                                 seq_axis=seq_axis, n_seq=n_seq)
     attn = attn.astype(x.dtype).reshape(b, t, h * hd)
     x = x + _mp_sum(attn @ lp["wo"])
 
@@ -1258,7 +1347,8 @@ def _mixed_decoder_layer(cfg, lp, x, positions, valid, page, off,
 
 def mixed_paged_step(cfg, stacked, embed, final_norm, lm_head, ids,
                      q_lens, kv_lens, tables, pages_k, pages_v,
-                     kscales=None, vscales=None, mp_axis=None):
+                     kscales=None, vscales=None, mp_axis=None,
+                     seq_axis=None, n_seq=1):
     """Jittable SINGLE-LAUNCH mixed step (ISSUE 10 tentpole): every
     decode-ready row's verify window and every funded prefill chunk
     run in ONE program. ids [B, T] LEFT-aligned windows (slot
@@ -1285,7 +1375,8 @@ def mixed_paged_step(cfg, stacked, embed, final_norm, lm_head, ids,
             lp, kp, vp = xs
             out, kp, vp, _, _ = _mixed_decoder_layer(
                 cfg, lp, carry, pos, valid, page, off, tables, kv_lens,
-                q_lens, kp, vp, mp_axis=mp_axis)
+                q_lens, kp, vp, mp_axis=mp_axis, seq_axis=seq_axis,
+                n_seq=n_seq)
             return out, (kp, vp)
 
         x, pools = jax.lax.scan(layer_fn, x,
@@ -1295,7 +1386,8 @@ def mixed_paged_step(cfg, stacked, embed, final_norm, lm_head, ids,
             lp, kp, vp, ksc, vsc = xs
             out, kp, vp, ksc, vsc = _mixed_decoder_layer(
                 cfg, lp, carry, pos, valid, page, off, tables, kv_lens,
-                q_lens, kp, vp, ksc, vsc, mp_axis=mp_axis)
+                q_lens, kp, vp, ksc, vsc, mp_axis=mp_axis,
+                seq_axis=seq_axis, n_seq=n_seq)
             return out, (kp, vp, ksc, vsc)
 
         x, pools = jax.lax.scan(
@@ -1408,8 +1500,57 @@ def _attention_prefix(q, k, v, key_mask, pk, pv, prefix_mask):
     return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
 
 
+def _attention_prefix_seq(q, k, v, key_mask, pk, pv, prefix_mask,
+                          seq_axis):
+    """Page-sharded :func:`_attention_prefix` (2-D mesh, ISSUE 16):
+    pk/pv are this seq shard's STRIDED prefix gather with
+    ``prefix_mask`` derived from the strided absolute positions; the
+    causal window k/v are replicated over seq, so their scores are
+    counted on shard 0 ONLY and every shard emits online-softmax
+    partials merged by :func:`merge_softmax_partials`. Masking uses the
+    FINITE ``-1e30`` so empty shards contribute zero weight without
+    NaNs (kernels/paged_attention.py, same math as the decode/mixed
+    partials)."""
+    neg = -1e30
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    spl = pk.shape[1]
+    qh = jnp.swapaxes(q, 1, 2).reshape(B, Hkv, G, S, D)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    pkh = jnp.swapaxes(pk, 1, 2)
+    pvh = jnp.swapaxes(pv, 1, 2)
+    scale = D ** 0.5
+    sw = jnp.einsum("bngsd,bntd->bngst", qh, kh).astype(jnp.float32)
+    sw = sw / scale
+    sp = jnp.einsum("bngsd,bntd->bngst", qh, pkh).astype(jnp.float32)
+    sp = sp / scale
+    causal = jnp.tril(jnp.ones((S, S), bool))
+    on_shard0 = jax.lax.axis_index(seq_axis) == 0
+    valid_w = (causal[None, :, :] & key_mask[:, None, :].astype(bool)
+               & on_shard0)
+    pm = jnp.broadcast_to(
+        prefix_mask[:, None, None, None, :].astype(bool),
+        (B, 1, 1, S, spl))
+    wm = jnp.broadcast_to(valid_w[:, None, None, :, :],
+                          (B, 1, 1, S, S))
+    ok = jnp.concatenate([pm, wm], axis=-1)  # prefix first: chrono
+    s = jnp.concatenate([sp, sw], axis=-1)
+    s = jnp.where(ok, s, neg)
+    m = s.max(axis=-1)                       # [B, Hkv, G, S]
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(ok, p, 0.0)
+    l = p.sum(axis=-1)
+    vall = jnp.concatenate([pvh, vh], axis=2).astype(jnp.float32)
+    acc = jnp.einsum("bngst,bntd->bngsd", p, vall)
+    out = merge_softmax_partials(m, l, acc, seq_axis)
+    out = out.astype(q.dtype).reshape(B, H, S, D)
+    return jnp.swapaxes(out, 1, 2)
+
+
 def _prefix_decoder_layer(cfg, lp, x, positions, key_mask, pk, pv,
-                          prefix_mask, mp_axis=None):
+                          prefix_mask, mp_axis=None, seq_axis=None):
     """One decoder layer over an uncached TAIL window attending to a
     cached paged prefix (single-program GSPMD path, mirrors
     _decoder_layer's math with _attention_prefix in place of
@@ -1435,7 +1576,12 @@ def _prefix_decoder_layer(cfg, lp, x, positions, key_mask, pk, pv,
     q = _rope(q.reshape(b, s, h, hd), positions, cfg.rope_theta, hd)
     k = _rope(k.reshape(b, s, kvh, hd), positions, cfg.rope_theta, hd)
     v = v.reshape(b, s, kvh, hd)
-    attn = _attention_prefix(q, k, v, key_mask, pk, pv, prefix_mask)
+    if seq_axis is not None:
+        attn = _attention_prefix_seq(q, k, v, key_mask, pk, pv,
+                                     prefix_mask, seq_axis)
+    else:
+        attn = _attention_prefix(q, k, v, key_mask, pk, pv,
+                                 prefix_mask)
     x = x + _mp_sum(attn.reshape(b, s, h * hd) @ lp["wo"])
 
     y = _rms(x, lp["post_ln"], cfg.rms_norm_eps)
@@ -1454,7 +1600,7 @@ def _prefix_decoder_layer(cfg, lp, x, positions, key_mask, pk, pv,
 def prefix_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
                    pad_len, prefix_len, kp, vp, table_row,
                    last_index=None, kv_scales=None, all_logits=False,
-                   mp_axis=None):
+                   mp_axis=None, seq_axis=None, n_seq=1):
     """Position-offset prefill of an UNCACHED TAIL over a prefix already
     resident in the paged pool (prefix-hit admission, ISSUE 2).
 
@@ -1473,9 +1619,12 @@ def prefix_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
     argmax chain off the last k+1 positions. ``kv_scales`` ([L, N, kvh]
     f32 pair) switches the pools to int8 codes — gathers dequantize,
     the final scatter quantizes — and appends the updated scales to the
-    return."""
+    return. ``seq_axis``/``n_seq``: page-sharded pools (2-D mesh) —
+    each layer gathers only this shard's STRIDED prefix columns, the
+    attention merges per-shard partials, and the tail scatter keeps
+    only owned pages."""
     from ..kernels.paged_attention import gather_pages, \
-        gather_pages_dequant
+        gather_pages_dequant, _seq_gather_ids
     b, sc = ids.shape
     bs = kp.shape[2]
     mb = table_row.shape[0]
@@ -1483,17 +1632,23 @@ def prefix_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
         jnp.arange(sc)[None, :] - pad_len[:, None], 0) \
         + prefix_len[:, None]
     key_mask = jnp.arange(sc)[None, :] >= pad_len[:, None]
-    prefix_mask = jnp.arange(mb * bs)[None, :] < prefix_len[:, None]
+    if seq_axis is not None:
+        gather_row, k_ids = _seq_gather_ids(
+            table_row[None, :], n_seq, kp.shape[1], bs, seq_axis)
+        prefix_mask = k_ids[None, :] < prefix_len[:, None]
+    else:
+        gather_row = table_row[None, :]
+        prefix_mask = jnp.arange(mb * bs)[None, :] < prefix_len[:, None]
     x = jnp.take(embed, ids, axis=0)
 
     if kv_scales is None:
         def layer_fn(carry, xs):
             lp, kpl, vpl = xs
-            pk = gather_pages(kpl, table_row[None, :]).astype(x.dtype)
-            pv = gather_pages(vpl, table_row[None, :]).astype(x.dtype)
+            pk = gather_pages(kpl, gather_row).astype(x.dtype)
+            pv = gather_pages(vpl, gather_row).astype(x.dtype)
             out, k, v = _prefix_decoder_layer(
                 cfg, lp, carry, positions, key_mask, pk, pv,
-                prefix_mask, mp_axis=mp_axis)
+                prefix_mask, mp_axis=mp_axis, seq_axis=seq_axis)
             return out, (k, v)
 
         x, (ks, vs) = jax.lax.scan(layer_fn, x, (stacked, kp, vp))
@@ -1501,12 +1656,12 @@ def prefix_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
         def layer_fn(carry, xs):
             lp, kpl, vpl, kscl, vscl = xs
             pk = gather_pages_dequant(
-                kpl, table_row[None, :], kscl).astype(x.dtype)
+                kpl, gather_row, kscl).astype(x.dtype)
             pv = gather_pages_dequant(
-                vpl, table_row[None, :], vscl).astype(x.dtype)
+                vpl, gather_row, vscl).astype(x.dtype)
             out, k, v = _prefix_decoder_layer(
                 cfg, lp, carry, positions, key_mask, pk, pv,
-                prefix_mask, mp_axis=mp_axis)
+                prefix_mask, mp_axis=mp_axis, seq_axis=seq_axis)
             return out, (k, v)
 
         x, (ks, vs) = jax.lax.scan(
@@ -1520,7 +1675,8 @@ def prefix_prefill(cfg, stacked, embed, final_norm, lm_head, ids,
                                          keepdims=False)
         logits = (last @ lm_head).astype(jnp.float32)
     out = scatter_prefill_kv(kp, vp, ks, vs, table_row, pad_len[0],
-                             offset=prefix_len[0], kv_scales=kv_scales)
+                             offset=prefix_len[0], kv_scales=kv_scales,
+                             seq_axis=seq_axis)
     return (logits, *out)
 
 

@@ -133,7 +133,8 @@ _NONDET_EXTRA = (
     "test_chaos.py", "test_slo.py", "test_spec_decode.py",
     "test_chunked_prefill.py", "test_prefix_scheduler.py",
     "test_observability.py", "test_paged_attention.py",
-    "test_tp_sharding.py", "test_bench_probe.py", "test_migration.py")
+    "test_tp_sharding.py", "test_bench_probe.py", "test_migration.py",
+    "test_seq_parallel.py")
 
 
 def nondet_extra_paths() -> list[pathlib.Path]:
